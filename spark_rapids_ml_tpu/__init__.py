@@ -1,0 +1,21 @@
+"""spark_rapids_ml_tpu — a TPU-native distributed classic-ML framework.
+
+Same capability surface as spark-rapids-ml (PCA, KMeans, Linear/Logistic
+Regression, RandomForest, exact kNN, UMAP, single-pass CrossValidator),
+re-designed for TPU: JAX/XLA global-math kernels over ``jax.sharding.Mesh``
+device meshes replace cuML/NCCL/UCX; a lightweight partitioned
+``DataFrame`` replaces the Spark data plane.
+
+Drop-in import layout mirrors the reference package::
+
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.regression import LinearRegression
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+"""
+
+__version__ = "0.1.0"
+
+from .data.dataframe import DataFrame, Row
+
+__all__ = ["DataFrame", "Row", "__version__"]

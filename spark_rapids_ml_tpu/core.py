@@ -1,0 +1,521 @@
+"""Core estimator/model framework — the reference ``core.py`` re-designed TPU-first.
+
+Reference architecture (``/root/reference/python/src/spark_rapids_ml/core.py``):
+Spark barrier tasks each ingest Arrow batches into device arrays, bootstrap a
+NCCL communicator, and call a per-algorithm closure returned by
+``_get_cuml_fit_func``; rank 0 yields the model row back to the driver
+(``core.py:615-780``). Transform is an embarrassingly-parallel pandas UDF
+(``core.py:1463-1568``).
+
+TPU-native redesign: there is no task/driver split — the host process owns a
+``jax.sharding.Mesh``; ``_pre_process_data`` shards the design matrix over
+the ``dp`` axis with ``NamedSharding`` and the per-algorithm fit function is
+a **jitted global-math function** (psum/all_gather inserted by XLA's SPMD
+partitioner, playing the role the NCCL allreduce played inside cuML).
+The subclass contract is preserved one-to-one:
+
+  reference hook                      this framework
+  ---------------------------------   ---------------------------------
+  ``_get_cuml_fit_func``              ``_get_tpu_fit_func``
+  ``_get_cuml_transform_func``        ``_get_tpu_transform_func``
+  ``_out_schema``                     (models return named arrays)
+  ``_pre_process_data``               ``_pre_process_data``
+  ``_require_nccl_ucx``               (absent — the mesh always exists)
+  ``fitMultiple``/``_combine``        same names, same single-pass contract
+  ``_transformEvaluate``              same name, same sufficient-stats design
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from abc import abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .data.dataframe import DataFrame, _is_sparse
+from .params import Params, _TpuParams, HasLabelCol, HasPredictionCol, HasWeightCol
+from .parallel.mesh import make_mesh, shard_rows, row_sharding
+from .utils.logging import get_logger
+
+
+def _resolve_feature_matrix(obj: "_TpuParams", dataset: DataFrame):
+    """Resolve the feature columns of ``dataset`` into one matrix.
+
+    Single implementation shared by the fit and transform paths (reference
+    column selection: ``core.py:449-546`` fit, ``core.py:1183-1303``
+    transform). Returns ``(X_dense, X_sparse)`` — exactly one is non-None;
+    ``X_sparse`` is a host scipy CSR and is only returned when the sparse
+    opt-in resolves to True (``enable_sparse_data_optim`` semantics,
+    reference ``params.py:42-63``).
+    """
+    input_col, input_cols = obj._get_input_columns()
+    if input_cols is not None:
+        mats = [np.asarray(dataset.column(c)).reshape(-1, 1) for c in input_cols]
+        return np.concatenate(mats, axis=1), None
+    col = dataset.column(input_col)
+    if _is_sparse(col):
+        use_sparse = True
+        if obj.hasParam("enable_sparse_data_optim") and obj.isDefined(
+            "enable_sparse_data_optim"
+        ):
+            if obj.getOrDefault("enable_sparse_data_optim") is False:
+                use_sparse = False
+        if use_sparse:
+            return None, col
+        return np.asarray(col.todense()), None
+    X = np.asarray(col)
+    if X.ndim != 2:
+        raise ValueError(f"Features column {input_col!r} must be a 2-D vector column")
+    return X, None
+
+def _x64_ctx(dtype: Any):
+    """Scoped x64 enablement for the float64 path.
+
+    The reference supports f64 inputs end-to-end (``float32_inputs=False``,
+    reference ``params.py:301-305``). JAX truncates to 32-bit by default and
+    toggling ``jax_enable_x64`` globally from a library import would change
+    numerics of unrelated user code — so widen only around our own
+    device_put/compute when the resolved input dtype is f64.
+    """
+    import contextlib
+
+    from jax._src.config import enable_x64
+
+    if jnp.dtype(dtype) == jnp.dtype("float64"):
+        return enable_x64(True)
+    return contextlib.nullcontext()
+
+
+@dataclass
+class FitInputs:
+    """Everything a fit function needs: the sharded design matrix + metadata.
+
+    Replaces the reference's per-task ``(dfs, params)`` closure inputs
+    (``core.py:749-762``) and ``PartitionDescriptor`` (``utils.py:163-200``):
+    ragged partitions become an even row-shard plus a validity mask.
+    """
+
+    X: jax.Array                     # (N_pad, d) row-sharded over dp
+    mask: jax.Array                  # (N_pad,) 1.0 valid / 0.0 padding
+    mesh: Any
+    n_rows: int                      # true (unpadded) row count
+    n_features: int
+    y: Optional[jax.Array] = None    # (N_pad,) labels, padded with 0
+    weight: Optional[jax.Array] = None
+    X_sparse: Optional[Any] = None   # host scipy CSR when the sparse path is on
+    dtype: Any = jnp.float32
+
+
+# fit function: (inputs, params_dict) -> dict of named numpy arrays/scalars
+FitFunc = Callable[[FitInputs, Dict[str, Any]], Dict[str, Any]]
+
+
+class _TpuEstimator(Params, _TpuParams):
+    """Abstract estimator (reference ``_CumlEstimator``, ``core.py:834-1032``)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._init_tpu_params()
+        self.logger = get_logger(type(self))
+
+    # ---- subclass hooks --------------------------------------------------
+    @abstractmethod
+    def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
+        ...
+
+    @abstractmethod
+    def _create_model(self, result: Dict[str, Any]) -> "_TpuModel":
+        ...
+
+    def _require_label(self) -> bool:
+        return isinstance(self, HasLabelCol)
+
+    def _supportsTransformEvaluate(self, evaluator: Any) -> bool:
+        return False
+
+    def _enable_fit_multiple_in_single_pass(self) -> bool:
+        return False
+
+    # ---- data plane ------------------------------------------------------
+    def _target_dtype(self, X: Optional[np.ndarray]) -> Any:
+        if self._float32_inputs:
+            return np.float32
+        if X is not None and X.dtype == np.float64:
+            return np.float64
+        return np.float32
+
+    def _pre_process_data(self, dataset: DataFrame) -> FitInputs:
+        X, X_sparse = _resolve_feature_matrix(self, dataset)
+        mesh = make_mesh(self.num_workers)
+        if X_sparse is not None:
+            # Sparse path: the device arrays are densified (TPUs have no
+            # sparse MXU path); the host CSR is kept on FitInputs so solvers
+            # with a dedicated sparse formulation (LogisticRegression) can
+            # stream it instead. Reference CSR ingestion: ``core.py:196-241``.
+            n_rows, n_features = X_sparse.shape
+            dtype = self._target_dtype(None)
+            Xd, maskd = shard_rows(np.asarray(X_sparse.todense(), dtype=dtype), mesh)
+        else:
+            dtype = self._target_dtype(X)
+            X = np.ascontiguousarray(X, dtype=dtype)
+            n_rows, n_features = X.shape
+            Xd, maskd = shard_rows(X, mesh)
+
+        y = w = None
+        if self._require_label():
+            label_col = self.getOrDefault("labelCol")
+            y_host = np.asarray(dataset.column(label_col), dtype=dtype)
+            n_pad = Xd.shape[0] - n_rows
+            if n_pad:
+                y_host = np.pad(y_host, (0, n_pad))
+            y = jax.device_put(y_host, row_sharding(mesh))
+        if (
+            isinstance(self, HasWeightCol)
+            and self.hasParam("weightCol")
+            and self.isDefined("weightCol")
+            and self.getOrDefault("weightCol") is not None
+            and self.getOrDefault("weightCol") in dataset
+        ):
+            w_host = np.asarray(dataset.column(self.getOrDefault("weightCol")), dtype=dtype)
+            n_pad = Xd.shape[0] - n_rows
+            if n_pad:
+                w_host = np.pad(w_host, (0, n_pad))
+            w = jax.device_put(w_host, row_sharding(mesh))
+
+        return FitInputs(
+            X=Xd,
+            mask=maskd,
+            mesh=mesh,
+            n_rows=int(n_rows),
+            n_features=int(n_features),
+            y=y,
+            weight=w,
+            X_sparse=X_sparse,
+            dtype=jnp.dtype(dtype),
+        )
+
+    # ---- fit -------------------------------------------------------------
+    def fit(self, dataset: DataFrame, params: Optional[Dict[Any, Any]] = None) -> "_TpuModel":
+        if params:
+            est = self.copy()
+            self._copy_tpu_params(est)
+            kw = {p.name if hasattr(p, "name") else p: v for p, v in params.items()}
+            est._set_params(**kw)
+            return est.fit(dataset)
+        models = self._fit_internal(dataset, None)
+        return models[0]
+
+    def fitMultiple(
+        self, dataset: DataFrame, paramMaps: Sequence[Dict[Any, Any]]
+    ) -> Iterator[Tuple[int, "_TpuModel"]]:
+        """Fit all param maps in ONE data pass (reference ``core.py:863-892``):
+        the design matrix is sharded onto the mesh once and every param set
+        reuses the resident device arrays."""
+        if self._enable_fit_multiple_in_single_pass():
+            models = self._fit_internal(dataset, list(paramMaps))
+        else:
+            models = [self.fit(dataset, pm) for pm in paramMaps]
+        return _FitMultipleIterator(models)
+
+    def _fit_internal(
+        self, dataset: DataFrame, paramMaps: Optional[List[Dict[Any, Any]]]
+    ) -> List["_TpuModel"]:
+        with _x64_ctx(np.float64 if not self._float32_inputs else np.float32):
+            return self._fit_internal_x64scoped(dataset, paramMaps)
+
+    def _fit_internal_x64scoped(
+        self, dataset: DataFrame, paramMaps: Optional[List[Dict[Any, Any]]]
+    ) -> List["_TpuModel"]:
+        inputs = self._pre_process_data(dataset)
+        fit_func = self._get_tpu_fit_func(dataset)
+        models: List[_TpuModel] = []
+        param_sets: List[Dict[str, Any]]
+        if paramMaps is None:
+            param_sets = [dict(self._tpu_params)]
+            estimators: List[_TpuEstimator] = [self]
+        else:
+            estimators = []
+            param_sets = []
+            for pm in paramMaps:
+                est = self.copy()
+                self._copy_tpu_params(est)
+                kw = {p.name if hasattr(p, "name") else p: v for p, v in pm.items()}
+                est._set_params(**kw)
+                estimators.append(est)
+                param_sets.append(dict(est._tpu_params))
+        for est, ps in zip(estimators, param_sets):
+            result = fit_func(inputs, ps)
+            model = est._create_model(result)
+            est._copyValues(model)
+            est._copy_tpu_params(model)
+            models.append(model)
+        return models
+
+    # ---- persistence -----------------------------------------------------
+    def write(self) -> "_Writer":
+        return _Writer(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def read(cls) -> "_Reader":
+        return _Reader(cls)
+
+    @classmethod
+    def load(cls, path: str) -> "_TpuEstimator":
+        return cls.read().load(path)
+
+    def _get_model_attributes(self) -> Optional[Dict[str, Any]]:
+        return None
+
+
+class _FitMultipleIterator:
+    """Thread-safe (index, model) iterator (reference ``core.py:789-831``)."""
+
+    def __init__(self, models: List["_TpuModel"]):
+        import threading
+
+        self._models = models
+        self._index = 0
+        self._lock = threading.Lock()
+
+    def __iter__(self) -> "_FitMultipleIterator":
+        return self
+
+    def __next__(self) -> Tuple[int, "_TpuModel"]:
+        with self._lock:
+            i = self._index
+            if i >= len(self._models):
+                raise StopIteration
+            self._index += 1
+        return i, self._models[i]
+
+
+class _TpuEstimatorSupervised(_TpuEstimator, HasLabelCol):
+    """Adds label handling (reference ``_CumlEstimatorSupervised``,
+    ``core.py:1039-1092``)."""
+
+    def _require_label(self) -> bool:
+        return True
+
+
+class _TpuModel(Params, _TpuParams):
+    """Abstract fitted model (reference ``_CumlModel``, ``core.py:1101-1364``)."""
+
+    # subclasses list their array attributes for persistence
+    _model_attribute_names: List[str] = []
+
+    def __init__(self, **model_attributes: Any) -> None:
+        super().__init__()
+        self._init_tpu_params()
+        self._model_attributes = model_attributes
+        self.logger = get_logger(type(self))
+
+    def _get_model_attributes(self) -> Dict[str, Any]:
+        return self._model_attributes
+
+    # ---- transform -------------------------------------------------------
+    @abstractmethod
+    def _get_tpu_transform_func(
+        self, dataset: Optional[DataFrame] = None
+    ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        """Return fn: host feature batch (n, d) -> dict of output columns.
+
+        The returned fn should wrap a jitted kernel; core handles batching
+        and column wiring (reference ``_get_cuml_transform_func``,
+        ``core.py:1137-1167``)."""
+        ...
+
+    def _out_cols(self) -> List[str]:
+        cols = []
+        if isinstance(self, HasPredictionCol):
+            cols.append(self.getOrDefault("predictionCol"))
+        return cols
+
+    def transform(self, dataset: DataFrame) -> DataFrame:
+        """Append prediction/output columns (reference ``core.py:1463-1568``).
+
+        Embarrassingly parallel: rows are processed in device-sized batches;
+        no collectives (matching the reference, which builds no communicator
+        for transform)."""
+        X = self._extract_features_for_transform(dataset)
+        with _x64_ctx(X.dtype):
+            fn = self._get_tpu_transform_func(dataset)
+            out_columns = self._apply_batched(fn, X)
+        out = dataset
+        for name, col in out_columns.items():
+            out = out.withColumn(name, col)
+        return out
+
+    def _extract_features_for_transform(self, dataset: DataFrame) -> np.ndarray:
+        X, X_sparse = _resolve_feature_matrix(self, dataset)
+        if X is None:
+            X = np.asarray(X_sparse.todense())
+        dtype = np.float32 if self._float32_inputs else X.dtype
+        return np.ascontiguousarray(X, dtype=dtype)
+
+    def _transform_batch_rows(self) -> int:
+        return 1 << 17  # 131072 rows/batch keeps HBM use bounded
+
+    def _apply_batched(
+        self,
+        fn: Callable[[np.ndarray], Dict[str, np.ndarray]],
+        X: np.ndarray,
+    ) -> Dict[str, np.ndarray]:
+        n = X.shape[0]
+        bs = self._transform_batch_rows()
+        if n <= bs:
+            return {k: np.asarray(v)[:n] for k, v in fn(X).items()}
+        chunks: Dict[str, List[np.ndarray]] = {}
+        for lo in range(0, n, bs):
+            part = fn(X[lo : lo + bs])
+            for k, v in part.items():
+                chunks.setdefault(k, []).append(np.asarray(v)[: min(bs, n - lo)])
+        return {k: np.concatenate(v, axis=0) for k, v in chunks.items()}
+
+    # ---- multi-model support (CV single-pass) ----------------------------
+    @classmethod
+    def _combine(cls, models: List["_TpuModel"]) -> "_TpuModel":
+        raise NotImplementedError(f"{cls.__name__} does not support _combine")
+
+    def _transformEvaluate(self, dataset: DataFrame, evaluator: Any) -> List[float]:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support _transformEvaluate"
+        )
+
+    # ---- persistence -----------------------------------------------------
+    def write(self) -> "_Writer":
+        return _Writer(self)
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def read(cls) -> "_Reader":
+        return _Reader(cls)
+
+    @classmethod
+    def load(cls, path: str) -> "_TpuModel":
+        return cls.read().load(path)
+
+    def cpu(self) -> "_TpuModel":
+        """The reference converts to a Spark JVM model (``feature.py:365-379``);
+        Spark-free, the model already runs on CPU via jax — return self."""
+        return self
+
+
+class _TpuModelWithPredictionCol(_TpuModel, HasPredictionCol):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Persistence (reference ``core.py:244-331``): metadata JSON + npz arrays.
+# ---------------------------------------------------------------------------
+
+
+class _Writer:
+    def __init__(self, instance: Union[_TpuEstimator, _TpuModel]):
+        self._instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "_Writer":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        inst = self._instance
+        if os.path.exists(path):
+            if self._overwrite:
+                shutil.rmtree(path)
+            else:
+                raise FileExistsError(f"Path {path} exists; use write().overwrite()")
+        os.makedirs(path)
+        params = {}
+        for p in inst.params:
+            if inst.isSet(p):
+                v = inst.getOrDefault(p)
+                params[p.name] = v if _json_ok(v) else str(v)
+        defaults = {}
+        for p in inst.params:
+            if inst.hasDefault(p):
+                v = inst._defaultParamMap[p]
+                defaults[p.name] = v if _json_ok(v) else str(v)
+        meta = {
+            "class": f"{type(inst).__module__}.{type(inst).__name__}",
+            "uid": inst.uid,
+            "paramMap": params,
+            "defaultParamMap": defaults,
+            "tpuParams": {k: v for k, v in inst._tpu_params.items() if _json_ok(v)},
+            "numWorkers": inst._num_workers,
+            "float32Inputs": inst._float32_inputs,
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        attrs = inst._get_model_attributes()
+        if attrs is not None:
+            arrays = {}
+            scalars = {}
+            for k, v in attrs.items():
+                a = np.asarray(v)
+                if a.dtype == object:
+                    scalars[k] = v
+                elif a.ndim == 0 and _json_ok(v):
+                    scalars[k] = v if not isinstance(v, np.generic) else v.item()
+                else:
+                    arrays[k] = a
+            if arrays:
+                np.savez(os.path.join(path, "model.npz"), **arrays)
+            with open(os.path.join(path, "attributes.json"), "w") as f:
+                json.dump(scalars, f, indent=2, default=str)
+
+
+class _Reader:
+    def __init__(self, cls: type):
+        self._cls = cls
+
+    def load(self, path: str) -> Any:
+        import importlib
+
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        module_name, cls_name = meta["class"].rsplit(".", 1)
+        module = importlib.import_module(module_name)
+        cls = getattr(module, cls_name)
+
+        attrs: Dict[str, Any] = {}
+        npz_path = os.path.join(path, "model.npz")
+        if os.path.exists(npz_path):
+            with np.load(npz_path, allow_pickle=False) as z:
+                attrs.update({k: z[k] for k in z.files})
+        attrs_json = os.path.join(path, "attributes.json")
+        if os.path.exists(attrs_json):
+            with open(attrs_json) as f:
+                attrs.update(json.load(f))
+
+        if issubclass(cls, _TpuModel):
+            inst = cls(**attrs)
+        else:
+            inst = cls()
+        for name, v in meta.get("paramMap", {}).items():
+            if inst.hasParam(name):
+                inst._set(**{name: v})
+        inst._tpu_params.update(meta.get("tpuParams", {}))
+        inst._num_workers = meta.get("numWorkers")
+        inst._float32_inputs = meta.get("float32Inputs", True)
+        return inst
+
+
+def _json_ok(v: Any) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False

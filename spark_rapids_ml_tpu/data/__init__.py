@@ -1,0 +1,3 @@
+from .dataframe import DataFrame, Row, kfold
+
+__all__ = ["DataFrame", "Row", "kfold"]

@@ -1,0 +1,333 @@
+"""Lightweight partitioned DataFrame — the framework's data plane.
+
+The reference runs on Spark DataFrames and ships partitions into barrier
+tasks (``/root/reference/python/src/spark_rapids_ml/core.py:615-780``). This
+framework is Spark-free and TPU-native: a ``DataFrame`` is a host-resident
+column store (numpy arrays / scipy CSR matrices) with a logical partition
+count; estimators shard its rows straight onto the device mesh with
+``jax.device_put`` + ``NamedSharding`` instead of serializing through Arrow
+batches per task.
+
+Column kinds:
+  * scalar column  -> 1-D numpy array (any dtype)
+  * vector column  -> 2-D numpy array (rows, dim)  — the analog of Spark's
+    VectorUDT / array<float> columns
+  * sparse vector  -> scipy.sparse.csr_matrix     — the analog of the
+    reference's CSR ingestion (``core.py:196-241``)
+
+Row order is meaningful and preserved by all operations (like a Spark
+DataFrame with a stable ordering, which the reference relies on for
+transform output alignment).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+try:
+    import scipy.sparse as sp
+except Exception:  # pragma: no cover
+    sp = None
+
+ColumnLike = Union[np.ndarray, "sp.csr_matrix"]
+
+
+def _is_sparse(col: Any) -> bool:
+    return sp is not None and sp.issparse(col)
+
+
+def _col_nrows(col: ColumnLike) -> int:
+    return int(col.shape[0])
+
+
+class Row(dict):
+    """Dict-like row with attribute access, like ``pyspark.sql.Row``."""
+
+    def __getattr__(self, item: str) -> Any:
+        try:
+            return self[item]
+        except KeyError as e:
+            raise AttributeError(item) from e
+
+
+class DataFrame:
+    def __init__(
+        self,
+        data: Dict[str, ColumnLike],
+        num_partitions: Optional[int] = None,
+    ):
+        if not data:
+            raise ValueError("DataFrame requires at least one column")
+        nrows = None
+        cols: Dict[str, ColumnLike] = {}
+        for name, col in data.items():
+            if _is_sparse(col):
+                col = col.tocsr()
+            else:
+                col = np.asarray(col)
+                if col.ndim not in (1, 2):
+                    raise ValueError(
+                        f"Column {name!r} must be 1-D (scalar) or 2-D (vector); got {col.ndim}-D"
+                    )
+            n = _col_nrows(col)
+            if nrows is None:
+                nrows = n
+            elif n != nrows:
+                raise ValueError(
+                    f"Column {name!r} has {n} rows; expected {nrows}"
+                )
+            cols[name] = col
+        self._data = cols
+        self._nrows = int(nrows or 0)
+        self._num_partitions = max(1, int(num_partitions or 1))
+
+    # -- basic info --------------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._data.keys())
+
+    def count(self) -> int:
+        return self._nrows
+
+    def __len__(self) -> int:
+        return self._nrows
+
+    @property
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def dtypes(self) -> List[Tuple[str, str]]:
+        out = []
+        for name, col in self._data.items():
+            if _is_sparse(col):
+                out.append((name, f"sparse_vector<{col.dtype}>[{col.shape[1]}]"))
+            elif col.ndim == 2:
+                out.append((name, f"vector<{col.dtype}>[{col.shape[1]}]"))
+            else:
+                out.append((name, str(col.dtype)))
+        return out
+
+    def column(self, name: str) -> ColumnLike:
+        if name not in self._data:
+            raise KeyError(f"No column {name!r}; have {self.columns}")
+        return self._data[name]
+
+    def __getitem__(self, name: str) -> ColumnLike:
+        return self.column(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._data
+
+    # -- projection / mutation (all return new frames) ---------------------
+    def select(self, *cols: str) -> "DataFrame":
+        names: List[str] = []
+        for c in cols:
+            if isinstance(c, (list, tuple)):
+                names.extend(c)
+            else:
+                names.append(c)
+        return DataFrame({c: self.column(c) for c in names}, self._num_partitions)
+
+    def withColumn(self, name: str, col: ColumnLike) -> "DataFrame":
+        data = dict(self._data)
+        data[name] = col
+        return DataFrame(data, self._num_partitions)
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        data = {}
+        for k, v in self._data.items():
+            data[new if k == old else k] = v
+        return DataFrame(data, self._num_partitions)
+
+    def drop(self, *cols: str) -> "DataFrame":
+        data = {k: v for k, v in self._data.items() if k not in cols}
+        return DataFrame(data, self._num_partitions)
+
+    def repartition(self, n: int) -> "DataFrame":
+        return DataFrame(dict(self._data), n)
+
+    def filter(self, mask: Union[np.ndarray, Callable[["DataFrame"], np.ndarray]]) -> "DataFrame":
+        if callable(mask):
+            mask = mask(self)
+        mask = np.asarray(mask, dtype=bool)
+        return self.take_rows(np.nonzero(mask)[0])
+
+    def take_rows(self, idx: np.ndarray) -> "DataFrame":
+        idx = np.asarray(idx)
+        data = {}
+        for k, v in self._data.items():
+            data[k] = v[idx]
+        return DataFrame(data, self._num_partitions)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        if set(self.columns) != set(other.columns):
+            raise ValueError(f"union: column mismatch {self.columns} vs {other.columns}")
+        data: Dict[str, ColumnLike] = {}
+        for k in self.columns:
+            a, b = self._data[k], other._data[k]
+            if _is_sparse(a) or _is_sparse(b):
+                data[k] = sp.vstack([sp.csr_matrix(a), sp.csr_matrix(b)]).tocsr()
+            elif a.ndim == 2:
+                data[k] = np.concatenate([a, np.asarray(b)], axis=0)
+            else:
+                data[k] = np.concatenate([a, np.asarray(b)])
+        return DataFrame(data, self._num_partitions)
+
+    def sample(self, fraction: float, seed: int = 0) -> "DataFrame":
+        rng = np.random.default_rng(seed)
+        mask = rng.random(self._nrows) < fraction
+        return self.filter(mask)
+
+    def randomSplit(self, weights: Sequence[float], seed: int = 0) -> List["DataFrame"]:
+        weights = np.asarray(weights, dtype=float)
+        weights = weights / weights.sum()
+        rng = np.random.default_rng(seed)
+        u = rng.random(self._nrows)
+        edges = np.concatenate([[0.0], np.cumsum(weights)])
+        out = []
+        for i in range(len(weights)):
+            mask = (u >= edges[i]) & (u < edges[i + 1])
+            out.append(self.filter(mask))
+        return out
+
+    def orderBy(self, col: str, ascending: bool = True) -> "DataFrame":
+        key = self.column(col)
+        if key.ndim != 1:
+            raise ValueError("orderBy requires a scalar column")
+        idx = np.argsort(key, kind="stable")
+        if not ascending:
+            idx = idx[::-1]
+        return self.take_rows(idx)
+
+    # -- partition iteration (barrier-task analog) -------------------------
+    def partition_bounds(self) -> List[Tuple[int, int]]:
+        """Row ranges of each logical partition (balanced split)."""
+        n, p = self._nrows, self._num_partitions
+        sizes = [n // p + (1 if i < n % p else 0) for i in range(p)]
+        bounds, start = [], 0
+        for s in sizes:
+            bounds.append((start, start + s))
+            start += s
+        return bounds
+
+    def iter_partitions(self) -> Iterator["DataFrame"]:
+        for lo, hi in self.partition_bounds():
+            yield self.take_rows(np.arange(lo, hi))
+
+    # -- materialization ---------------------------------------------------
+    def collect(self) -> List[Row]:
+        rows = []
+        dense = {
+            k: (v.toarray() if _is_sparse(v) else v) for k, v in self._data.items()
+        }
+        for i in range(self._nrows):
+            rows.append(Row({k: (v[i] if v.ndim == 1 else v[i, :]) for k, v in dense.items()}))
+        return rows
+
+    def take(self, n: int) -> List[Row]:
+        return self.take_rows(np.arange(min(n, self._nrows))).collect()
+
+    def first(self) -> Optional[Row]:
+        rows = self.take(1)
+        return rows[0] if rows else None
+
+    def toPandas(self) -> "Any":
+        import pandas as pd
+
+        out = {}
+        for k, v in self._data.items():
+            if _is_sparse(v):
+                out[k] = list(np.asarray(v.todense()))
+            elif v.ndim == 2:
+                out[k] = list(v)
+            else:
+                out[k] = v
+        return pd.DataFrame(out)
+
+    @staticmethod
+    def from_pandas(pdf: "Any", num_partitions: int = 1) -> "DataFrame":
+        data: Dict[str, ColumnLike] = {}
+        for k in pdf.columns:
+            col = pdf[k]
+            if len(col) and isinstance(col.iloc[0], (list, tuple, np.ndarray)):
+                data[k] = np.stack([np.asarray(v) for v in col])
+            else:
+                data[k] = col.to_numpy()
+        return DataFrame(data, num_partitions)
+
+    def cache(self) -> "DataFrame":
+        return self  # host-resident already
+
+    def unpersist(self) -> "DataFrame":
+        return self
+
+    # -- parquet I/O (pyarrow; vector columns as fixed-size lists) ---------
+    def write_parquet(self, path: str, rows_per_file: Optional[int] = None) -> None:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        n = self._nrows
+        rows_per_file = rows_per_file or max(1, (n + self._num_partitions - 1) // self._num_partitions)
+        file_idx = 0
+        for lo in range(0, n, rows_per_file):
+            hi = min(lo + rows_per_file, n)
+            arrays, names = [], []
+            for k, v in self._data.items():
+                names.append(k)
+                if _is_sparse(v):
+                    v = np.asarray(v[lo:hi].todense())
+                    arrays.append(pa.FixedSizeListArray.from_arrays(pa.array(v.ravel()), v.shape[1]))
+                elif v.ndim == 2:
+                    chunk = v[lo:hi]
+                    arrays.append(
+                        pa.FixedSizeListArray.from_arrays(pa.array(chunk.ravel()), chunk.shape[1])
+                    )
+                else:
+                    arrays.append(pa.array(v[lo:hi]))
+            table = pa.Table.from_arrays(arrays, names=names)
+            pq.write_table(table, os.path.join(path, f"part-{file_idx:05d}.parquet"))
+            file_idx += 1
+
+    @staticmethod
+    def read_parquet(path: str, num_partitions: int = 1) -> "DataFrame":
+        import pyarrow.parquet as pq
+
+        if os.path.isdir(path):
+            files = sorted(
+                os.path.join(path, f) for f in os.listdir(path) if f.endswith(".parquet")
+            )
+        else:
+            files = [path]
+        tables = [pq.read_table(f) for f in files]
+        import pyarrow as pa
+
+        table = pa.concat_tables(tables)
+        data: Dict[str, ColumnLike] = {}
+        for name in table.column_names:
+            col = table.column(name).combine_chunks()
+            if isinstance(col.type, (pa.FixedSizeListType,)):
+                dim = col.type.list_size
+                flat = col.flatten().to_numpy(zero_copy_only=False)
+                data[name] = flat.reshape(-1, dim)
+            elif pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
+                pylist = col.to_pylist()
+                data[name] = np.stack([np.asarray(v) for v in pylist])
+            else:
+                data[name] = col.to_numpy(zero_copy_only=False)
+        return DataFrame(data, num_partitions)
+
+
+def kfold(df: DataFrame, n_folds: int, seed: int = 0) -> List[Tuple[DataFrame, DataFrame]]:
+    """Random k-fold split -> list of (train, validation) pairs, the analog
+    of pyspark CrossValidator's ``_kFold``."""
+    rng = np.random.default_rng(seed)
+    fold_of = rng.integers(0, n_folds, size=df.count())
+    out = []
+    for f in range(n_folds):
+        val_mask = fold_of == f
+        out.append((df.filter(~val_mask), df.filter(val_mask)))
+    return out

@@ -1,0 +1,6 @@
+"""Drop-in module alias: ``spark_rapids_ml_tpu.feature`` ≙ reference
+``spark_rapids_ml.feature`` (``/root/reference/python/src/spark_rapids_ml/feature.py``)."""
+
+from .models.feature import PCA, PCAModel
+
+__all__ = ["PCA", "PCAModel"]

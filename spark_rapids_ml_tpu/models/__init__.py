@@ -1,0 +1,3 @@
+from .feature import PCA, PCAModel
+
+__all__ = ["PCA", "PCAModel"]

@@ -1,0 +1,9 @@
+from .linalg import mean_and_cov, masked_mean, sign_flip, standardize_moments, topk_eigh
+
+__all__ = [
+    "mean_and_cov",
+    "masked_mean",
+    "sign_flip",
+    "standardize_moments",
+    "topk_eigh",
+]

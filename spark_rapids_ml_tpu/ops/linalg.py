@@ -1,0 +1,83 @@
+"""Shared dense linear-algebra kernels (jit-friendly global math).
+
+These are the TPU-native equivalents of the reference's native CUDA kernels
+(``/root/reference/jvm/native/src/rapidsml_jni.cu``): ``dgemmCov`` (Gram /
+covariance, :109-127), ``calSVD`` (eigendecomposition of the covariance,
+:215-268) and ``signFlip`` (deterministic eigenvector sign, :35-60).
+Written as global math over row-sharded arrays: under ``jit`` XLA's SPMD
+partitioner turns the row reductions into ``psum`` over the dp axis — the
+role NCCL allreduce played for cuML.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_mean(X: jax.Array, mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(column means, valid count) under a row-validity mask."""
+    n = mask.sum()
+    s = (X * mask[:, None]).sum(axis=0)
+    return s / n, n
+
+
+def mean_and_cov(X: jax.Array, mask: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Column mean and sample covariance (n-1 normalized) with masking.
+
+    Computed as a single Gram pass: cov = (XᵀX - n·μμᵀ) / (n-1). The XᵀX
+    contraction is the MXU hot loop; rows are dp-sharded so XLA emits one
+    psum of the d×d partial Gram per device — identical communication
+    volume to the reference's cuML allreduce of cov partials.
+    """
+    mean, n = masked_mean(X, mask)
+    # Center BEFORE the Gram: the one-pass (X'X - n μμ')/(n-1) form
+    # catastrophically cancels in f32 when |μ| >> σ. The subtraction fuses
+    # into the matmul's operand read, so the extra pass is ~free on TPU.
+    Xc = (X - mean[None, :]) * mask[:, None]
+    cov = (Xc.T @ Xc) / (n - 1.0)
+    return mean, cov, n
+
+def sign_flip(vectors: jax.Array) -> jax.Array:
+    """Deterministic eigenvector sign convention: make the max-|.| entry of
+    each column positive (reference thrust kernel ``signFlip``,
+    ``rapidsml_jni.cu:35-60``; same convention as cuML / sklearn's svd_flip).
+
+    ``vectors``: (d, k) — columns are eigenvectors.
+    """
+    idx = jnp.argmax(jnp.abs(vectors), axis=0)
+    picked = vectors[idx, jnp.arange(vectors.shape[1])]
+    signs = jnp.where(picked < 0, -1.0, 1.0).astype(vectors.dtype)
+    return vectors * signs[None, :]
+
+
+def topk_eigh(cov: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Top-k eigenpairs of a symmetric matrix, descending, sign-fixed.
+
+    Returns (eigenvalues (k,), eigenvectors (d, k)). The reference does this
+    on one GPU via ``raft::linalg::eigDC`` + column/row reversal
+    (``rapidsml_jni.cu:215-268``); here it runs replicated on every chip
+    (d is small relative to HBM; replication avoids a gather).
+    """
+    evals, evecs = jnp.linalg.eigh(cov)        # ascending
+    evals = evals[::-1][:k]
+    evecs = evecs[:, ::-1][:, :k]
+    return evals, sign_flip(evecs)
+
+
+def standardize_moments(
+    X: jax.Array, mask: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(mean, std (population), n) for feature standardization.
+
+    Reference reimplements Spark's standardization with cupy partials +
+    allGather (``classification.py:989-1038``); here one masked pass with
+    XLA-inserted psum.
+    """
+    mean, n = masked_mean(X, mask)
+    # centered second pass — same f32-cancellation rationale as mean_and_cov
+    d = (X - mean[None, :]) * mask[:, None]
+    var = (d * d).sum(axis=0) / n
+    return mean, jnp.sqrt(var), n

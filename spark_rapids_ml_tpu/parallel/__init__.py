@@ -1,0 +1,23 @@
+from .mesh import (
+    DP_AXIS,
+    MP_AXIS,
+    default_device_count,
+    make_mesh,
+    pad_rows,
+    replicated,
+    row_sharding,
+    shard_rows,
+)
+from .context import TpuDistContext
+
+__all__ = [
+    "DP_AXIS",
+    "MP_AXIS",
+    "default_device_count",
+    "make_mesh",
+    "pad_rows",
+    "replicated",
+    "row_sharding",
+    "shard_rows",
+    "TpuDistContext",
+]

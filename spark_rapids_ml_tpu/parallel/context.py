@@ -1,0 +1,88 @@
+"""Distributed context — the ``CumlContext`` replacement.
+
+The reference bootstraps a NCCL/UCX communicator per barrier stage by
+allGather-ing a NCCL uid through Spark
+(``/root/reference/python/src/spark_rapids_ml/common/cuml_context.py:36-147``).
+TPU-natively the communicator is the XLA runtime itself:
+
+  * single-host: the local device mesh IS the cluster — nothing to boot.
+  * multi-host: ``jax.distributed.initialize(coordinator, nprocs, pid)``
+    plays the role of the uid allGather (out-of-band rendezvous), after
+    which ``jax.devices()`` spans all hosts and the same mesh/pjit code
+    runs unchanged over ICI/DCN.
+
+``TpuDistContext`` is a context manager mirroring the reference's lifecycle
+(enter = communicator formation, exit = teardown; ``cuml_context.py:109-166``).
+On exception it calls ``jax.distributed.shutdown`` so surviving processes
+don't hang — the analog of ``nccl.abort()`` (``cuml_context.py:155-160``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..utils.logging import get_logger
+
+logger = get_logger("TpuDistContext")
+
+
+class TpuDistContext:
+    """rank/nranks multi-process bootstrap for multi-host TPU pods.
+
+    Environment-driven (the launcher provides the rendezvous info, exactly
+    as Spark's allGather provided the NCCL uid in the reference):
+
+      TPUML_COORDINATOR  address of process 0, e.g. "10.0.0.1:8476"
+      TPUML_NUM_PROCS    total process count
+      TPUML_PROC_ID      this process's rank
+
+    With no env set, runs single-process (all local devices).
+    """
+
+    def __init__(
+        self,
+        coordinator: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+    ):
+        self.coordinator = coordinator or os.environ.get("TPUML_COORDINATOR")
+        self.num_processes = num_processes or int(os.environ.get("TPUML_NUM_PROCS", "1"))
+        self.process_id = process_id if process_id is not None else int(
+            os.environ.get("TPUML_PROC_ID", "0")
+        )
+        self._initialized_here = False
+
+    @property
+    def rank(self) -> int:
+        return self.process_id
+
+    @property
+    def nranks(self) -> int:
+        return self.num_processes
+
+    def __enter__(self) -> "TpuDistContext":
+        if self.num_processes > 1 and self.coordinator:
+            logger.info(
+                "jax.distributed.initialize(coordinator=%s, nprocs=%d, pid=%d)",
+                self.coordinator, self.num_processes, self.process_id,
+            )
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator,
+                num_processes=self.num_processes,
+                process_id=self.process_id,
+            )
+            self._initialized_here = True
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        if self._initialized_here:
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # pragma: no cover - teardown best effort
+                if exc_type is None:
+                    raise
+        if exc_type is not None:
+            logger.error("distributed stage failed: %s", exc_val)

@@ -1,0 +1,36 @@
+"""Logger factory (reference: ``/root/reference/python/src/spark_rapids_ml/utils.py:271-288``)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Any, Union
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+_configured = False
+
+
+def _ensure_configured() -> None:
+    global _configured
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        root = logging.getLogger("spark_rapids_ml_tpu")
+        if not root.handlers:
+            root.addHandler(handler)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+        _configured = True
+
+
+def get_logger(cls: Union[type, str, Any], level: int = logging.INFO) -> logging.Logger:
+    _ensure_configured()
+    if isinstance(cls, str):
+        name = cls
+    elif isinstance(cls, type):
+        name = cls.__name__
+    else:
+        name = type(cls).__name__
+    logger = logging.getLogger(f"spark_rapids_ml_tpu.{name}")
+    logger.setLevel(level)
+    return logger

@@ -1,0 +1,62 @@
+"""Test harness: simulate an 8-chip mesh on CPU.
+
+The reference tests against local-mode Spark with real GPUs
+(``/root/reference/python/tests/conftest.py:34-51``), emulating a
+multi-node-multi-GPU cluster on one box. The TPU-native equivalent is
+``--xla_force_host_platform_device_count``: 8 virtual CPU devices form a
+mesh with the same SPMD program (and collectives) a v5e-8 slice would run.
+"""
+
+import os
+
+# Must run before jax initializes its backends. Force CPU even when the
+# session environment points at a real TPU (tests simulate the mesh).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The session's TPU plugin (if any) may force its own platform list from
+# sitecustomize AFTER env vars are read; explicitly pin CPU here.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.default_backend() == "cpu"
+assert len(jax.devices()) == 8, f"expected 8 virtual devices, got {len(jax.devices())}"
+
+
+@pytest.fixture(params=[1, 2, 4])
+def n_workers(request):
+    """Parametrized worker counts, like the reference's ``gpu_number``."""
+    return request.param
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False, help="run slow tests"
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: mark test as slow")
+    config.addinivalue_line("markers", "compat: CPU-oracle equivalence test")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip_slow = pytest.mark.skip(reason="need --runslow option to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)

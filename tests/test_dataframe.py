@@ -1,0 +1,96 @@
+"""DataFrame (data plane) tests."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from spark_rapids_ml_tpu.data import DataFrame, kfold
+
+
+def _df(n=10):
+    return DataFrame(
+        {
+            "features": np.arange(n * 3, dtype=np.float32).reshape(n, 3),
+            "label": np.arange(n, dtype=np.float32),
+        },
+        num_partitions=2,
+    )
+
+
+def test_basic_shape():
+    df = _df()
+    assert df.count() == 10
+    assert set(df.columns) == {"features", "label"}
+    assert df.column("features").shape == (10, 3)
+
+
+def test_mismatched_rows_raises():
+    with pytest.raises(ValueError, match="rows"):
+        DataFrame({"a": np.zeros(3), "b": np.zeros(4)})
+
+
+def test_select_withcolumn_drop():
+    df = _df()
+    assert df.select("label").columns == ["label"]
+    df2 = df.withColumn("pred", np.zeros(10))
+    assert "pred" in df2.columns and "pred" not in df.columns
+    assert df2.drop("pred").columns == df.columns
+
+
+def test_filter_and_order():
+    df = _df()
+    sub = df.filter(df["label"] > 5)
+    assert sub.count() == 4
+    rev = df.orderBy("label", ascending=False)
+    assert rev["label"][0] == 9
+
+
+def test_union_and_split():
+    df = _df()
+    both = df.union(df)
+    assert both.count() == 20
+    a, b = df.randomSplit([0.5, 0.5], seed=1)
+    assert a.count() + b.count() == 10
+
+
+def test_partitions():
+    df = _df().repartition(3)
+    parts = list(df.iter_partitions())
+    assert len(parts) == 3
+    assert sum(p.count() for p in parts) == 10
+
+
+def test_collect_rows():
+    rows = _df(3).collect()
+    assert rows[1].label == 1.0
+    assert rows[1]["features"].shape == (3,)
+
+
+def test_pandas_roundtrip():
+    df = _df(5)
+    pdf = df.toPandas()
+    back = DataFrame.from_pandas(pdf)
+    np.testing.assert_array_equal(back["features"], df["features"])
+
+
+def test_parquet_roundtrip(tmp_path):
+    df = _df(7)
+    df.write_parquet(str(tmp_path / "d"), rows_per_file=3)
+    back = DataFrame.read_parquet(str(tmp_path / "d"))
+    np.testing.assert_allclose(back["features"], df["features"])
+    np.testing.assert_allclose(back["label"], df["label"])
+
+
+def test_sparse_column():
+    m = sp.random(10, 5, density=0.3, format="csr", random_state=0)
+    df = DataFrame({"features": m, "label": np.zeros(10)})
+    assert df.count() == 10
+    sub = df.take_rows(np.arange(4))
+    assert sub["features"].shape == (4, 5)
+
+
+def test_kfold():
+    folds = kfold(_df(20), 4, seed=0)
+    assert len(folds) == 4
+    for train, val in folds:
+        assert train.count() + val.count() == 20

@@ -1,0 +1,153 @@
+"""Param-system semantics tests — the framework-level contract the reference
+pins with ``test_common_estimator.py``
+(``/root/reference/python/tests/test_common_estimator.py:320-397``):
+mapped params sync into backend params, ``""``-mapped are ignored with a
+warning, ``None``-mapped raise, unknown params raise.
+"""
+
+import pytest
+
+from spark_rapids_ml_tpu.core import _TpuEstimator, _TpuModel, FitInputs
+from spark_rapids_ml_tpu.params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    Param,
+    Params,
+    TypeConverters,
+    _mk,
+)
+
+
+class _DummyParams(HasFeaturesCol, HasFeaturesCols):
+    alpha = _mk("alpha", "mapped param", TypeConverters.toFloat)
+    beta = _mk("beta", "ignored param", TypeConverters.toInt)
+    gamma = _mk("gamma", "unsupported param", TypeConverters.toString)
+
+    def __init__(self):
+        super().__init__()
+        self._setDefault(alpha=1.0, beta=2, gamma="three")
+
+
+class DummyEstimator(_TpuEstimator, _DummyParams):
+    def __init__(self, **kwargs):
+        _TpuEstimator.__init__(self)
+        _DummyParams.__init__(self)
+        self._set_params(**kwargs)
+
+    @classmethod
+    def _param_mapping(cls):
+        return {"alpha": "backend_alpha", "beta": "", "gamma": None}
+
+    @classmethod
+    def _get_tpu_params_default(cls):
+        return {"backend_alpha": 1.0, "extra": 7}
+
+    def _get_tpu_fit_func(self, dataset):
+        def _fit(inputs: FitInputs, params):
+            return {"n": inputs.n_rows}
+
+        return _fit
+
+    def _create_model(self, result):
+        return DummyModel(**result)
+
+
+class DummyModel(_TpuModel, _DummyParams):
+    def __init__(self, **attrs):
+        _TpuModel.__init__(self, **attrs)
+        _DummyParams.__init__(self)
+
+    def _get_tpu_transform_func(self, dataset=None):
+        def _fn(X):
+            return {"out": X.sum(axis=1)}
+
+        return _fn
+
+
+def test_mapped_param_syncs_to_backend():
+    est = DummyEstimator(alpha=5.0)
+    assert est.getOrDefault("alpha") == 5.0
+    assert est.tpu_params["backend_alpha"] == 5.0
+
+
+def test_ignored_param_warns_but_accepts():
+    est = DummyEstimator(beta=9)
+    assert est.getOrDefault("beta") == 9
+    assert "beta" not in est.tpu_params
+
+
+def test_unsupported_param_raises():
+    with pytest.raises(ValueError, match="not supported"):
+        DummyEstimator(gamma="x")
+
+
+def test_unknown_param_raises():
+    with pytest.raises(ValueError, match="Unknown param"):
+        DummyEstimator(nonexistent=1)
+
+
+def test_direct_backend_param():
+    est = DummyEstimator(extra=11)
+    assert est.tpu_params["extra"] == 11
+
+
+def test_num_workers_and_float32_kwargs():
+    est = DummyEstimator(num_workers=2, float32_inputs=False)
+    assert est.num_workers == 2
+    assert est._float32_inputs is False
+    with pytest.raises(ValueError):
+        est.num_workers = 0
+
+
+def test_copy_keeps_params_independent():
+    est = DummyEstimator(alpha=3.0)
+    cp = est.copy()
+    est._copy_tpu_params(cp)
+    cp._set_params(alpha=4.0)
+    assert est.getOrDefault("alpha") == 3.0
+    assert cp.getOrDefault("alpha") == 4.0
+    assert est.tpu_params["backend_alpha"] == 3.0
+    assert cp.tpu_params["backend_alpha"] == 4.0
+
+
+def test_params_introspection():
+    est = DummyEstimator()
+    assert est.hasParam("alpha")
+    assert not est.hasParam("zzz")
+    names = [p.name for p in est.params]
+    assert "alpha" in names and "featuresCol" in names
+    assert "alpha" in est.explainParams()
+
+
+def test_input_columns_resolution():
+    est = DummyEstimator()
+    est.setFeaturesCol("feat")
+    col, cols = est._get_input_columns()
+    assert col == "feat" and cols is None
+    est2 = DummyEstimator()
+    est2.setFeaturesCol(["a", "b"])
+    col, cols = est2._get_input_columns()
+    assert col is None and cols == ["a", "b"]
+
+
+def test_set_inputcol_not_shadowed_by_featurescol_default():
+    """Explicitly set inputCol must win over featuresCol's default
+    (reference params.py:342-375: 'order is significant'). PCA has both
+    inputCol and featuresCol (with default 'features')."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu.data import DataFrame
+    from spark_rapids_ml_tpu.feature import PCA
+
+    X = np.random.default_rng(0).normal(size=(20, 4))
+    df = DataFrame({"embeddings": X})
+    model = PCA(k=2).setInputCol("embeddings").fit(df)
+    assert model.components_.shape == (2, 4)
+
+
+def test_copy_does_not_share_backend_params():
+    e1 = DummyEstimator(alpha=1.0)
+    e2 = e1.copy()
+    assert e1._tpu_params is not e2._tpu_params
+    e2._set_params(alpha=9.0)
+    assert e1.tpu_params["backend_alpha"] == 1.0

@@ -1,0 +1,133 @@
+"""PCA tests: toy exactness, sklearn-oracle compat (replaces the reference's
+pyspark.ml compat tests, ``/root/reference/python/tests/test_pca.py``),
+multi-worker invariance, persistence round-trip.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.feature import PCA, PCAModel
+
+
+def _make_df(n=200, d=8, seed=0, num_partitions=2):
+    rng = np.random.default_rng(seed)
+    # low-rank + noise so PCs are well separated
+    basis = rng.normal(size=(3, d))
+    X = rng.normal(size=(n, 3)) @ basis + 0.01 * rng.normal(size=(n, d))
+    return DataFrame({"features": X.astype(np.float64)}, num_partitions), X
+
+
+def test_pca_toy_exact():
+    # variance entirely along x-axis
+    X = np.array([[1.0, 0.0], [2.0, 0.0], [3.0, 0.0], [4.0, 0.0]])
+    df = DataFrame({"features": X})
+    model = PCA(k=1).setInputCol("features").fit(df)
+    comp = model.components_
+    np.testing.assert_allclose(np.abs(comp), [[1.0, 0.0]], atol=1e-6)
+    assert model.explained_variance_ratio_[0] > 0.999
+
+
+@pytest.mark.compat
+def test_pca_matches_sklearn(n_workers):
+    df, X = _make_df()
+    k = 3
+    model = PCA(k=k, num_workers=n_workers, float32_inputs=False).setInputCol(
+        "features"
+    ).fit(df)
+
+    from sklearn.decomposition import PCA as SkPCA
+
+    sk = SkPCA(n_components=k).fit(X)
+    # same sign convention (max-|.| positive) on sklearn side for comparison
+    sk_comp = sk.components_
+    for i in range(k):
+        j = np.argmax(np.abs(sk_comp[i]))
+        if sk_comp[i, j] < 0:
+            sk_comp[i] = -sk_comp[i]
+    np.testing.assert_allclose(model.components_, sk_comp, atol=1e-4)
+    np.testing.assert_allclose(
+        model.explained_variance_ratio_, sk.explained_variance_ratio_, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        model.singular_values_, sk.singular_values_, rtol=1e-5
+    )
+    np.testing.assert_allclose(model.mean_, X.mean(axis=0), atol=1e-6)
+
+
+def test_pca_transform_spark_semantics():
+    """Spark PCA transform = X @ pc (no centering); reference compensates
+    cuML's centering at ``feature.py:426-439``."""
+    df, X = _make_df(n=50)
+    model = PCA(k=2, float32_inputs=False).setInputCol("features").fit(df)
+    out = model.transform(df)
+    expected = X @ model.pc
+    np.testing.assert_allclose(out["pca_features"], expected, atol=1e-5)
+
+
+def test_pca_multicol_input():
+    rng = np.random.default_rng(1)
+    cols = {f"c{i}": rng.normal(size=100) for i in range(4)}
+    df = DataFrame(cols)
+    model = PCA(k=2).setFeaturesCol([f"c{i}" for i in range(4)]).fit(df)
+    assert model.components_.shape == (2, 4)
+    out = model.transform(df)
+    assert out["pca_features"].shape == (100, 2)
+
+
+def test_pca_worker_count_invariance():
+    df, _ = _make_df()
+    m1 = PCA(k=2, num_workers=1, float32_inputs=False).setInputCol("features").fit(df)
+    m4 = PCA(k=2, num_workers=4, float32_inputs=False).setInputCol("features").fit(df)
+    np.testing.assert_allclose(m1.components_, m4.components_, atol=1e-6)
+
+
+def test_pca_padding_correctness():
+    # row counts not divisible by the mesh size exercise the mask path
+    for n in (97, 101, 103):
+        df, X = _make_df(n=n)
+        model = PCA(k=2, num_workers=4, float32_inputs=False).setInputCol(
+            "features"
+        ).fit(df)
+        np.testing.assert_allclose(model.mean_, X.mean(axis=0), atol=1e-8)
+
+
+def test_pca_persistence_roundtrip(tmp_path):
+    df, _ = _make_df()
+    model = PCA(k=2).setInputCol("features").fit(df)
+    path = str(tmp_path / "pca_model")
+    model.write().overwrite().save(path)
+    loaded = PCAModel.load(path)
+    np.testing.assert_allclose(loaded.components_, model.components_)
+    np.testing.assert_allclose(loaded.mean_, model.mean_)
+    assert loaded.getOrDefault("k") == 2
+    out = loaded.transform(df)
+    assert out["pca_features"].shape[1] == 2
+
+
+def test_pca_estimator_persistence(tmp_path):
+    est = PCA(k=3).setInputCol("features")
+    path = str(tmp_path / "pca_est")
+    est.save(path)
+    loaded = PCA.load(path)
+    assert loaded.getOrDefault("k") == 3
+    assert loaded.getOrDefault("inputCol") == "features"
+
+
+def test_pca_k_too_large():
+    df, _ = _make_df(d=4)
+    with pytest.raises(ValueError, match="must be <="):
+        PCA(k=10).setInputCol("features").fit(df)
+
+
+def test_pca_f32_large_mean_offset():
+    """f32 covariance must not catastrophically cancel when |mean| >> std —
+    guards the centered-Gram formulation in ops/linalg.py."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2000, 6)) + 1e4
+    df = DataFrame({"features": X.astype(np.float32)})
+    model = PCA(k=2).setInputCol("features").fit(df)  # default f32 path
+    ev = model.explained_variance_
+    # true per-feature variance is ~1.0; eigenvalues must be O(1), not garbage
+    assert np.all(ev > 0.1) and np.all(ev < 10.0)
+    assert 0.0 <= model.explained_variance_ratio_[0] <= 1.0
