@@ -1,0 +1,6 @@
+"""Drop-in module alias: ``spark_rapids_ml_tpu.clustering`` ≙ reference
+``spark_rapids_ml.clustering`` (``/root/reference/python/src/spark_rapids_ml/clustering.py``)."""
+
+from .models.clustering import KMeans, KMeansModel
+
+__all__ = ["KMeans", "KMeansModel"]

@@ -111,6 +111,7 @@ class FitInputs:
     weight: Optional[jax.Array] = None
     X_sparse: Optional[Any] = None   # host scipy CSR when the sparse path is on
     dtype: Any = jnp.float32
+    csize: int = 1                   # per-device row-chunk size (scan kernels)
 
 
 # fit function: (inputs, params_dict) -> dict of named numpy arrays/scalars
@@ -151,6 +152,11 @@ class _TpuEstimator(Params, _TpuParams):
             return np.float64
         return np.float32
 
+    def _chunk_rows(self, n_rows: int, n_dp: int) -> int:
+        """Per-device scan chunk size; subclasses with chunked-scan kernels
+        override (rows are padded so each shard is a multiple of this)."""
+        return 1
+
     def _pre_process_data(self, dataset: DataFrame) -> FitInputs:
         X, X_sparse = _resolve_feature_matrix(self, dataset)
         mesh = make_mesh(self.num_workers)
@@ -161,12 +167,16 @@ class _TpuEstimator(Params, _TpuParams):
             # stream it instead. Reference CSR ingestion: ``core.py:196-241``.
             n_rows, n_features = X_sparse.shape
             dtype = self._target_dtype(None)
-            Xd, maskd = shard_rows(np.asarray(X_sparse.todense(), dtype=dtype), mesh)
+            csize = self._chunk_rows(n_rows, mesh.shape["dp"])
+            Xd, maskd = shard_rows(
+                np.asarray(X_sparse.todense(), dtype=dtype), mesh, csize
+            )
         else:
             dtype = self._target_dtype(X)
             X = np.ascontiguousarray(X, dtype=dtype)
             n_rows, n_features = X.shape
-            Xd, maskd = shard_rows(X, mesh)
+            csize = self._chunk_rows(n_rows, mesh.shape["dp"])
+            Xd, maskd = shard_rows(X, mesh, csize)
 
         y = w = None
         if self._require_label():
@@ -199,6 +209,7 @@ class _TpuEstimator(Params, _TpuParams):
             weight=w,
             X_sparse=X_sparse,
             dtype=jnp.dtype(dtype),
+            csize=csize,
         )
 
     # ---- fit -------------------------------------------------------------

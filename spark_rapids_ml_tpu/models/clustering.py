@@ -1,0 +1,308 @@
+"""KMeans — Spark ML drop-in, TPU-native fit/transform.
+
+Reference: ``/root/reference/python/src/spark_rapids_ml/clustering.py``
+(491 LoC; cuML ``KMeansMG`` fit at :340-378, per-batch predict transform at
+:458-491). Param mapping parity (reference ``clustering.py:59-82``):
+``initMode→init``, ``k→n_clusters``, ``maxIter→max_iter``,
+``seed→random_state``, ``tol→tol``; ``distanceMeasure`` only supports
+"euclidean"; ``weightCol`` unsupported.
+
+TPU-native fit (vs cuML's NCCL-allreduce Lloyd):
+  * k-means|| seeding (Spark's default initMode): device passes compute
+    min-distances and candidate weights (``ops/kmeans_kernels.py``), the
+    small weighted k-means++ reduction of ~l·steps candidates runs on host;
+  * Lloyd loop = ONE compiled ``lax.while_loop`` with per-device chunked
+    scans and ``psum`` of (sums, counts, cost) over the dp mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import FitFunc, FitInputs, _TpuEstimator, _TpuModel
+from ..data.dataframe import DataFrame
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasMaxIter,
+    HasPredictionCol,
+    HasSeed,
+    HasTol,
+    HasWeightCol,
+    TypeConverters,
+    _mk,
+)
+from ..ops.kmeans_kernels import count_closest, kmeans_lloyd, min_sq_dists
+
+_CHUNK = 4096
+
+
+class KMeansClass:
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        return {
+            "k": "n_clusters",
+            "initMode": "init",
+            "initSteps": "init_steps",
+            "maxIter": "max_iter",
+            "seed": "random_state",
+            "tol": "tol",
+            "distanceMeasure": "distance_measure",
+            "weightCol": None,
+            "solver": "",
+            "maxBlockSizeInMB": "",
+        }
+
+    @classmethod
+    def _param_value_mapping(cls) -> Dict[str, Callable[[Any], Any]]:
+        def _check_init(v: str) -> str:
+            if v not in ("k-means||", "random"):
+                raise ValueError(f"Unsupported initMode: {v!r}")
+            return v
+
+        def _check_dist(v: str) -> str:
+            if v != "euclidean":
+                raise ValueError(
+                    f"Only euclidean distance is supported, got {v!r}"
+                )
+            return v
+
+        return {"init": _check_init, "distance_measure": _check_dist}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "n_clusters": 2,
+            "init": "k-means||",
+            "init_steps": 2,
+            "max_iter": 20,
+            "tol": 1e-4,
+            "random_state": 1,
+            "oversampling_factor": 2.0,
+            "distance_measure": "euclidean",
+        }
+
+
+class _KMeansParams(
+    HasFeaturesCol, HasFeaturesCols, HasPredictionCol, HasMaxIter, HasTol, HasSeed, HasWeightCol
+):
+    k = _mk("k", "number of clusters", TypeConverters.toInt)
+    initMode = _mk("initMode", "init algorithm: k-means|| or random", TypeConverters.toString)
+    initSteps = _mk("initSteps", "k-means|| init steps", TypeConverters.toInt)
+    distanceMeasure = _mk("distanceMeasure", "distance measure", TypeConverters.toString)
+    # accepted-but-ignored Spark >= 3.4 params (""-mapped)
+    solver = _mk("solver", "optimization solver (ignored)", TypeConverters.toString)
+    maxBlockSizeInMB = _mk(
+        "maxBlockSizeInMB", "block size hint (ignored)", TypeConverters.toFloat
+    )
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(
+            k=2, initMode="k-means||", initSteps=2, maxIter=20, tol=1e-4,
+            distanceMeasure="euclidean",
+        )
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def getInitMode(self) -> str:
+        return self.getOrDefault("initMode")
+
+
+class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
+    """``KMeans(k=1000, maxIter=30).fit(df)`` — drop-in for
+    ``pyspark.ml.clustering.KMeans``."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        _TpuEstimator.__init__(self)
+        _KMeansParams.__init__(self)
+        self._set_params(**kwargs)
+
+    def setK(self, value: int) -> "KMeans":
+        self._set_params(k=value)
+        return self
+
+    def setMaxIter(self, value: int) -> "KMeans":
+        self._set_params(maxIter=value)
+        return self
+
+    def setTol(self, value: float) -> "KMeans":
+        self._set_params(tol=value)
+        return self
+
+    def setSeed(self, value: int) -> "KMeans":
+        self._set_params(seed=value)
+        return self
+
+    def setInitMode(self, value: str) -> "KMeans":
+        self._set_params(initMode=value)
+        return self
+
+    def _chunk_rows(self, n_rows: int, n_dp: int) -> int:
+        per_dev = -(-n_rows // n_dp)
+        return min(_CHUNK, max(1, per_dev))
+
+    # ---- seeding ---------------------------------------------------------
+    def _init_random(self, inputs: FitInputs, k: int, rng: np.random.Generator) -> np.ndarray:
+        idx = rng.choice(inputs.n_rows, size=k, replace=inputs.n_rows < k)
+        return np.asarray(inputs.X[np.sort(idx)])
+
+    def _init_scalable_kmeanspp(
+        self,
+        inputs: FitInputs,
+        k: int,
+        steps: int,
+        oversample: float,
+        rng: np.random.Generator,
+    ) -> np.ndarray:
+        """k-means|| (Bahmani et al.): sample ~l=oversample*k candidates per
+        round with prob l*d²/Σd², then reduce candidates to k centers with
+        weighted k-means++ on host (the candidate set is tiny)."""
+        l = max(int(oversample * k), 1)
+        first = rng.integers(0, inputs.n_rows)
+        cands = np.asarray(inputs.X[first : first + 1])
+        min_d2 = np.asarray(
+            min_sq_dists(
+                inputs.X, inputs.mask, jnp.asarray(cands), mesh=inputs.mesh, csize=inputs.csize
+            )
+        )
+        for _ in range(steps):
+            total = float(min_d2.sum())
+            if total <= 0:
+                break
+            probs = np.minimum(l * min_d2 / total, 1.0)
+            sel = np.nonzero(rng.random(len(probs)) < probs)[0]
+            sel = sel[sel < inputs.n_rows]
+            if len(sel) == 0:
+                continue
+            new = np.asarray(inputs.X[sel])
+            cands = np.concatenate([cands, new], axis=0)
+            nd = np.asarray(
+                min_sq_dists(
+                    inputs.X, inputs.mask, jnp.asarray(new), mesh=inputs.mesh, csize=inputs.csize
+                )
+            )
+            min_d2 = np.minimum(min_d2, nd)
+        if len(cands) <= k:
+            # not enough candidates — top up with random rows
+            extra = self._init_random(inputs, k - len(cands), rng) if len(cands) < k else None
+            return np.concatenate([cands, extra], axis=0) if extra is not None else cands
+        weights = np.asarray(
+            count_closest(
+                inputs.X, inputs.mask, jnp.asarray(cands), mesh=inputs.mesh, csize=inputs.csize
+            )
+        ).astype(np.float64)
+        return _weighted_kmeanspp(cands.astype(np.float64), weights, k, rng)
+
+    # ---- fit -------------------------------------------------------------
+    def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
+        def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            k = int(params["n_clusters"])
+            if k > inputs.n_rows:
+                raise ValueError(f"k={k} must be <= number of rows {inputs.n_rows}")
+            rng = np.random.default_rng(int(params.get("random_state") or 0))
+            if params.get("init") == "random":
+                centers0 = self._init_random(inputs, k, rng)
+            else:
+                centers0 = self._init_scalable_kmeanspp(
+                    inputs, k, int(params.get("init_steps", 2)),
+                    float(params.get("oversampling_factor", 2.0)), rng,
+                )
+            centers0 = jnp.asarray(centers0, dtype=inputs.dtype)
+            centers, cost, n_iter = kmeans_lloyd(
+                inputs.X,
+                inputs.mask,
+                centers0,
+                mesh=inputs.mesh,
+                csize=inputs.csize,
+                max_iter=int(params["max_iter"]),
+                tol=float(params["tol"]),
+            )
+            return {
+                "cluster_centers": np.asarray(centers),
+                "training_cost": float(cost),
+                "n_iter": int(n_iter),
+            }
+
+        return _fit
+
+    def _create_model(self, result: Dict[str, Any]) -> "KMeansModel":
+        return KMeansModel(**result)
+
+
+class KMeansModel(KMeansClass, _TpuModel, _KMeansParams):
+    def __init__(self, **attrs: Any) -> None:
+        _TpuModel.__init__(self, **attrs)
+        _KMeansParams.__init__(self)
+
+    @property
+    def cluster_centers_(self) -> np.ndarray:
+        return np.asarray(self._model_attributes["cluster_centers"])
+
+    def clusterCenters(self) -> List[np.ndarray]:
+        return list(self.cluster_centers_)
+
+    @property
+    def trainingCost(self) -> float:
+        """Sum of squared distances to closest center (Spark
+        ``summary.trainingCost`` analog)."""
+        return float(self._model_attributes["training_cost"])
+
+    @property
+    def numIter(self) -> int:
+        return int(self._model_attributes["n_iter"])
+
+    def predict(self, vector: Any) -> int:
+        """Single-vector predict (the reference falls back to the CPU model,
+        ``clustering.py:445-449``; here the same kernel serves both)."""
+        fn = self._get_tpu_transform_func()
+        out = fn(np.asarray(vector, dtype=np.float32).reshape(1, -1))
+        return int(out[self.getOrDefault("predictionCol")][0])
+
+    def _get_tpu_transform_func(
+        self, dataset: Optional[DataFrame] = None
+    ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        from ..ops.kmeans_kernels import pairwise_sq_dists
+
+        pred_col = self.getOrDefault("predictionCol")
+        centers_np = self.cluster_centers_
+
+        @jax.jit
+        def _assign(Xb: jax.Array) -> jax.Array:
+            centers = jnp.asarray(centers_np, dtype=Xb.dtype)
+            d2 = pairwise_sq_dists(Xb, centers)
+            return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            return {pred_col: np.asarray(_assign(jnp.asarray(Xb)))}
+
+        return _fn
+
+
+def _weighted_kmeanspp(
+    cands: np.ndarray, weights: np.ndarray, k: int, rng: np.random.Generator
+) -> np.ndarray:
+    """Weighted k-means++ over the (small) k-means|| candidate set."""
+    m = len(cands)
+    w = np.maximum(weights, 1e-12)
+    centers = np.empty((k, cands.shape[1]), dtype=cands.dtype)
+    first = rng.choice(m, p=w / w.sum())
+    centers[0] = cands[first]
+    min_d2 = ((cands - centers[0]) ** 2).sum(axis=1)
+    for i in range(1, k):
+        p = w * min_d2
+        tot = p.sum()
+        if tot <= 0:
+            # all remaining candidates coincide with chosen centers
+            centers[i:] = cands[rng.choice(m, size=k - i)]
+            break
+        centers[i] = cands[rng.choice(m, p=p / tot)]
+        d2 = ((cands - centers[i]) ** 2).sum(axis=1)
+        min_d2 = np.minimum(min_d2, d2)
+    return centers

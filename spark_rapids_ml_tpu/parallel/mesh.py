@@ -87,15 +87,19 @@ def pad_rows(
     return x, mask
 
 
-def shard_rows(x: np.ndarray, mesh: Mesh) -> Tuple[jax.Array, jax.Array]:
+def shard_rows(
+    x: np.ndarray, mesh: Mesh, row_multiple: int = 1
+) -> Tuple[jax.Array, jax.Array]:
     """Pad + device_put a host array row-sharded over the dp axis.
 
     This is the data-plane replacement for the reference's Arrow-batch →
     cupy ingestion inside the barrier task (``core.py:717-741``).
+    ``row_multiple`` > 1 additionally aligns each device's shard to that
+    multiple (for kernels that scan rows in fixed-size chunks).
     Returns (sharded_x, sharded_mask).
     """
     n_dp = mesh.shape[DP_AXIS]
-    xp, mask = pad_rows(np.asarray(x), n_dp)
+    xp, mask = pad_rows(np.asarray(x), n_dp * row_multiple)
     sh = row_sharding(mesh)
     xd = jax.device_put(xp, sh)
     md = jax.device_put(mask, sh)

@@ -1,0 +1,112 @@
+"""KMeans tests: toy exactness, sklearn compat oracle, worker invariance,
+padding, persistence (reference test model:
+``/root/reference/python/tests/test_kmeans.py``)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.clustering import KMeans, KMeansModel
+from spark_rapids_ml_tpu.data import DataFrame
+
+
+def _blobs(n=400, d=5, k=3, seed=0, spread=0.05):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)) * 5
+    labels = rng.integers(0, k, size=n)
+    X = centers[labels] + spread * rng.normal(size=(n, d))
+    return X, centers, labels
+
+
+def test_kmeans_toy_two_clusters():
+    X = np.array([[0.0, 0.0], [0.1, 0.0], [10.0, 10.0], [10.1, 10.0]])
+    df = DataFrame({"features": X})
+    model = KMeans(k=2, seed=1).setFeaturesCol("features").fit(df)
+    centers = np.sort(model.cluster_centers_, axis=0)
+    np.testing.assert_allclose(centers, [[0.05, 0.0], [10.05, 10.0]], atol=1e-6)
+    out = model.transform(df)
+    pred = out["prediction"]
+    assert pred[0] == pred[1] and pred[2] == pred[3] and pred[0] != pred[2]
+
+
+@pytest.mark.compat
+def test_kmeans_matches_sklearn_inertia(n_workers):
+    X, _, _ = _blobs(n=500, d=8, k=4)
+    df = DataFrame({"features": X.astype(np.float32)})
+    model = (
+        KMeans(k=4, maxIter=50, tol=1e-8, seed=5, num_workers=n_workers)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    from sklearn.cluster import KMeans as SkKMeans
+
+    sk = SkKMeans(n_clusters=4, n_init=10, random_state=0).fit(X)
+    # well-separated blobs: same optimum up to permutation -> compare inertia
+    assert model.trainingCost <= sk.inertia_ * 1.01 + 1e-6
+    # and each learned center matches some sklearn center
+    for c in model.cluster_centers_:
+        dmin = np.min(((sk.cluster_centers_ - c) ** 2).sum(axis=1))
+        assert dmin < 1e-2
+
+
+def test_kmeans_random_init_mode():
+    X, _, _ = _blobs(n=300, d=4, k=3, seed=2)
+    df = DataFrame({"features": X})
+    model = KMeans(k=3, initMode="random", maxIter=100, seed=7).setFeaturesCol(
+        "features"
+    ).fit(df)
+    assert model.cluster_centers_.shape == (3, 4)
+    assert model.numIter >= 1
+
+
+def test_kmeans_padding_and_workers():
+    X, _, _ = _blobs(n=257, d=3, k=2, seed=3)
+    df = DataFrame({"features": X})
+    m = KMeans(k=2, seed=1, num_workers=8, maxIter=50).setFeaturesCol("features").fit(df)
+    # padded zero-rows must not attract centroids: both centers near blob means
+    for c in m.cluster_centers_:
+        assert np.linalg.norm(c) > 0.5
+
+
+def test_kmeans_unsupported_params():
+    with pytest.raises(ValueError, match="not supported"):
+        KMeans(weightCol="w")
+    with pytest.raises(ValueError, match="euclidean"):
+        KMeans(distanceMeasure="cosine")
+    with pytest.raises(ValueError, match="Unsupported initMode"):
+        KMeans(initMode="bogus")
+
+
+def test_kmeans_k_greater_than_rows():
+    X = np.zeros((3, 2))
+    df = DataFrame({"features": X})
+    with pytest.raises(ValueError, match="must be <= number of rows"):
+        KMeans(k=10).setFeaturesCol("features").fit(df)
+
+
+def test_kmeans_persistence(tmp_path):
+    X, _, _ = _blobs(n=100, d=4, k=3)
+    df = DataFrame({"features": X})
+    model = KMeans(k=3, seed=0).setFeaturesCol("features").fit(df)
+    path = str(tmp_path / "km")
+    model.write().overwrite().save(path)
+    loaded = KMeansModel.load(path)
+    np.testing.assert_allclose(loaded.cluster_centers_, model.cluster_centers_)
+    out = loaded.transform(df)
+    assert "prediction" in out.columns
+
+
+def test_kmeans_single_predict():
+    X, centers, _ = _blobs(n=200, d=4, k=3, seed=1)
+    df = DataFrame({"features": X})
+    model = KMeans(k=3, seed=0, maxIter=50).setFeaturesCol("features").fit(df)
+    p = model.predict(X[0])
+    out = model.transform(df)
+    assert p == out["prediction"][0]
+
+
+def test_kmeans_ignored_spark34_params():
+    """solver / maxBlockSizeInMB are accepted-but-ignored (""-mapped), like
+    the reference on Spark >= 3.4."""
+    est = KMeans(k=2, solver="auto", maxBlockSizeInMB=1.0)
+    assert est.getOrDefault("solver") == "auto"
+    assert "solver" not in est.tpu_params
