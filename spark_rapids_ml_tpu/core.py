@@ -189,11 +189,15 @@ class _TpuEstimator(Params, _TpuParams):
         if (
             isinstance(self, HasWeightCol)
             and self.hasParam("weightCol")
-            and self.isDefined("weightCol")
+            and self.isSet("weightCol")
             and self.getOrDefault("weightCol") is not None
-            and self.getOrDefault("weightCol") in dataset
         ):
-            w_host = np.asarray(dataset.column(self.getOrDefault("weightCol")), dtype=dtype)
+            wcol = self.getOrDefault("weightCol")
+            if wcol not in dataset:
+                raise ValueError(
+                    f"weightCol {wcol!r} not found in dataset columns {dataset.columns}"
+                )
+            w_host = np.asarray(dataset.column(wcol), dtype=dtype)
             n_pad = Xd.shape[0] - n_rows
             if n_pad:
                 w_host = np.pad(w_host, (0, n_pad))

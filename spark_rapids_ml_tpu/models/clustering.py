@@ -145,8 +145,11 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
         return self
 
     def _chunk_rows(self, n_rows: int, n_dp: int) -> int:
-        per_dev = -(-n_rows // n_dp)
-        return min(_CHUNK, max(1, per_dev))
+        # smallest chunk <= _CHUNK that divides the shard into equal pieces:
+        # bounds padding to < n_chunks rows/device (vs up to csize-1)
+        per_dev = max(1, -(-n_rows // n_dp))
+        n_chunks = -(-per_dev // _CHUNK)
+        return -(-per_dev // n_chunks)
 
     # ---- seeding ---------------------------------------------------------
     def _init_random(self, inputs: FitInputs, k: int, rng: np.random.Generator) -> np.ndarray:
@@ -260,9 +263,11 @@ class KMeansModel(KMeansClass, _TpuModel, _KMeansParams):
 
     def predict(self, vector: Any) -> int:
         """Single-vector predict (the reference falls back to the CPU model,
-        ``clustering.py:445-449``; here the same kernel serves both)."""
-        fn = self._get_tpu_transform_func()
-        out = fn(np.asarray(vector, dtype=np.float32).reshape(1, -1))
+        ``clustering.py:445-449``; here the same kernel serves both).
+        The jitted assigner is cached — rebuilding it per call would retrace."""
+        if not hasattr(self, "_predict_fn"):
+            self._predict_fn = self._get_tpu_transform_func()
+        out = self._predict_fn(np.asarray(vector, dtype=np.float32).reshape(1, -1))
         return int(out[self.getOrDefault("predictionCol")][0])
 
     def _get_tpu_transform_func(

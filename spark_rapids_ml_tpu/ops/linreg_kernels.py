@@ -1,0 +1,158 @@
+"""Linear-regression device kernels: sufficient statistics + solvers.
+
+TPU-native replacement for the reference's three cuML solver classes
+(``/root/reference/python/src/spark_rapids_ml/regression.py:502-559``:
+``LinearRegressionMG`` eig for OLS, ``RidgeMG`` with the alpha×M Spark
+scaling, ``CDMG`` coordinate descent for elasticnet).
+
+Design: ONE distributed pass over the dp-sharded design matrix computes the
+weighted centered sufficient statistics (Gram d×d, X'y, y'y, moments) —
+XLA inserts the psum. Every solver then works on the replicated d×d
+system: OLS/ridge are a Cholesky solve, elasticnet is FISTA on the
+quadratic form — O(d²) per iteration with NO further data passes or
+collectives (cuML's CD re-reads the data every iteration; for the
+reference's d≈3000 benchmark shape this is strictly less communication).
+
+Spark objective parity: 1/(2n)·Σ wᵢ(yᵢ - x·β - b)² + λ[(1-α)/2‖β‖₂² + α‖β‖₁]
+with the penalty applied to standardized coefficients when
+``standardization=True`` (Spark MLlib semantics the reference matches via
+the alpha×M rescale, ``regression.py:530-537``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("fit_intercept",))
+def linreg_suffstats(
+    X: jax.Array,
+    mask: jax.Array,
+    y: jax.Array,
+    row_w: Optional[jax.Array] = None,
+    *,
+    fit_intercept: bool = True,
+) -> Dict[str, jax.Array]:
+    """Weighted centered sufficient statistics in one pass.
+
+    Returns dict with n (Σw), mean_x, mean_y, G=(Xc√w)'(Xc√w), Xy, yy, var.
+    Centering before the Gram keeps f32 stable (see ops/linalg.py).
+    """
+    w = mask if row_w is None else mask * row_w
+    n = w.sum()
+    if fit_intercept:
+        mean_x = (X * w[:, None]).sum(axis=0) / n
+        mean_y = (y * w).sum() / n
+    else:
+        mean_x = jnp.zeros((X.shape[1],), X.dtype)
+        mean_y = jnp.asarray(0.0, X.dtype)
+    sw = jnp.sqrt(w)
+    Xc = (X - mean_x[None, :]) * sw[:, None]
+    yc = (y - mean_y) * sw
+    G = Xc.T @ Xc
+    Xy = Xc.T @ yc
+    yy = (yc * yc).sum()
+    var = jnp.diagonal(G) / n
+    return {
+        "n": n, "mean_x": mean_x, "mean_y": mean_y,
+        "G": G, "Xy": Xy, "yy": yy, "var": var,
+    }
+
+
+def _to_standardized(stats: Dict[str, jax.Array], standardization: bool):
+    """Scale the quadratic system into standardized-coefficient space."""
+    std = jnp.sqrt(jnp.maximum(stats["var"], 0.0))
+    safe = jnp.where(std > 0, std, 1.0)
+    if standardization:
+        G = stats["G"] / jnp.outer(safe, safe)
+        Xy = stats["Xy"] / safe
+    else:
+        G = stats["G"]
+        Xy = stats["Xy"]
+    return G, Xy, std, safe
+
+
+@functools.partial(jax.jit, static_argnames=("standardization",))
+def solve_normal(
+    stats: Dict[str, jax.Array], l2: jax.Array, *, standardization: bool
+) -> Tuple[jax.Array, jax.Array]:
+    """Closed-form OLS/ridge: (G/n + λ₂I) β = Xy/n, Cholesky on device.
+
+    Replaces the reference's eig solver path (``regression.py:502-559``).
+    Returns (coefficients in original scale, intercept).
+    """
+    n = stats["n"]
+    G, Xy, std, safe = _to_standardized(stats, standardization)
+    d = G.shape[0]
+    A = G / n + l2 * jnp.eye(d, dtype=G.dtype)
+    # dtype-scaled jitter keeps Cholesky PD for exactly-collinear features
+    # (a fixed 1e-10 underflows in f32 against a unit-scale diagonal)
+    jitter = jnp.finfo(G.dtype).eps * jnp.trace(A)
+    A = A + jitter * jnp.eye(d, dtype=G.dtype)
+    beta = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(A), Xy / n)
+    if standardization:
+        beta = jnp.where(std > 0, beta / safe, 0.0)
+    intercept = stats["mean_y"] - stats["mean_x"] @ beta
+    return beta, intercept
+
+
+@functools.partial(jax.jit, static_argnames=("standardization", "max_iter"))
+def solve_elasticnet(
+    stats: Dict[str, jax.Array],
+    l1: jax.Array,
+    l2: jax.Array,
+    *,
+    standardization: bool,
+    max_iter: int,
+    tol: float,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """FISTA on the precomputed quadratic form — replaces cuML ``CDMG``.
+
+    grad f(β) = (Gβ - Xy)/n + λ₂β ; prox = soft-threshold at λ₁/L.
+    L is bounded by power iteration on G/n. Entirely replicated d×d math:
+    zero data passes, zero collectives per iteration.
+    Returns (coefficients, intercept, n_iter).
+    """
+    n = stats["n"]
+    G, Xy, std, safe = _to_standardized(stats, standardization)
+    d = G.shape[0]
+    Gn = G / n
+    b = Xy / n
+
+    # Lipschitz constant: power iteration for λmax(G/n)
+    def power_body(_, v):
+        v = Gn @ v
+        return v / jnp.maximum(jnp.linalg.norm(v), 1e-30)
+
+    v0 = jnp.ones((d,), G.dtype) / jnp.sqrt(d)
+    v = lax.fori_loop(0, 16, power_body, v0)
+    L = (v @ (Gn @ v)) / jnp.maximum(v @ v, 1e-30) + l2 + 1e-12
+
+    def soft(x, t):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
+
+    def cond(state):
+        _, _, _, it, delta = state
+        return jnp.logical_and(it < max_iter, delta > tol)
+
+    def body(state):
+        beta, z, t, it, _ = state
+        grad = Gn @ z - b + l2 * z
+        beta_new = soft(z - grad / L, l1 / L)
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        z_new = beta_new + ((t - 1.0) / t_new) * (beta_new - beta)
+        delta = jnp.abs(beta_new - beta).max()
+        return (beta_new, z_new, t_new, it + 1, delta)
+
+    beta0 = jnp.zeros((d,), G.dtype)
+    state = (beta0, beta0, jnp.asarray(1.0, G.dtype), jnp.asarray(0), jnp.asarray(jnp.inf, G.dtype))
+    beta, _, _, it, _ = lax.while_loop(cond, body, state)
+    if standardization:
+        beta = jnp.where(std > 0, beta / safe, 0.0)
+    intercept = stats["mean_y"] - stats["mean_x"] @ beta
+    return beta, intercept, it

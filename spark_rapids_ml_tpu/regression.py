@@ -1,0 +1,6 @@
+"""Drop-in module alias: ``spark_rapids_ml_tpu.regression`` ≙ reference
+``spark_rapids_ml.regression`` (``/root/reference/python/src/spark_rapids_ml/regression.py``)."""
+
+from .models.regression import LinearRegression, LinearRegressionModel
+
+__all__ = ["LinearRegression", "LinearRegressionModel"]

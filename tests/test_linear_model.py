@@ -1,0 +1,205 @@
+"""LinearRegression tests with sklearn oracles (reference test model:
+``/root/reference/python/tests/test_linear_model.py``)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.regression import LinearRegression, LinearRegressionModel
+
+
+def _make_reg(n=500, d=10, noise=0.1, seed=0, weighted=False):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)) * rng.uniform(0.5, 3.0, size=d)
+    w_true = rng.normal(size=d)
+    y = X @ w_true + 2.5 + noise * rng.normal(size=n)
+    cols = {"features": X, "label": y}
+    if weighted:
+        cols["w"] = rng.uniform(0.1, 2.0, size=n)
+    return DataFrame(cols), X, y, w_true
+
+
+def test_ols_matches_sklearn(n_workers):
+    df, X, y, _ = _make_reg()
+    model = (
+        LinearRegression(num_workers=n_workers, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    from sklearn.linear_model import LinearRegression as SkLR
+
+    sk = SkLR().fit(X, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_, atol=1e-6)
+    np.testing.assert_allclose(model.intercept, sk.intercept_, atol=1e-6)
+
+
+def test_ols_no_intercept():
+    df, X, y, _ = _make_reg()
+    model = (
+        LinearRegression(fitIntercept=False, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    from sklearn.linear_model import LinearRegression as SkLR
+
+    sk = SkLR(fit_intercept=False).fit(X, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_, atol=1e-6)
+    assert model.intercept == pytest.approx(0.0, abs=1e-9)
+
+
+def test_ridge_matches_sklearn_unstandardized():
+    """standardization=False ridge: objective 1/(2n)||r||^2 + l2/2 ||w||^2
+    == sklearn Ridge(alpha = l2 * n)."""
+    df, X, y, _ = _make_reg(n=300, d=8)
+    reg = 0.5
+    model = (
+        LinearRegression(regParam=reg, standardization=False, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    from sklearn.linear_model import Ridge
+
+    sk = Ridge(alpha=reg * len(y)).fit(X, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_, atol=1e-5)
+    np.testing.assert_allclose(model.intercept, sk.intercept_, atol=1e-5)
+
+
+def test_ridge_standardized_explicit_oracle():
+    """standardization=True penalizes standardized coefficients: solve the
+    equivalent problem explicitly with numpy and compare."""
+    df, X, y, _ = _make_reg(n=400, d=6, seed=3)
+    lam = 0.2
+    model = (
+        LinearRegression(regParam=lam, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    n = len(y)
+    mu, sd = X.mean(0), X.std(0)
+    Xs = (X - mu) / sd
+    yc = y - y.mean()
+    A = Xs.T @ Xs / n + lam * np.eye(X.shape[1])
+    beta_s = np.linalg.solve(A, Xs.T @ yc / n)
+    beta = beta_s / sd
+    np.testing.assert_allclose(model.coefficients, beta, atol=1e-5)
+    np.testing.assert_allclose(model.intercept, y.mean() - mu @ beta, atol=1e-5)
+
+
+def test_elasticnet_matches_sklearn():
+    df, X, y, _ = _make_reg(n=400, d=12, seed=4)
+    alpha, l1r = 0.1, 0.5
+    model = (
+        LinearRegression(
+            regParam=alpha, elasticNetParam=l1r, standardization=False,
+            maxIter=2000, tol=1e-10, float32_inputs=False,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    from sklearn.linear_model import ElasticNet
+
+    sk = ElasticNet(alpha=alpha, l1_ratio=l1r, max_iter=50000, tol=1e-12).fit(X, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_, atol=2e-4)
+    np.testing.assert_allclose(model.intercept, sk.intercept_, atol=2e-4)
+
+
+def test_lasso_sparsity():
+    df, X, y, _ = _make_reg(n=300, d=20, seed=5)
+    model = (
+        LinearRegression(
+            regParam=0.5, elasticNetParam=1.0, standardization=False,
+            maxIter=2000, tol=1e-10, float32_inputs=False,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    assert (np.abs(model.coefficients) < 1e-10).any()  # l1 zeroes some coefs
+
+
+def test_weighted_ols():
+    df, X, y, _ = _make_reg(weighted=True, seed=6)
+    w = df["w"]
+    model = (
+        LinearRegression(weightCol="w", float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    from sklearn.linear_model import LinearRegression as SkLR
+
+    sk = SkLR().fit(X, y, sample_weight=w)
+    np.testing.assert_allclose(model.coefficients, sk.coef_, atol=1e-6)
+    np.testing.assert_allclose(model.intercept, sk.intercept_, atol=1e-6)
+
+
+def test_transform_and_predict():
+    df, X, y, _ = _make_reg(n=100, d=5)
+    model = LinearRegression(float32_inputs=False).setFeaturesCol("features").fit(df)
+    out = model.transform(df)
+    expected = X @ model.coefficients + model.intercept
+    np.testing.assert_allclose(out["prediction"], expected, atol=1e-8)
+    assert model.predict(X[0]) == pytest.approx(expected[0])
+
+
+def test_fit_multiple_single_pass():
+    df, X, y, _ = _make_reg(n=200, d=6)
+    est = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = [
+        {est.getParam("regParam"): 0.0},
+        {est.getParam("regParam"): 0.1},
+        {est.getParam("regParam"): 1.0},
+    ]
+    models = dict(est.fitMultiple(df, grid))
+    assert len(models) == 3
+    # heavier regularization shrinks coefficients
+    n0 = np.linalg.norm(models[0].coefficients)
+    n2 = np.linalg.norm(models[2].coefficients)
+    assert n2 < n0
+
+
+def test_combine_multi_model():
+    df, X, y, _ = _make_reg(n=150, d=4)
+    est = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    m1 = est.fit(df)
+    m2 = LinearRegression(regParam=1.0, float32_inputs=False).setFeaturesCol("features").fit(df)
+    combined = LinearRegressionModel._combine([m1, m2])
+    assert combined.coefficients.shape == (2, 4)
+    out = combined.transform(df)
+    assert out["prediction"].shape == (150, 2)
+    np.testing.assert_allclose(
+        out["prediction"][:, 0], X @ m1.coefficients + m1.intercept, atol=1e-6
+    )
+
+
+def test_unsupported_loss():
+    with pytest.raises(ValueError, match="squaredError"):
+        LinearRegression(loss="huber")
+
+
+def test_persistence(tmp_path):
+    df, X, y, _ = _make_reg(n=80, d=3)
+    model = LinearRegression(regParam=0.1).setFeaturesCol("features").fit(df)
+    path = str(tmp_path / "lr")
+    model.write().overwrite().save(path)
+    loaded = LinearRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.coefficients, model.coefficients)
+    assert loaded.intercept == pytest.approx(model.intercept)
+
+
+def test_collinear_features_f32_no_nan():
+    """Duplicated feature column in default f32: jitter must keep Cholesky
+    finite (least-norm-ish split, not NaN)."""
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(200, 4))
+    X = np.concatenate([X, X[:, :1]], axis=1)  # exact duplicate column
+    y = X[:, 0] + 0.1 * rng.normal(size=200)
+    df = DataFrame({"features": X.astype(np.float32), "label": y.astype(np.float32)})
+    model = LinearRegression().setFeaturesCol("features").fit(df)
+    assert np.isfinite(model.coefficients).all()
+    pred = X @ model.coefficients + model.intercept
+    assert np.sqrt(((pred - y) ** 2).mean()) < 0.2
+
+
+def test_missing_weight_col_raises():
+    df, X, y, _ = _make_reg(n=50, d=3)
+    with pytest.raises(ValueError, match="weightCol"):
+        LinearRegression(weightCol="nope").setFeaturesCol("features").fit(df)
