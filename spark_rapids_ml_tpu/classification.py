@@ -1,0 +1,6 @@
+"""Drop-in module alias: ``spark_rapids_ml_tpu.classification`` ≙ reference
+``spark_rapids_ml.classification`` (``/root/reference/python/src/spark_rapids_ml/classification.py``)."""
+
+from .models.classification import LogisticRegression, LogisticRegressionModel
+
+__all__ = ["LogisticRegression", "LogisticRegressionModel"]

@@ -68,9 +68,9 @@ class DataFrame:
                 col = col.tocsr()
             else:
                 col = np.asarray(col)
-                if col.ndim not in (1, 2):
+                if col.ndim == 0:
                     raise ValueError(
-                        f"Column {name!r} must be 1-D (scalar) or 2-D (vector); got {col.ndim}-D"
+                        f"Column {name!r} must be at least 1-D (scalar column); got a 0-D value"
                     )
             n = _col_nrows(col)
             if nrows is None:
@@ -106,6 +106,9 @@ class DataFrame:
                 out.append((name, f"sparse_vector<{col.dtype}>[{col.shape[1]}]"))
             elif col.ndim == 2:
                 out.append((name, f"vector<{col.dtype}>[{col.shape[1]}]"))
+            elif col.ndim > 2:
+                dims = "x".join(str(s) for s in col.shape[1:])
+                out.append((name, f"tensor<{col.dtype}>[{dims}]"))
             else:
                 out.append((name, str(col.dtype)))
         return out
@@ -170,10 +173,8 @@ class DataFrame:
             a, b = self._data[k], other._data[k]
             if _is_sparse(a) or _is_sparse(b):
                 data[k] = sp.vstack([sp.csr_matrix(a), sp.csr_matrix(b)]).tocsr()
-            elif a.ndim == 2:
-                data[k] = np.concatenate([a, np.asarray(b)], axis=0)
             else:
-                data[k] = np.concatenate([a, np.asarray(b)])
+                data[k] = np.concatenate([a, np.asarray(b)], axis=0)
         return DataFrame(data, self._num_partitions)
 
     def sample(self, fraction: float, seed: int = 0) -> "DataFrame":

@@ -1,0 +1,449 @@
+"""Classification estimators — Spark ML drop-ins, TPU-native fit/transform.
+
+LogisticRegression reference:
+``/root/reference/python/src/spark_rapids_ml/classification.py:651-1562``.
+Param-mapping parity (reference ``classification.py:652-671``):
+``maxIter→max_iter``, ``regParam→C`` (value-mapped 1/x), ``elasticNetParam→
+l1_ratio``, ``tol→tol``, ``fitIntercept→fit_intercept``, ``standardization→
+standardization``, ``family`` accepted-but-ignored (auto-detected),
+``threshold``/``thresholds``/``weightCol``/``aggregationDepth``/coefficient
+bounds unsupported (raise on set).
+
+Fit is the jitted distributed L-BFGS/OWL-QN in ``ops/logreg_kernels.py``.
+``fitMultiple`` reuses the device-resident design matrix for every param map
+(reference single-pass loop ``classification.py:1137-1154``); ``_combine``
+stacks models for single-pass CV evaluation (``classification.py:1504-1519``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import FitFunc, FitInputs, _TpuEstimatorSupervised, _TpuModel
+from ..data.dataframe import DataFrame
+from ..params import (
+    HasElasticNetParam,
+    HasEnableSparseDataOptim,
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasFitIntercept,
+    HasLabelCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasProbabilityCol,
+    HasRawPredictionCol,
+    HasRegParam,
+    HasStandardization,
+    HasTol,
+    TypeConverters,
+    _mk,
+)
+from ..ops.logreg_kernels import logreg_fit, logreg_predict
+
+
+class LogisticRegressionClass:
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        # reference ``classification.py:652-671``
+        return {
+            "maxIter": "max_iter",
+            "regParam": "C",
+            "elasticNetParam": "l1_ratio",
+            "tol": "tol",
+            "fitIntercept": "fit_intercept",
+            "threshold": None,
+            "thresholds": None,
+            "standardization": "standardization",
+            "weightCol": None,
+            "aggregationDepth": None,
+            "family": "",
+            "lowerBoundsOnCoefficients": None,
+            "upperBoundsOnCoefficients": None,
+            "lowerBoundsOnIntercepts": None,
+            "upperBoundsOnIntercepts": None,
+            "maxBlockSizeInMB": None,
+        }
+
+    @classmethod
+    def _param_value_mapping(cls) -> Dict[str, Callable[[Any], Any]]:
+        # Spark regParam -> inverse-regularization C (reference
+        # ``classification.py:676-678``); C=0 encodes "no penalty"
+        def _c(x: float) -> float:
+            if x > 0.0:
+                return 1.0 / x
+            if x == 0.0:
+                return 0.0
+            raise ValueError(f"regParam must be >= 0, got {x}")
+
+        return {"C": _c}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "fit_intercept": True,
+            "standardization": True,
+            "C": 0.0,
+            "l1_ratio": 0.0,
+            "max_iter": 100,
+            "tol": 1e-6,
+        }
+
+
+class _LogisticRegressionParams(
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    HasPredictionCol,
+    HasProbabilityCol,
+    HasRawPredictionCol,
+    HasMaxIter,
+    HasTol,
+    HasRegParam,
+    HasElasticNetParam,
+    HasFitIntercept,
+    HasStandardization,
+    HasEnableSparseDataOptim,
+):
+    family = _mk(
+        "family", "binomial | multinomial | auto (auto-detected)", TypeConverters.toString
+    )
+    threshold = _mk("threshold", "binary prediction threshold (unsupported)", TypeConverters.toFloat)
+    thresholds = _mk("thresholds", "per-class thresholds (unsupported)", TypeConverters.toListFloat)
+    weightCol = _mk("weightCol", "weight column (unsupported)", TypeConverters.toString)
+    aggregationDepth = _mk("aggregationDepth", "tree aggregate depth (unsupported)", TypeConverters.toInt)
+    maxBlockSizeInMB = _mk("maxBlockSizeInMB", "block size hint (unsupported)", TypeConverters.toFloat)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(
+            maxIter=100,
+            regParam=0.0,
+            elasticNetParam=0.0,
+            tol=1e-6,
+            family="auto",
+        )
+
+    def getFamily(self) -> str:
+        return self.getOrDefault("family")
+
+
+class LogisticRegression(
+    LogisticRegressionClass, _TpuEstimatorSupervised, _LogisticRegressionParams
+):
+    """``LogisticRegression(regParam=0.01).fit(df)`` — drop-in for
+    ``pyspark.ml.classification.LogisticRegression``. Labels must be
+    non-negative integers (reference ``classification.py:1103-1112``)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        _TpuEstimatorSupervised.__init__(self)
+        _LogisticRegressionParams.__init__(self)
+        self._set_params(**kwargs)
+
+    def setMaxIter(self, value: int) -> "LogisticRegression":
+        self._set_params(maxIter=value)
+        return self
+
+    def setRegParam(self, value: float) -> "LogisticRegression":
+        self._set_params(regParam=value)
+        return self
+
+    def setElasticNetParam(self, value: float) -> "LogisticRegression":
+        self._set_params(elasticNetParam=value)
+        return self
+
+    def setTol(self, value: float) -> "LogisticRegression":
+        self._set_params(tol=value)
+        return self
+
+    def setFitIntercept(self, value: bool) -> "LogisticRegression":
+        self._set_params(fitIntercept=value)
+        return self
+
+    def setStandardization(self, value: bool) -> "LogisticRegression":
+        self._set_params(standardization=value)
+        return self
+
+    def setProbabilityCol(self, value: str) -> "LogisticRegression":
+        self._set_params(probabilityCol=value)
+        return self
+
+    def setRawPredictionCol(self, value: str) -> "LogisticRegression":
+        self._set_params(rawPredictionCol=value)
+        return self
+
+    def _enable_fit_multiple_in_single_pass(self) -> bool:
+        return True
+
+    def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
+        # label analysis happens on host, once, outside jit (the class count
+        # is a static shape parameter of the compiled program)
+        label_col = self.getOrDefault("labelCol")
+        y_host = np.asarray(dataset.column(label_col))
+        if y_host.size == 0:
+            raise ValueError("Labels column is empty")
+        if np.any(y_host < 0) or np.any(y_host != np.floor(y_host)):
+            raise RuntimeError(
+                f"Labels MUST be non-negative integers, got values outside that set"
+            )
+        # Spark semantics: numClasses = max(label) + 1
+        n_classes = max(int(y_host.max()) + 1, 2)
+        uniques = np.unique(y_host)
+
+        def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            multinomial = n_classes > 2
+            fit_intercept = bool(params["fit_intercept"])
+
+            if len(uniques) == 1 and n_classes == 2:
+                # single-label degenerate case (reference
+                # ``classification.py:1119-1132``): all-0 or all-1 labels
+                class_val = float(uniques[0])
+                if fit_intercept:
+                    return {
+                        "coef_": np.zeros((1, inputs.n_features)),
+                        "intercept_": np.asarray(
+                            [np.inf if class_val == 1.0 else -np.inf]
+                        ),
+                        "n_classes": n_classes,
+                        "multinomial": False,
+                        "n_iter": 0,
+                        "objective": 0.0,
+                    }
+
+            c = float(params["C"])
+            reg = 1.0 / c if c > 0.0 else 0.0
+            l1_ratio = float(params["l1_ratio"])
+            out = logreg_fit(
+                inputs.X,
+                inputs.mask,
+                inputs.y,
+                n_classes=n_classes,
+                multinomial=multinomial,
+                fit_intercept=fit_intercept,
+                standardization=bool(params["standardization"]),
+                l1=jnp.asarray(reg * l1_ratio, inputs.dtype),
+                l2=jnp.asarray(reg * (1.0 - l1_ratio), inputs.dtype),
+                use_l1=reg * l1_ratio > 0.0,
+                max_iter=int(params["max_iter"]),
+                tol=jnp.asarray(float(params["tol"]), inputs.dtype),
+            )
+            return {
+                "coef_": np.asarray(out["coef_"]),
+                "intercept_": np.asarray(out["intercept_"]),
+                "n_classes": n_classes,
+                "multinomial": multinomial,
+                "n_iter": int(out["n_iter"]),
+                "objective": float(out["objective"]),
+            }
+
+        return _fit
+
+    def _create_model(self, result: Dict[str, Any]) -> "LogisticRegressionModel":
+        return LogisticRegressionModel(**result)
+
+
+class LogisticRegressionModel(
+    LogisticRegressionClass, _TpuModel, _LogisticRegressionParams
+):
+    def __init__(self, **attrs: Any) -> None:
+        _TpuModel.__init__(self, **attrs)
+        _LogisticRegressionParams.__init__(self)
+
+    # -- attribute surface (Spark model API) -------------------------------
+    @property
+    def coef_(self) -> np.ndarray:
+        return np.asarray(self._model_attributes["coef_"])
+
+    @property
+    def intercept_(self) -> np.ndarray:
+        return np.asarray(self._model_attributes["intercept_"])
+
+    @property
+    def numClasses(self) -> int:
+        return int(self._model_attributes["n_classes"])
+
+    @property
+    def numFeatures(self) -> int:
+        return int(self.coef_.shape[-1])
+
+    @property
+    def _multinomial(self) -> bool:
+        v = self._model_attributes["multinomial"]
+        if isinstance(v, str):  # JSON round-trip through persistence
+            return v == "True"
+        return bool(np.asarray(v))
+
+    @property
+    def coefficients(self) -> np.ndarray:
+        """Binary-model coefficient vector (Spark raises for multinomial)."""
+        if self._multinomial:
+            raise RuntimeError(
+                "Multinomial model: use coefficientMatrix instead of coefficients"
+            )
+        return self.coef_.reshape(-1)
+
+    @property
+    def intercept(self) -> float:
+        if self._multinomial:
+            raise RuntimeError(
+                "Multinomial model: use interceptVector instead of intercept"
+            )
+        return float(self.intercept_.reshape(-1)[0])
+
+    @property
+    def coefficientMatrix(self) -> np.ndarray:
+        return np.atleast_2d(self.coef_)
+
+    @property
+    def interceptVector(self) -> np.ndarray:
+        return np.atleast_1d(self.intercept_)
+
+    @property
+    def classes_(self) -> np.ndarray:
+        return np.arange(self.numClasses, dtype=np.float64)
+
+    @property
+    def hasSummary(self) -> bool:
+        return False
+
+    @property
+    def n_iter_(self) -> int:
+        return int(self._model_attributes.get("n_iter", 0))
+
+    # -- single-row helpers (Spark model API) ------------------------------
+    def _scores(self, x: np.ndarray) -> np.ndarray:
+        coef = np.atleast_2d(self.coef_).astype(np.float64)
+        b = np.atleast_1d(self.intercept_).astype(np.float64)
+        return x @ coef.T + b
+
+    def predict(self, vector: Any) -> float:
+        x = np.asarray(vector, dtype=np.float64).ravel()
+        s = self._scores(x[None, :])[0]
+        if self._multinomial:
+            return float(np.argmax(s))
+        return float(s[0] > 0)
+
+    def predictRaw(self, vector: Any) -> np.ndarray:
+        x = np.asarray(vector, dtype=np.float64).ravel()
+        s = self._scores(x[None, :])[0]
+        if self._multinomial:
+            return s
+        return np.asarray([-s[0], s[0]])
+
+    def predictProbability(self, vector: Any) -> np.ndarray:
+        raw = self.predictRaw(vector)
+        if self._multinomial:
+            e = np.exp(raw - raw.max())
+            return e / e.sum()
+        p1 = 1.0 / (1.0 + np.exp(-raw[1]))
+        return np.asarray([1.0 - p1, p1])
+
+    # -- transform ---------------------------------------------------------
+    def _out_cols(self) -> List[str]:
+        return [
+            self.getOrDefault("predictionCol"),
+            self.getOrDefault("probabilityCol"),
+            self.getOrDefault("rawPredictionCol"),
+        ]
+
+    def _get_tpu_transform_func(
+        self, dataset: Optional[DataFrame] = None
+    ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        pred_col = self.getOrDefault("predictionCol")
+        prob_col = self.getOrDefault("probabilityCol")
+        raw_col = self.getOrDefault("rawPredictionCol")
+        coef_np = np.atleast_2d(self.coef_)
+        b_np = np.atleast_1d(self.intercept_)
+        multinomial = self._multinomial
+        if not np.all(np.isfinite(b_np)):
+            # degenerate single-label model: ±inf intercept would poison the
+            # matmul; emit constant predictions directly
+            const_pred = 1.0 if b_np.reshape(-1)[0] > 0 else 0.0
+
+            def _const(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+                n = Xb.shape[0]
+                pred = np.full((n,), const_pred, dtype=Xb.dtype)
+                prob = np.zeros((n, 2), dtype=Xb.dtype)
+                prob[:, int(const_pred)] = 1.0
+                raw = np.zeros((n, 2), dtype=Xb.dtype)
+                raw[:, int(const_pred)] = np.inf
+                raw[:, 1 - int(const_pred)] = -np.inf
+                return {pred_col: pred, prob_col: prob, raw_col: raw}
+
+            return _const
+
+        if self._is_multi_model:
+            # CV-combined model: coef_ (m, K, d) -> per-model outputs
+            # prediction (n, m), probability (n, m, K), raw (n, m, K)
+            coef3 = self.coef_
+
+            @jax.jit
+            def _predict_multi(Xb: jax.Array):
+                C = jnp.asarray(coef3, dtype=Xb.dtype)      # (m, K, d)
+                B = jnp.asarray(np.atleast_2d(b_np), dtype=Xb.dtype)  # (m, K)
+                scores = jnp.einsum("nd,mkd->nmk", Xb, C) + B[None, :, :]
+                if multinomial:
+                    raw = scores
+                    prob = jax.nn.softmax(scores, axis=2)
+                    pred = jnp.argmax(scores, axis=2).astype(Xb.dtype)
+                else:
+                    z = scores[..., 0]
+                    raw = jnp.stack([-z, z], axis=2)
+                    p1 = jax.nn.sigmoid(z)
+                    prob = jnp.stack([1.0 - p1, p1], axis=2)
+                    pred = (p1 > 0.5).astype(Xb.dtype)
+                return pred, prob, raw
+
+            def _fn_multi(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+                pred, prob, raw = _predict_multi(jnp.asarray(Xb))
+                return {
+                    pred_col: np.asarray(pred),
+                    prob_col: np.asarray(prob),
+                    raw_col: np.asarray(raw),
+                }
+
+            return _fn_multi
+
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            pred, prob, raw = logreg_predict(
+                jnp.asarray(Xb),
+                jnp.asarray(coef_np, dtype=Xb.dtype),
+                jnp.asarray(b_np, dtype=Xb.dtype),
+                multinomial=multinomial,
+            )
+            return {
+                pred_col: np.asarray(pred),
+                prob_col: np.asarray(prob),
+                raw_col: np.asarray(raw),
+            }
+
+        return _fn
+
+    # -- multi-model support (CV single-pass) ------------------------------
+    @classmethod
+    def _combine(
+        cls, models: List["LogisticRegressionModel"]
+    ) -> "LogisticRegressionModel":
+        """Stack models for single-pass multi-model evaluation (reference
+        ``classification.py:1504-1519``)."""
+        coefs = np.stack([np.atleast_2d(m.coef_) for m in models])  # (m, K, d)
+        intercepts = np.stack([np.atleast_1d(m.intercept_) for m in models])
+        combined = cls(
+            coef_=coefs,
+            intercept_=intercepts,
+            n_classes=models[0].numClasses,
+            multinomial=models[0]._multinomial,
+            n_iter=0,
+            objective=0.0,
+        )
+        models[0]._copyValues(combined)
+        models[0]._copy_tpu_params(combined)
+        return combined
+
+    @property
+    def _is_multi_model(self) -> bool:
+        return self.coef_.ndim == 3

@@ -265,10 +265,12 @@ class KMeansModel(KMeansClass, _TpuModel, _KMeansParams):
         """Single-vector predict (the reference falls back to the CPU model,
         ``clustering.py:445-449``; here the same kernel serves both).
         The jitted assigner is cached — rebuilding it per call would retrace."""
-        if not hasattr(self, "_predict_fn"):
+        pred_col = self.getOrDefault("predictionCol")
+        if getattr(self, "_predict_fn_col", None) != pred_col:
             self._predict_fn = self._get_tpu_transform_func()
+            self._predict_fn_col = pred_col
         out = self._predict_fn(np.asarray(vector, dtype=np.float32).reshape(1, -1))
-        return int(out[self.getOrDefault("predictionCol")][0])
+        return int(out[pred_col][0])
 
     def _get_tpu_transform_func(
         self, dataset: Optional[DataFrame] = None

@@ -45,8 +45,9 @@ def linreg_suffstats(
     """
     w = mask if row_w is None else mask * row_w
     n = w.sum()
+    mean_all = (X * w[:, None]).sum(axis=0) / n  # true feature means
     if fit_intercept:
-        mean_x = (X * w[:, None]).sum(axis=0) / n
+        mean_x = mean_all
         mean_y = (y * w).sum() / n
     else:
         mean_x = jnp.zeros((X.shape[1],), X.dtype)
@@ -57,7 +58,12 @@ def linreg_suffstats(
     G = Xc.T @ Xc
     Xy = Xc.T @ yc
     yy = (yc * yc).sum()
+    # penalty scaling always uses the true (centered) variance, even when
+    # fit_intercept=False leaves G uncentered: diag(G)/n is then E[x²], so
+    # subtract mean² (matches Spark's std-based penalty semantics)
     var = jnp.diagonal(G) / n
+    if not fit_intercept:
+        var = var - mean_all * mean_all
     return {
         "n": n, "mean_x": mean_x, "mean_y": mean_y,
         "G": G, "Xy": Xy, "yy": yy, "var": var,
@@ -124,14 +130,22 @@ def solve_elasticnet(
     Gn = G / n
     b = Xy / n
 
-    # Lipschitz constant: power iteration for λmax(G/n)
+    # Lipschitz constant: power iteration for λmax(G/n). The start vector is
+    # pseudo-random (an all-ones start can be exactly orthogonal to the top
+    # eigenvector, e.g. for a feature and its negation, collapsing L to ~0
+    # and blowing up the first FISTA step); if the iterate still collapses,
+    # fall back to the Frobenius norm, a guaranteed λmax upper bound.
     def power_body(_, v):
         v = Gn @ v
         return v / jnp.maximum(jnp.linalg.norm(v), 1e-30)
 
-    v0 = jnp.ones((d,), G.dtype) / jnp.sqrt(d)
+    v0 = jnp.cos(jnp.arange(d, dtype=G.dtype) * 1.61803398875 + 0.5)
+    v0 = v0 / jnp.maximum(jnp.linalg.norm(v0), 1e-30)
     v = lax.fori_loop(0, 16, power_body, v0)
-    L = (v @ (Gn @ v)) / jnp.maximum(v @ v, 1e-30) + l2 + 1e-12
+    fro = jnp.sqrt((Gn * Gn).sum())
+    L_pow = (v @ (Gn @ v)) / jnp.maximum(v @ v, 1e-30)
+    L_smooth = jnp.where(L_pow > 1e-6 * fro, L_pow * 1.01, fro)
+    L = L_smooth + l2 + 1e-12
 
     def soft(x, t):
         return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)

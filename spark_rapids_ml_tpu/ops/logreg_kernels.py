@@ -1,0 +1,166 @@
+"""LogisticRegression device kernels — distributed L-BFGS/OWL-QN fit.
+
+TPU-native replacement for cuML ``LogisticRegressionMG``
+(reference: ``/root/reference/python/src/spark_rapids_ml/classification.py:955-1140``).
+
+Design notes:
+
+* **One jitted program.** The whole fit — standardization moments, the
+  L-BFGS loop, the coefficient back-transform — is a single jit over the
+  dp-sharded design matrix; XLA inserts the psum for every masked reduction
+  (the role NCCL allreduce played inside cuML's QN solver).
+* **Standardization without a data copy.** The reference materializes a
+  standardized copy of the dataset with cupy and allGathers mean/var
+  (``classification.py:989-1038``). Here standardization is a
+  *reparametrization*: optimize W in standardized-coefficient space and
+  fold the (mean, 1/std) affine map into the logits,
+  ``logits = X @ (W·inv_std)ᵀ + (b − (W·inv_std)·mean)`` — zero extra HBM,
+  identical objective. The final back-transform (coef/std, intercept
+  −coef·mean, multinomial intercept centering) matches the reference's
+  post-processing at ``classification.py:1073-1094``.
+* **Spark objective**: (1/n)·Σ logloss + λ[(1−α)/2‖β‖₂² + α‖β‖₁] with the
+  penalty applied to standardized coefficients when standardization=True
+  and never to intercepts. Feature variance uses the unbiased (n−1)
+  denominator exactly like the reference (``classification.py:1024-1026``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .lbfgs import minimize_lbfgs
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_classes",
+        "multinomial",
+        "fit_intercept",
+        "standardization",
+        "use_l1",
+        "max_iter",
+        "history",
+    ),
+)
+def logreg_fit(
+    X: jax.Array,
+    mask: jax.Array,
+    y: jax.Array,
+    *,
+    n_classes: int,
+    multinomial: bool,
+    fit_intercept: bool,
+    standardization: bool,
+    l1: jax.Array,
+    l2: jax.Array,
+    use_l1: bool,
+    max_iter: int,
+    tol: jax.Array,
+    history: int = 10,
+) -> Dict[str, jax.Array]:
+    """Fit logistic regression; returns coef_ (K,d), intercept_ (K,), n_iter,
+    objective. K=1 for the binomial (sigmoid) formulation, else n_classes."""
+    dtype = X.dtype
+    d = X.shape[1]
+    n = mask.sum()
+    yi = y.astype(jnp.int32)
+    yf = y.astype(dtype)
+
+    mean = (X * mask[:, None]).sum(axis=0) / n
+    if standardization:
+        sq = ((X - mean[None, :]) ** 2 * mask[:, None]).sum(axis=0)
+        var = sq / jnp.maximum(n - 1.0, 1.0)
+        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        inv_std = jnp.where(std > 0, 1.0 / std, 1.0)
+    else:
+        inv_std = jnp.ones((d,), dtype)
+    # the reference skips centering when fit_intercept=False (adds the mean
+    # back before scaling, ``classification.py:1036-1037``)
+    use_center = standardization and fit_intercept
+
+    K = n_classes if multinomial else 1
+    n_coef = K * d
+    p = n_coef + (K if fit_intercept else 0)
+
+    def unpack(wflat: jax.Array):
+        A = wflat[:n_coef].reshape(K, d)
+        b = wflat[n_coef:] if fit_intercept else jnp.zeros((K,), dtype)
+        return A, b
+
+    def to_original(A: jax.Array, b: jax.Array):
+        Aeff = A * inv_std[None, :]
+        beff = b - (Aeff @ mean if use_center else jnp.zeros((), dtype))
+        return Aeff, beff
+
+    coef_mask = jnp.concatenate(
+        [jnp.ones((n_coef,), dtype), jnp.zeros((p - n_coef,), dtype)]
+    )
+
+    def smooth_loss(wflat: jax.Array) -> jax.Array:
+        A, b = unpack(wflat)
+        Aeff, beff = to_original(A, b)
+        logits = X @ Aeff.T + beff[None, :]  # (n, K)
+        if multinomial:
+            ll = jax.nn.logsumexp(logits, axis=1) - jnp.take_along_axis(
+                logits, yi[:, None], axis=1
+            )[:, 0]
+        else:
+            z = logits[:, 0]
+            ll = jax.nn.softplus(z) - yf * z
+        data_loss = (ll * mask).sum() / n
+        coefs = wflat * coef_mask  # penalty never touches intercepts
+        return data_loss + 0.5 * l2 * jnp.vdot(coefs, coefs)
+
+    w0 = jnp.zeros((p,), dtype)
+    res = minimize_lbfgs(
+        smooth_loss,
+        w0,
+        max_iter=max_iter,
+        tol=tol,
+        # None keeps the solver on plain L-BFGS; OWL-QN's direction
+        # sign-alignment and orthant projection only pay off when L1 > 0
+        l1_weights=l1 * coef_mask if use_l1 else None,
+        history=history,
+    )
+
+    A, b = unpack(res.w)
+    coef, intercept = to_original(A, b)
+    if fit_intercept and K > 1:
+        # Spark centers multinomial intercepts (reference
+        # ``classification.py:1082-1094``)
+        intercept = intercept - intercept.mean()
+    return {
+        "coef_": coef,
+        "intercept_": intercept,
+        "n_iter": res.n_iter,
+        "objective": res.f,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("multinomial",))
+def logreg_predict(
+    Xb: jax.Array, coef: jax.Array, intercept: jax.Array, *, multinomial: bool
+):
+    """Batch inference -> (prediction, probability, rawPrediction).
+
+    Binomial rawPrediction follows Spark's [-m, m] convention; multinomial
+    rawPrediction is the margins vector (reference transform computes the
+    same scores then local sigmoid/softmax, ``classification.py:1410-1433``).
+    """
+    scores = Xb @ coef.T + intercept[None, :]
+    if multinomial:
+        raw = scores
+        prob = jax.nn.softmax(scores, axis=1)
+        pred = jnp.argmax(scores, axis=1).astype(Xb.dtype)
+    else:
+        z = scores[:, 0]
+        raw = jnp.stack([-z, z], axis=1)
+        p1 = jax.nn.sigmoid(z)
+        prob = jnp.stack([1.0 - p1, p1], axis=1)
+        pred = (p1 > 0.5).astype(Xb.dtype)
+    return pred, prob, raw

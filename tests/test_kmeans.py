@@ -110,3 +110,14 @@ def test_kmeans_ignored_spark34_params():
     est = KMeans(k=2, solver="auto", maxBlockSizeInMB=1.0)
     assert est.getOrDefault("solver") == "auto"
     assert "solver" not in est.tpu_params
+
+
+def test_predict_after_prediction_col_change():
+    rng = np.random.default_rng(30)
+    X = np.concatenate([rng.normal(size=(40, 3)), rng.normal(size=(40, 3)) + 10])
+    from spark_rapids_ml_tpu.data import DataFrame as DF
+    model = KMeans(k=2, seed=1).setFeaturesCol("features").fit(DF({"features": X}))
+    p0 = model.predict(X[0])
+    model._set_params(predictionCol="cluster")
+    p1 = model.predict(X[0])  # used to KeyError on the stale cached closure
+    assert p0 == p1

@@ -203,3 +203,44 @@ def test_missing_weight_col_raises():
     df, X, y, _ = _make_reg(n=50, d=3)
     with pytest.raises(ValueError, match="weightCol"):
         LinearRegression(weightCol="nope").setFeaturesCol("features").fit(df)
+
+
+def test_lasso_negated_feature_no_nan():
+    """A feature and its exact negation used to collapse the FISTA power
+    iteration's all-ones start vector -> L~0 -> NaN coefficients."""
+    rng = np.random.default_rng(21)
+    x = rng.normal(size=(200, 1))
+    X = np.concatenate([x, -x], axis=1)
+    y = x[:, 0] + 0.05 * rng.normal(size=200)
+    df = DataFrame({"features": X, "label": y})
+    model = (
+        LinearRegression(
+            regParam=0.5, elasticNetParam=1.0, standardization=False,
+            float32_inputs=False,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    assert np.isfinite(model.coefficients).all()
+
+
+def test_ridge_no_intercept_centered_std_scaling():
+    """fitIntercept=False + standardization=True must scale the penalty by
+    the true (centered) std, not the RMS second moment."""
+    rng = np.random.default_rng(22)
+    X = rng.normal(size=(300, 4)) + 5.0  # strongly non-zero-mean features
+    w_true = rng.normal(size=4)
+    y = X @ w_true + 0.1 * rng.normal(size=300)
+    lam = 0.3
+    df = DataFrame({"features": X, "label": y})
+    model = (
+        LinearRegression(regParam=lam, fitIntercept=False, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    # explicit oracle: min 1/(2n)||y - Xb||^2 + lam/2 ||b*std||^2 (no centering)
+    n = len(y)
+    sd = X.std(0)  # centered std
+    A = X.T @ X / n + lam * np.diag(sd**2)
+    beta = np.linalg.solve(A, X.T @ y / n)
+    np.testing.assert_allclose(model.coefficients, beta, atol=1e-5)

@@ -1,0 +1,310 @@
+"""LogisticRegression tests with sklearn oracles (reference test model:
+``/root/reference/python/tests/test_logistic_regression.py``).
+
+Objective correspondence used throughout: our (Spark's) objective is
+(1/n)·Σ logloss + λ[(1−α)/2‖β‖² + α‖β‖₁]; sklearn's is C·Σ logloss +
+penalty, so sklearn C = 1/(n·λ).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.classification import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+
+
+def _make_cls(n=400, d=6, n_classes=2, seed=0, scale=True):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    if scale:
+        X = X * rng.uniform(0.5, 3.0, size=d) + rng.normal(size=d)
+    W = rng.normal(size=(n_classes, d))
+    logits = X @ W.T + rng.normal(size=n_classes)
+    y = np.argmax(logits + rng.gumbel(size=(n, n_classes)), axis=1).astype(np.float64)
+    return DataFrame({"features": X, "label": y}), X, y
+
+
+def test_binary_no_reg_matches_sklearn(n_workers):
+    df, X, y = _make_cls(seed=1)
+    model = (
+        LogisticRegression(
+            num_workers=n_workers, standardization=False,
+            maxIter=500, tol=1e-12, float32_inputs=False,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    sk = SkLR(penalty=None, max_iter=2000, tol=1e-12).fit(X, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_.ravel(), atol=2e-3)
+    np.testing.assert_allclose(model.intercept, sk.intercept_[0], atol=2e-3)
+    assert model.numClasses == 2
+
+
+def test_binary_l2_matches_sklearn():
+    df, X, y = _make_cls(n=300, d=5, seed=2)
+    lam = 0.1
+    model = (
+        LogisticRegression(
+            regParam=lam, standardization=False, maxIter=500, tol=1e-12,
+            float32_inputs=False,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    sk = SkLR(C=1.0 / (len(y) * lam), max_iter=5000, tol=1e-12).fit(X, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_.ravel(), atol=1e-4)
+    np.testing.assert_allclose(model.intercept, sk.intercept_[0], atol=1e-4)
+
+
+def test_binary_standardization_oracle():
+    """standardization=True == fit on (X-mean)/std(ddof=1) then back-transform
+    (the reference's cupy standardization, classification.py:989-1038)."""
+    df, X, y = _make_cls(n=350, d=4, seed=3)
+    lam = 0.05
+    model = (
+        LogisticRegression(regParam=lam, maxIter=500, tol=1e-12, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    mu, sd = X.mean(0), X.std(0, ddof=1)
+    Xs = (X - mu) / sd
+    sk = SkLR(C=1.0 / (len(y) * lam), max_iter=5000, tol=1e-12).fit(Xs, y)
+    coef = sk.coef_.ravel() / sd
+    intercept = sk.intercept_[0] - coef @ mu
+    np.testing.assert_allclose(model.coefficients, coef, atol=1e-4)
+    np.testing.assert_allclose(model.intercept, intercept, atol=1e-4)
+
+
+def test_binary_l1_owlqn_matches_sklearn():
+    df, X, y = _make_cls(n=300, d=10, seed=4, scale=False)
+    lam = 0.05
+    model = (
+        LogisticRegression(
+            regParam=lam, elasticNetParam=1.0, standardization=False,
+            maxIter=1000, tol=1e-12, float32_inputs=False,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    sk = SkLR(
+        penalty="l1", solver="saga", C=1.0 / (len(y) * lam),
+        max_iter=20000, tol=1e-10,
+    ).fit(X, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_.ravel(), atol=3e-3)
+    # L1 at this strength zeroes some coefficients and OWL-QN must find them
+    assert (np.abs(model.coefficients) < 1e-8).any()
+    sk_zero = np.abs(sk.coef_.ravel()) < 1e-8
+    ours_zero = np.abs(model.coefficients) < 1e-8
+    assert (sk_zero == ours_zero).all()
+
+
+def test_elasticnet_matches_sklearn():
+    df, X, y = _make_cls(n=300, d=8, seed=5, scale=False)
+    lam, l1r = 0.05, 0.4
+    model = (
+        LogisticRegression(
+            regParam=lam, elasticNetParam=l1r, standardization=False,
+            maxIter=1000, tol=1e-12, float32_inputs=False,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    sk = SkLR(
+        penalty="elasticnet", solver="saga", l1_ratio=l1r,
+        C=1.0 / (len(y) * lam), max_iter=20000, tol=1e-10,
+    ).fit(X, y)
+    np.testing.assert_allclose(model.coefficients, sk.coef_.ravel(), atol=3e-3)
+
+
+def test_multinomial_matches_sklearn():
+    df, X, y = _make_cls(n=600, d=5, n_classes=3, seed=6)
+    lam = 0.02
+    model = (
+        LogisticRegression(
+            regParam=lam, standardization=False, maxIter=500, tol=1e-12,
+            float32_inputs=False,
+        )
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    assert model.numClasses == 3
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    sk = SkLR(C=1.0 / (len(y) * lam), max_iter=5000, tol=1e-12).fit(X, y)
+    np.testing.assert_allclose(model.coefficientMatrix, sk.coef_, atol=2e-3)
+    np.testing.assert_allclose(model.interceptVector, sk.intercept_, atol=2e-3)
+    # Spark centers multinomial intercepts
+    assert model.interceptVector.sum() == pytest.approx(0.0, abs=1e-8)
+    with pytest.raises(RuntimeError, match="coefficientMatrix"):
+        _ = model.coefficients
+
+
+def test_transform_columns_binary():
+    df, X, y = _make_cls(n=120, d=4, seed=7)
+    model = (
+        LogisticRegression(regParam=0.01, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    out = model.transform(df)
+    pred = out["prediction"]
+    prob = out["probability"]
+    raw = out["rawPrediction"]
+    assert pred.shape == (120,)
+    assert prob.shape == (120, 2)
+    assert raw.shape == (120, 2)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-6)
+    z = X @ model.coefficients + model.intercept
+    np.testing.assert_allclose(raw[:, 1], z, atol=1e-6)
+    np.testing.assert_allclose(pred, (z > 0).astype(float), atol=0)
+    # accuracy sanity on separable-ish data
+    assert (pred == y).mean() > 0.8
+
+
+def test_transform_columns_multinomial():
+    df, X, y = _make_cls(n=200, d=4, n_classes=4, seed=8)
+    model = (
+        LogisticRegression(regParam=0.01, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    out = model.transform(df)
+    assert out["probability"].shape == (200, 4)
+    assert out["rawPrediction"].shape == (200, 4)
+    np.testing.assert_allclose(out["probability"].sum(axis=1), 1.0, atol=1e-6)
+    np.testing.assert_allclose(
+        out["prediction"], np.argmax(out["rawPrediction"], axis=1), atol=0
+    )
+
+
+def test_single_row_predict_helpers():
+    df, X, y = _make_cls(n=100, d=3, seed=9)
+    model = (
+        LogisticRegression(regParam=0.01, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    x = X[0]
+    raw = model.predictRaw(x)
+    prob = model.predictProbability(x)
+    assert raw.shape == (2,)
+    assert prob.sum() == pytest.approx(1.0)
+    assert model.predict(x) == float(raw[1] > 0)
+
+
+def test_single_label_degenerate():
+    rng = np.random.default_rng(10)
+    X = rng.normal(size=(50, 3))
+    df = DataFrame({"features": X, "label": np.ones(50)})
+    model = LogisticRegression().setFeaturesCol("features").fit(df)
+    assert np.all(model.coefficients == 0.0)
+    assert model.intercept == np.inf
+    out = model.transform(df)
+    assert (out["prediction"] == 1.0).all()
+
+    df0 = DataFrame({"features": X, "label": np.zeros(50)})
+    model0 = LogisticRegression().setFeaturesCol("features").fit(df0)
+    assert model0.intercept == -np.inf
+    assert (model0.transform(df0)["prediction"] == 0.0).all()
+
+
+def test_invalid_labels_raise():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(20, 3))
+    with pytest.raises(RuntimeError, match="non-negative integers"):
+        LogisticRegression().setFeaturesCol("features").fit(
+            DataFrame({"features": X, "label": np.full(20, -1.0)})
+        )
+    with pytest.raises(RuntimeError, match="non-negative integers"):
+        LogisticRegression().setFeaturesCol("features").fit(
+            DataFrame({"features": X, "label": np.full(20, 0.5)})
+        )
+
+
+def test_unsupported_params_raise():
+    with pytest.raises(ValueError, match="not supported"):
+        LogisticRegression(threshold=0.3)
+    with pytest.raises(ValueError, match="not supported"):
+        LogisticRegression(weightCol="w")
+
+
+def test_param_mapping_c_inverse():
+    est = LogisticRegression(regParam=0.25)
+    assert est.tpu_params["C"] == pytest.approx(4.0)
+    est2 = LogisticRegression(regParam=0.0)
+    assert est2.tpu_params["C"] == 0.0
+
+
+def test_fit_multiple_and_combine():
+    df, X, y = _make_cls(n=150, d=4, seed=12)
+    est = LogisticRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = [
+        {est.getParam("regParam"): 0.01},
+        {est.getParam("regParam"): 1.0},
+    ]
+    models = dict(est.fitMultiple(df, grid))
+    assert len(models) == 2
+    n0 = np.linalg.norm(models[0].coefficients)
+    n1 = np.linalg.norm(models[1].coefficients)
+    assert n1 < n0
+    combined = LogisticRegressionModel._combine([models[0], models[1]])
+    assert combined._is_multi_model
+    assert combined.coef_.shape == (2, 1, 4)
+
+
+def test_persistence(tmp_path):
+    df, X, y = _make_cls(n=100, d=4, n_classes=3, seed=13)
+    model = (
+        LogisticRegression(regParam=0.1, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    path = str(tmp_path / "lr")
+    model.write().overwrite().save(path)
+    loaded = LogisticRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.coefficientMatrix, model.coefficientMatrix)
+    np.testing.assert_allclose(loaded.interceptVector, model.interceptVector)
+    assert loaded.numClasses == 3
+    assert loaded._multinomial
+    out0 = model.transform(df)["prediction"]
+    out1 = loaded.transform(df)["prediction"]
+    np.testing.assert_allclose(out0, out1)
+
+
+def test_f32_default_path():
+    df, X, y = _make_cls(n=200, d=5, seed=14)
+    model = LogisticRegression(regParam=0.01).setFeaturesCol("features").fit(df)
+    assert model.coefficients.dtype == np.float32 or np.isfinite(model.coefficients).all()
+    pred = model.transform(df)["prediction"]
+    assert (pred == y).mean() > 0.7
+
+
+def test_combined_multi_model_transform():
+    df, X, y = _make_cls(n=120, d=4, seed=15)
+    est = LogisticRegression(float32_inputs=False).setFeaturesCol("features")
+    m1 = est.fit(df, {est.getParam("regParam"): 0.01})
+    m2 = est.fit(df, {est.getParam("regParam"): 1.0})
+    combined = LogisticRegressionModel._combine([m1, m2])
+    out = combined.transform(df)
+    assert out["prediction"].shape == (120, 2)
+    assert out["probability"].shape == (120, 2, 2)
+    assert out["rawPrediction"].shape == (120, 2, 2)
+    np.testing.assert_allclose(
+        out["prediction"][:, 0], m1.transform(df)["prediction"], atol=0
+    )
+    np.testing.assert_allclose(
+        out["probability"][:, 1, :], m2.transform(df)["probability"], atol=1e-8
+    )
