@@ -1,0 +1,19 @@
+"""Driver-side metric computation from mergeable sufficient statistics.
+
+Mirrors the reference package (``/root/reference/python/src/spark_rapids_ml/
+metrics/``): ``MulticlassMetrics`` / ``RegressionMetrics`` aggregate
+per-shard sufficient statistics (confusion counts / moment buffers) and
+compute every metric the corresponding Spark evaluator supports. Unlike the
+reference there is no ``EvalMetricInfo`` side-channel — the evaluator object
+itself travels into ``model._transformEvaluate``.
+"""
+
+from .multiclass import MulticlassMetrics, log_loss
+from .regression import RegressionMetrics, _SummarizerBuffer
+
+__all__ = [
+    "MulticlassMetrics",
+    "RegressionMetrics",
+    "_SummarizerBuffer",
+    "log_loss",
+]

@@ -1,0 +1,208 @@
+"""Multiclass classification metrics from confusion sufficient statistics.
+
+Port of the reference's ``MulticlassMetrics``
+(``/root/reference/python/src/spark_rapids_ml/metrics/MulticlassMetrics.py``),
+itself aligned with Spark's Scala ``MulticlassMetrics``. The sufficient
+statistics are per-class true-positive / false-positive / label counts plus
+an accumulated log-loss sum — tiny, mergeable across shards, and enough for
+every metric ``MulticlassClassificationEvaluator`` supports.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def log_loss(labels: np.ndarray, probs: np.ndarray, eps: float) -> float:
+    """Sum of -log(p[label]) with probabilities clamped at ``eps``
+    (reference ``MulticlassMetrics.py:24-31``)."""
+    if np.any(labels < 0) or np.any(labels > probs.shape[1] - 1):
+        raise ValueError(f"labels must be in the range [0,{probs.shape[1] - 1}]")
+    if np.any(probs < 0) or np.any(probs > 1.0):
+        raise ValueError("probs must be in the range [0.0, 1.0]")
+    probs_for_labels = probs[np.arange(probs.shape[0]), labels.astype(np.int32)]
+    probs_for_labels = np.maximum(probs_for_labels, eps)
+    return float(np.sum(-np.log(probs_for_labels)))
+
+
+class MulticlassMetrics:
+    """Metrics for multiclass classification (confusion-count based)."""
+
+    SUPPORTED_MULTI_CLASS_METRIC_NAMES = [
+        "f1",
+        "accuracy",
+        "weightedPrecision",
+        "weightedRecall",
+        "weightedTruePositiveRate",
+        "weightedFalsePositiveRate",
+        "weightedFMeasure",
+        "truePositiveRateByLabel",
+        "falsePositiveRateByLabel",
+        "precisionByLabel",
+        "recallByLabel",
+        "fMeasureByLabel",
+        "hammingLoss",
+        "logLoss",
+    ]
+
+    def __init__(
+        self,
+        tp: Optional[Dict[float, float]] = None,
+        fp: Optional[Dict[float, float]] = None,
+        label: Optional[Dict[float, float]] = None,
+        label_count: int = 0,
+        log_loss: float = -1,
+    ) -> None:
+        self._tp_by_class = tp or {}
+        self._fp_by_class = fp or {}
+        self._label_count_by_class = label or {}
+        self._label_count = label_count
+        self._log_loss = log_loss
+
+    @classmethod
+    def from_predictions(
+        cls,
+        labels: np.ndarray,
+        predictions: np.ndarray,
+        probs: Optional[np.ndarray] = None,
+        eps: float = 1.0e-15,
+    ) -> "MulticlassMetrics":
+        """Build the sufficient statistics from a (shard of) predictions."""
+        labels = np.asarray(labels, dtype=np.float64)
+        predictions = np.asarray(predictions, dtype=np.float64)
+        tp: Dict[float, float] = {}
+        fp: Dict[float, float] = {}
+        cnt: Dict[float, float] = {}
+        # tp/fp are tracked for every class that appears anywhere; label
+        # counts only for classes present in labels (a prediction-only class
+        # must not create a zero-count label entry — recall would be 0/0)
+        for c in np.unique(np.concatenate([labels, predictions])):
+            is_label = labels == c
+            is_pred = predictions == c
+            tp[float(c)] = float(np.sum(is_label & is_pred))
+            fp[float(c)] = float(np.sum(~is_label & is_pred))
+            n_label = float(np.sum(is_label))
+            if n_label > 0:
+                cnt[float(c)] = n_label
+        ll = log_loss(labels, probs, eps) if probs is not None else -1.0
+        return cls(tp, fp, cnt, int(labels.shape[0]), ll)
+
+    def merge(self, other: "MulticlassMetrics") -> "MulticlassMetrics":
+        """Merge two shards' sufficient statistics."""
+
+        def _madd(a: Dict[float, float], b: Dict[float, float]) -> Dict[float, float]:
+            out = dict(a)
+            for k, v in b.items():
+                out[k] = out.get(k, 0.0) + v
+            return out
+
+        ll = (
+            self._log_loss + other._log_loss
+            if self._log_loss >= 0 and other._log_loss >= 0
+            else -1.0
+        )
+        return MulticlassMetrics(
+            _madd(self._tp_by_class, other._tp_by_class),
+            _madd(self._fp_by_class, other._fp_by_class),
+            _madd(self._label_count_by_class, other._label_count_by_class),
+            self._label_count + other._label_count,
+            ll,
+        )
+
+    # -- per-label pieces (reference ``MulticlassMetrics.py:70-143``) -------
+    def _precision(self, label: float) -> float:
+        tp = self._tp_by_class.get(label, 0.0)
+        fp = self._fp_by_class.get(label, 0.0)
+        return 0.0 if (tp + fp == 0) else tp / (tp + fp)
+
+    def _recall(self, label: float) -> float:
+        n = self._label_count_by_class.get(label, 0.0)
+        return 0.0 if n == 0 else self._tp_by_class.get(label, 0.0) / n
+
+    def _f_measure(self, label: float, beta: float = 1.0) -> float:
+        p = self._precision(label)
+        r = self._recall(label)
+        beta_sqrd = beta * beta
+        return 0.0 if (p + r == 0) else (1 + beta_sqrd) * p * r / (beta_sqrd * p + r)
+
+    def false_positive_rate(self, label: float) -> float:
+        fp = self._fp_by_class.get(label, 0.0)
+        denom = self._label_count - self._label_count_by_class.get(label, 0.0)
+        return 0.0 if denom == 0 else fp / denom
+
+    # -- aggregates --------------------------------------------------------
+    def weighted_fmeasure(self, beta: float = 1.0) -> float:
+        return sum(
+            self._f_measure(k, beta) * v / self._label_count
+            for k, v in self._label_count_by_class.items()
+        )
+
+    def accuracy(self) -> float:
+        return sum(self._tp_by_class.values()) / self._label_count
+
+    def weighted_precision(self) -> float:
+        return sum(
+            self._precision(c) * n / self._label_count
+            for c, n in self._label_count_by_class.items()
+        )
+
+    def weighted_recall(self) -> float:
+        return sum(
+            self._recall(c) * n / self._label_count
+            for c, n in self._label_count_by_class.items()
+        )
+
+    def weighted_true_positive_rate(self) -> float:
+        return self.weighted_recall()
+
+    def weighted_false_positive_rate(self) -> float:
+        return sum(
+            self.false_positive_rate(c) * n / self._label_count
+            for c, n in self._label_count_by_class.items()
+        )
+
+    def true_positive_rate_by_label(self, label: float) -> float:
+        return self._recall(label)
+
+    def hamming_loss(self) -> float:
+        return sum(self._fp_by_class.values()) / self._label_count
+
+    def log_loss(self) -> float:
+        return self._log_loss / self._label_count
+
+    def evaluate(self, evaluator: Any) -> float:
+        """Compute the metric an evaluator asks for (reference
+        ``MulticlassMetrics.py:148-180``)."""
+        metric_name = evaluator.getMetricName()
+        if metric_name == "f1":
+            return self.weighted_fmeasure()
+        elif metric_name == "accuracy":
+            return self.accuracy()
+        elif metric_name == "weightedPrecision":
+            return self.weighted_precision()
+        elif metric_name == "weightedRecall":
+            return self.weighted_recall()
+        elif metric_name == "weightedTruePositiveRate":
+            return self.weighted_true_positive_rate()
+        elif metric_name == "weightedFalsePositiveRate":
+            return self.weighted_false_positive_rate()
+        elif metric_name == "weightedFMeasure":
+            return self.weighted_fmeasure(evaluator.getBeta())
+        elif metric_name == "truePositiveRateByLabel":
+            return self.true_positive_rate_by_label(evaluator.getMetricLabel())
+        elif metric_name == "falsePositiveRateByLabel":
+            return self.false_positive_rate(evaluator.getMetricLabel())
+        elif metric_name == "precisionByLabel":
+            return self._precision(evaluator.getMetricLabel())
+        elif metric_name == "recallByLabel":
+            return self._recall(evaluator.getMetricLabel())
+        elif metric_name == "fMeasureByLabel":
+            return self._f_measure(evaluator.getMetricLabel(), evaluator.getBeta())
+        elif metric_name == "hammingLoss":
+            return self.hamming_loss()
+        elif metric_name == "logLoss":
+            return self.log_loss()
+        else:
+            raise ValueError(f"Unsupported metric name, found {metric_name}")

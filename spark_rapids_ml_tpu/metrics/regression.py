@@ -1,0 +1,215 @@
+"""Regression metrics from mergeable moment buffers.
+
+Port of the reference's ``RegressionMetrics`` + ``_SummarizerBuffer``
+(``/root/reference/python/src/spark_rapids_ml/metrics/RegressionMetrics.py``),
+itself a port of Spark's Scala ``SummarizerBuffer``. The buffer tracks
+mean / m2n (centered second moment) / m2 (raw second moment) / l1 for the
+three series [label, label−prediction, prediction]; two buffers merge with
+the Chan et al. parallel-variance update, so per-shard statistics combine
+exactly.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import namedtuple
+from typing import Any, List
+
+import numpy as np
+
+RegMetrics = namedtuple("RegMetrics", ("m2n", "m2", "l1", "mean", "total_count"))
+reg_metrics = RegMetrics("m2n", "m2", "l1", "mean", "total_count")
+
+
+class _SummarizerBuffer:
+    """Mergeable moment buffer (reference ``RegressionMetrics.py:30-149``).
+
+    All of mean/m2n/m2/l1 have the same length (3 here), ordered
+    [label, label-prediction, prediction]::
+
+        mean = 1/N · Σ x_i
+        m2n  = Σ (x_i − mean)²   (variance · N)
+        m2   = Σ x_i²
+        l1   = Σ |x_i|
+    """
+
+    def __init__(
+        self,
+        mean: List[float],
+        m2n: List[float],
+        m2: List[float],
+        l1: List[float],
+        total_cnt: int,
+    ):
+        self._curr_mean = list(mean)
+        self._curr_m2n = list(m2n)
+        self._curr_m2 = list(m2)
+        self._curr_l1 = list(l1)
+        self._num_cols = len(mean)
+        self._total_cnt = total_cnt
+        # weight col unsupported (parity with the reference): weight = 1/row
+        self._total_weight_sum = total_cnt
+        self._weight_square_sum = total_cnt
+        self._curr_weight_sum = [total_cnt] * self._num_cols
+
+    def merge(self, other: "_SummarizerBuffer") -> "_SummarizerBuffer":
+        """Merge the other into self and return a new buffer (Chan et al.)."""
+        self._total_cnt += other._total_cnt
+        self._total_weight_sum += other._total_weight_sum
+        self._weight_square_sum += other._weight_square_sum
+
+        for i in range(self._num_cols):
+            this_weight_sum = self._curr_weight_sum[i]
+            other_weight_sum = other._curr_weight_sum[i]
+            total_weight_sum = this_weight_sum + other_weight_sum
+            if total_weight_sum != 0.0:
+                delta_mean = other._curr_mean[i] - self._curr_mean[i]
+                self._curr_mean[i] += delta_mean * other_weight_sum / total_weight_sum
+                self._curr_m2n[i] += (
+                    other._curr_m2n[i]
+                    + delta_mean
+                    * delta_mean
+                    * this_weight_sum
+                    * other_weight_sum
+                    / total_weight_sum
+                )
+                self._curr_m2[i] += other._curr_m2[i]
+                self._curr_l1[i] += other._curr_l1[i]
+            self._curr_weight_sum[i] = total_weight_sum
+
+        return _SummarizerBuffer(
+            self._curr_mean,
+            self._curr_m2n,
+            self._curr_m2,
+            self._curr_l1,
+            self._total_cnt,
+        )
+
+    @property
+    def total_count(self) -> int:
+        return self._total_cnt
+
+    @property
+    def weight_sum(self) -> int:
+        return self._total_weight_sum
+
+    @property
+    def m2(self) -> List[float]:
+        return self._curr_m2
+
+    @property
+    def norm_l1(self) -> List[float]:
+        return self._curr_l1
+
+    @property
+    def mean(self) -> List[float]:
+        return self._curr_mean
+
+    @property
+    def variance(self) -> List[float]:
+        """Unbiased sample variance per series (Spark semantics)."""
+        denom = self._total_weight_sum - (
+            self._weight_square_sum / self._total_weight_sum
+        )
+        if denom > 0:
+            return [
+                max(m2n / denom, 0.0) for m2n in self._curr_m2n
+            ]
+        return [0.0] * self._num_cols
+
+
+class RegressionMetrics:
+    """Metrics for regression (reference ``RegressionMetrics.py:153-267``)."""
+
+    def __init__(self, summary: _SummarizerBuffer):
+        self._summary = summary
+
+    @staticmethod
+    def create(
+        mean: List[float],
+        m2n: List[float],
+        m2: List[float],
+        l1: List[float],
+        total_cnt: int,
+    ) -> "RegressionMetrics":
+        return RegressionMetrics(_SummarizerBuffer(mean, m2n, m2, l1, total_cnt))
+
+    @classmethod
+    def from_predictions(
+        cls, labels: np.ndarray, predictions: np.ndarray
+    ) -> "RegressionMetrics":
+        """Build the moment buffer from a (shard of) predictions."""
+        y = np.asarray(labels, dtype=np.float64)
+        p = np.asarray(predictions, dtype=np.float64)
+        series = [y, y - p, p]
+        mean = [float(s.mean()) for s in series]
+        m2n = [float(((s - s.mean()) ** 2).sum()) for s in series]
+        m2 = [float((s * s).sum()) for s in series]
+        l1 = [float(np.abs(s).sum()) for s in series]
+        return cls.create(mean, m2n, m2, l1, int(y.shape[0]))
+
+    def merge(self, other: "RegressionMetrics") -> "RegressionMetrics":
+        return RegressionMetrics(self._summary.merge(other._summary))
+
+    @property
+    def _ss_y(self) -> float:
+        """Sum of squares for label."""
+        return self._summary.m2[0]
+
+    @property
+    def _ss_err(self) -> float:
+        """Sum of squares for label−prediction."""
+        return self._summary.m2[1]
+
+    @property
+    def _ss_tot(self) -> float:
+        return self._summary.variance[0] * (self._summary.weight_sum - 1)
+
+    @property
+    def _ss_reg(self) -> float:
+        return (
+            self._summary.m2[2]
+            + math.pow(self._summary.mean[0], 2) * self._summary.weight_sum
+            - 2
+            * self._summary.mean[0]
+            * self._summary.mean[2]
+            * self._summary.weight_sum
+        )
+
+    @property
+    def mean_squared_error(self) -> float:
+        return self._ss_err / self._summary.weight_sum
+
+    @property
+    def root_mean_squared_error(self) -> float:
+        return math.sqrt(self.mean_squared_error)
+
+    def r2(self, through_origin: bool) -> float:
+        return (
+            (1 - self._ss_err / self._ss_y)
+            if through_origin
+            else (1 - self._ss_err / self._ss_tot)
+        )
+
+    @property
+    def mean_absolute_error(self) -> float:
+        return self._summary.norm_l1[1] / self._summary.weight_sum
+
+    @property
+    def explained_variance(self) -> float:
+        return self._ss_reg / self._summary.weight_sum
+
+    def evaluate(self, evaluator: Any) -> float:
+        metric_name = evaluator.getMetricName()
+        if metric_name == "rmse":
+            return self.root_mean_squared_error
+        elif metric_name == "mse":
+            return self.mean_squared_error
+        elif metric_name == "r2":
+            return self.r2(evaluator.getThroughOrigin())
+        elif metric_name == "mae":
+            return self.mean_absolute_error
+        elif metric_name == "var":
+            return self.explained_variance
+        else:
+            raise ValueError(f"Unsupported metric name, found {metric_name}")

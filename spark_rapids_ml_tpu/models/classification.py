@@ -179,6 +179,11 @@ class LogisticRegression(
     def _enable_fit_multiple_in_single_pass(self) -> bool:
         return True
 
+    def _supportsTransformEvaluate(self, evaluator: Any) -> bool:
+        from ..evaluation import MulticlassClassificationEvaluator
+
+        return isinstance(evaluator, MulticlassClassificationEvaluator)
+
     def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
         # label analysis happens on host, once, outside jit (the class count
         # is a static shape parameter of the compiled program)
@@ -359,7 +364,7 @@ class LogisticRegressionModel(
         coef_np = np.atleast_2d(self.coef_)
         b_np = np.atleast_1d(self.intercept_)
         multinomial = self._multinomial
-        if not np.all(np.isfinite(b_np)):
+        if not self._is_multi_model and not np.all(np.isfinite(b_np)):
             # degenerate single-label model: ±inf intercept would poison the
             # matmul; emit constant predictions directly
             const_pred = 1.0 if b_np.reshape(-1)[0] > 0 else 0.0
@@ -447,3 +452,31 @@ class LogisticRegressionModel(
     @property
     def _is_multi_model(self) -> bool:
         return self.coef_.ndim == 3
+
+    def _transformEvaluate(self, dataset: DataFrame, evaluator: Any) -> List[float]:
+        """ONE data pass -> per-model confusion/log-loss sufficient stats ->
+        metric values (reference ``classification.py:153-272``)."""
+        from ..evaluation import MulticlassClassificationEvaluator
+        from ..metrics import MulticlassMetrics
+
+        if not isinstance(evaluator, MulticlassClassificationEvaluator):
+            raise NotImplementedError(
+                f"Evaluator {type(evaluator).__name__} is not supported"
+            )
+        X = self._extract_features_for_transform(dataset)
+        out = self._apply_batched(self._get_tpu_transform_func(dataset), X)
+        preds = out[self.getOrDefault("predictionCol")]
+        probs = out[self.getOrDefault("probabilityCol")]
+        y = np.asarray(dataset.column(evaluator.getLabelCol()), dtype=np.float64)
+        need_probs = evaluator.getMetricName() == "logLoss"
+        if preds.ndim == 1:
+            preds, probs = preds[:, None], probs[:, None, :]
+        return [
+            MulticlassMetrics.from_predictions(
+                y,
+                preds[:, j],
+                probs[:, j, :] if need_probs else None,
+                evaluator.getEps(),
+            ).evaluate(evaluator)
+            for j in range(preds.shape[1])
+        ]

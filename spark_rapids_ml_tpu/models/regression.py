@@ -160,6 +160,11 @@ class LinearRegression(
     def _enable_fit_multiple_in_single_pass(self) -> bool:
         return True
 
+    def _supportsTransformEvaluate(self, evaluator: Any) -> bool:
+        from ..evaluation import RegressionEvaluator
+
+        return isinstance(evaluator, RegressionEvaluator)
+
     def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
         stats_cache: Dict[bool, Dict[str, jax.Array]] = {}
 
@@ -247,6 +252,29 @@ class LinearRegressionModel(
     @property
     def _is_multi_model(self) -> bool:
         return np.asarray(self._model_attributes["coefficients"]).ndim == 2
+
+    def _transformEvaluate(self, dataset: DataFrame, evaluator: Any) -> List[float]:
+        """ONE data pass computes every model's predictions and reduces them
+        to tiny moment buffers (reference ``regression.py:89-141`` computes
+        per-partition sufficient-stats rows; here the pass is a single
+        batched device sweep)."""
+        from ..evaluation import RegressionEvaluator
+        from ..metrics import RegressionMetrics
+
+        if not isinstance(evaluator, RegressionEvaluator):
+            raise NotImplementedError(
+                f"Evaluator {type(evaluator).__name__} is not supported"
+            )
+        X = self._extract_features_for_transform(dataset)
+        preds = self._apply_batched(self._get_tpu_transform_func(dataset), X)[
+            self.getOrDefault("predictionCol")
+        ]
+        y = np.asarray(dataset.column(evaluator.getLabelCol()), dtype=np.float64)
+        P = preds[:, None] if preds.ndim == 1 else preds  # (n, m)
+        return [
+            RegressionMetrics.from_predictions(y, P[:, j]).evaluate(evaluator)
+            for j in range(P.shape[1])
+        ]
 
     def _get_tpu_transform_func(
         self, dataset: Optional[DataFrame] = None

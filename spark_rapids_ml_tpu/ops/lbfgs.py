@@ -114,11 +114,9 @@ def minimize_lbfgs(
     l1w = l1_weights if use_l1 else jnp.zeros((p,), dtype)
 
     def full_obj_parts(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """(L1-inclusive objective, smooth gradient) in one fwd+bwd pass."""
         f, g = vg(w)
         return f + jnp.abs(l1w * w).sum(), g
-
-    def full_obj(w: jax.Array) -> jax.Array:
-        return fun(w) + jnp.abs(l1w * w).sum()
 
     f0, g0 = full_obj_parts(w0)
 
@@ -155,22 +153,26 @@ def minimize_lbfgs(
                 w_t = jnp.where(w_t * xi < 0.0, 0.0, w_t)  # orthant projection
             return w_t
 
-        # Armijo backtracking on the full (L1-inclusive) objective
+        # Armijo backtracking on the full (L1-inclusive) objective. Each
+        # trial evaluates value AND gradient in one fused fwd+bwd data pass:
+        # the accepted trial's gradient feeds the curvature update directly,
+        # so no extra pass is spent re-evaluating the accepted point.
         def ls_cond(carry):
-            t, f_t, n_try = carry
+            t, f_t, _, n_try = carry
             ok = f_t <= f + c1 * t * dir_deriv
             return jnp.logical_and(jnp.logical_not(ok), n_try < max_ls)
 
         def ls_body(carry):
-            t, _, n_try = carry
+            t, _, _, n_try = carry
             t = t * 0.5
-            return t, full_obj(trial_point(t)), n_try + 1
+            f_t, g_t = full_obj_parts(trial_point(t))
+            return t, f_t, g_t, n_try + 1
 
-        f_t0 = full_obj(trial_point(t0))
-        t, f_new, _ = lax.while_loop(ls_cond, ls_body, (t0, f_t0, jnp.asarray(0)))
-
+        f_t0, g_t0 = full_obj_parts(trial_point(t0))
+        t, f_new, g_new, _ = lax.while_loop(
+            ls_cond, ls_body, (t0, f_t0, g_t0, jnp.asarray(0))
+        )
         w_new = trial_point(t)
-        f_new, g_new = full_obj_parts(w_new)
 
         s = w_new - w
         yv = g_new - g

@@ -1,0 +1,359 @@
+"""Metrics / evaluators / CrossValidator tests (reference models:
+``/root/reference/python/src/spark_rapids_ml/metrics/`` + ``tuning.py``,
+sklearn as the numeric oracle)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.evaluation import (
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_rapids_ml_tpu.metrics import MulticlassMetrics, RegressionMetrics
+from spark_rapids_ml_tpu.tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+)
+
+
+# ---------------------------------------------------------------------------
+# metrics vs sklearn oracles
+# ---------------------------------------------------------------------------
+
+
+def _cls_data(seed=0, n=300, k=3):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, k, size=n).astype(np.float64)
+    pred = y.copy()
+    flip = rng.random(n) < 0.3
+    pred[flip] = rng.integers(0, k, size=flip.sum())
+    probs = rng.dirichlet(np.ones(k), size=n)
+    return y, pred.astype(np.float64), probs
+
+
+def test_multiclass_metrics_vs_sklearn():
+    y, pred, probs = _cls_data()
+    m = MulticlassMetrics.from_predictions(y, pred)
+    import sklearn.metrics as sk
+
+    assert m.accuracy() == pytest.approx(sk.accuracy_score(y, pred))
+    assert m.weighted_fmeasure() == pytest.approx(
+        sk.f1_score(y, pred, average="weighted")
+    )
+    assert m.weighted_precision() == pytest.approx(
+        sk.precision_score(y, pred, average="weighted")
+    )
+    assert m.weighted_recall() == pytest.approx(
+        sk.recall_score(y, pred, average="weighted")
+    )
+
+
+def test_multiclass_log_loss_vs_sklearn():
+    y, _, probs = _cls_data(seed=1)
+    m = MulticlassMetrics.from_predictions(y, y, probs)
+    import sklearn.metrics as sk
+
+    assert m.log_loss() == pytest.approx(sk.log_loss(y, probs), rel=1e-9)
+
+
+def test_multiclass_metrics_merge_equals_whole():
+    y, pred, probs = _cls_data(seed=2)
+    whole = MulticlassMetrics.from_predictions(y, pred, probs)
+    a = MulticlassMetrics.from_predictions(y[:100], pred[:100], probs[:100])
+    b = MulticlassMetrics.from_predictions(y[100:], pred[100:], probs[100:])
+    merged = a.merge(b)
+    assert merged.accuracy() == pytest.approx(whole.accuracy())
+    assert merged.weighted_fmeasure() == pytest.approx(whole.weighted_fmeasure())
+    assert merged.log_loss() == pytest.approx(whole.log_loss())
+
+
+def test_regression_metrics_vs_sklearn():
+    rng = np.random.default_rng(3)
+    y = rng.normal(size=400)
+    pred = y + 0.3 * rng.normal(size=400)
+    m = RegressionMetrics.from_predictions(y, pred)
+    import sklearn.metrics as sk
+
+    assert m.mean_squared_error == pytest.approx(sk.mean_squared_error(y, pred))
+    assert m.root_mean_squared_error == pytest.approx(
+        np.sqrt(sk.mean_squared_error(y, pred))
+    )
+    assert m.mean_absolute_error == pytest.approx(sk.mean_absolute_error(y, pred))
+    assert m.r2(False) == pytest.approx(sk.r2_score(y, pred), rel=1e-6)
+
+
+def test_regression_metrics_merge_equals_whole():
+    rng = np.random.default_rng(4)
+    y = rng.normal(size=500)
+    pred = y + rng.normal(size=500) * 0.5
+    whole = RegressionMetrics.from_predictions(y, pred)
+    merged = RegressionMetrics.from_predictions(y[:200], pred[:200]).merge(
+        RegressionMetrics.from_predictions(y[200:], pred[200:])
+    )
+    assert merged.mean_squared_error == pytest.approx(whole.mean_squared_error)
+    assert merged.r2(False) == pytest.approx(whole.r2(False))
+    assert merged.explained_variance == pytest.approx(whole.explained_variance)
+
+
+# ---------------------------------------------------------------------------
+# evaluators
+# ---------------------------------------------------------------------------
+
+
+def test_regression_evaluator_on_dataframe():
+    rng = np.random.default_rng(5)
+    y = rng.normal(size=100)
+    p = y + 0.1 * rng.normal(size=100)
+    df = DataFrame({"label": y, "prediction": p})
+    ev = RegressionEvaluator(metricName="rmse")
+    assert ev.evaluate(df) == pytest.approx(np.sqrt(((y - p) ** 2).mean()))
+    assert not ev.isLargerBetter()
+    assert RegressionEvaluator(metricName="r2").isLargerBetter()
+
+
+def test_multiclass_evaluator_on_dataframe():
+    y, pred, probs = _cls_data(seed=6)
+    df = DataFrame({"label": y, "prediction": pred, "probability": probs})
+    ev = MulticlassClassificationEvaluator(metricName="accuracy")
+    assert ev.evaluate(df) == pytest.approx((y == pred).mean())
+    ll = MulticlassClassificationEvaluator(metricName="logLoss").evaluate(df)
+    import sklearn.metrics as sk
+
+    assert ll == pytest.approx(sk.log_loss(y, probs), rel=1e-9)
+
+
+def test_binary_evaluator_auc_vs_sklearn():
+    rng = np.random.default_rng(7)
+    y = (rng.random(500) < 0.4).astype(np.float64)
+    score = y * 0.8 + rng.normal(size=500)
+    raw = np.stack([-score, score], axis=1)
+    df = DataFrame({"label": y, "rawPrediction": raw})
+    import sklearn.metrics as sk
+
+    auc = BinaryClassificationEvaluator(metricName="areaUnderROC").evaluate(df)
+    assert auc == pytest.approx(sk.roc_auc_score(y, score), abs=1e-9)
+    pr = BinaryClassificationEvaluator(metricName="areaUnderPR").evaluate(df)
+    # trapezoidal PR area differs slightly from sklearn's step interpolation
+    assert pr == pytest.approx(sk.average_precision_score(y, score), abs=0.02)
+
+
+# ---------------------------------------------------------------------------
+# single-pass transformEvaluate + CrossValidator
+# ---------------------------------------------------------------------------
+
+
+def _make_reg_df(n=300, d=6, seed=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = X @ w + 1.0 + 0.5 * rng.normal(size=n)
+    return DataFrame({"features": X, "label": y})
+
+
+def test_linreg_transform_evaluate_multi_model():
+    from spark_rapids_ml_tpu.regression import LinearRegression, LinearRegressionModel
+
+    df = _make_reg_df()
+    est = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    m1 = est.fit(df, {est.getParam("regParam"): 0.0})
+    m2 = est.fit(df, {est.getParam("regParam"): 10.0})
+    combined = LinearRegressionModel._combine([m1, m2])
+    ev = RegressionEvaluator(metricName="rmse")
+    vals = combined._transformEvaluate(df, ev)
+    assert len(vals) == 2
+    # each value equals the standalone evaluation of its model
+    assert vals[0] == pytest.approx(ev.evaluate(m1.transform(df)), rel=1e-6)
+    assert vals[1] == pytest.approx(ev.evaluate(m2.transform(df)), rel=1e-6)
+    assert vals[0] < vals[1]  # over-regularized model fits worse
+
+
+def test_logreg_transform_evaluate_multi_model():
+    from spark_rapids_ml_tpu.classification import (
+        LogisticRegression,
+        LogisticRegressionModel,
+    )
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(200, 4))
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    df = DataFrame({"features": X, "label": y})
+    est = LogisticRegression(float32_inputs=False).setFeaturesCol("features")
+    m1 = est.fit(df, {est.getParam("regParam"): 0.01})
+    m2 = est.fit(df, {est.getParam("regParam"): 100.0})
+    combined = LogisticRegressionModel._combine([m1, m2])
+    ev = MulticlassClassificationEvaluator(metricName="accuracy")
+    vals = combined._transformEvaluate(df, ev)
+    assert len(vals) == 2
+    assert vals[0] == pytest.approx(ev.evaluate(m1.transform(df)))
+    assert vals[0] >= vals[1]
+    ll = combined._transformEvaluate(
+        df, MulticlassClassificationEvaluator(metricName="logLoss")
+    )
+    assert ll[0] < ll[1]
+
+
+def test_param_grid_builder():
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    est = LinearRegression()
+    grid = (
+        ParamGridBuilder()
+        .addGrid(est.getParam("regParam"), [0.0, 0.1])
+        .addGrid(est.getParam("elasticNetParam"), [0.0, 0.5, 1.0])
+        .build()
+    )
+    assert len(grid) == 6
+    assert all(len(pm) == 2 for pm in grid)
+
+
+def test_cross_validator_picks_sensible_model():
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    df = _make_reg_df(n=400, seed=10)
+    est = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = (
+        ParamGridBuilder()
+        .addGrid(est.getParam("regParam"), [0.0, 0.01, 100.0])
+        .build()
+    )
+    cv = CrossValidator(
+        estimator=est,
+        estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(metricName="rmse"),
+        numFolds=3,
+        seed=1,
+    )
+    cv_model = cv.fit(df)
+    assert len(cv_model.avgMetrics) == 3
+    # the heavily regularized candidate must lose
+    assert np.argmin(cv_model.avgMetrics) != 2
+    # best model predicts well
+    ev = RegressionEvaluator(metricName="r2")
+    assert ev.evaluate(cv_model.transform(df)) > 0.9
+
+
+def test_cross_validator_single_pass_matches_fallback():
+    """Fast path (fitMultiple + _combine + _transformEvaluate) must agree
+    with the per-map fallback loop."""
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] - X[:, 2] > 0.2).astype(np.float64)
+    df = DataFrame({"features": X, "label": y})
+    est = LogisticRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(est.getParam("regParam"), [0.01, 1.0]).build()
+    ev = MulticlassClassificationEvaluator(metricName="accuracy")
+
+    cv = CrossValidator(estimator=est, estimatorParamMaps=grid, evaluator=ev, seed=3)
+    fast = cv.fit(df).avgMetrics
+
+    # force fallback by pretending the evaluator is unsupported
+    class _Wrapped(MulticlassClassificationEvaluator):
+        pass
+
+    est2 = LogisticRegression(float32_inputs=False).setFeaturesCol("features")
+    est2._supportsTransformEvaluate = lambda e: False  # type: ignore[assignment]
+    ev2 = MulticlassClassificationEvaluator(metricName="accuracy")
+    slow = CrossValidator(
+        estimator=est2, estimatorParamMaps=grid, evaluator=ev2, seed=3
+    ).fit(df).avgMetrics
+    np.testing.assert_allclose(fast, slow, atol=1e-12)
+
+
+def test_cross_validator_parallel_folds():
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    df = _make_reg_df(n=200, seed=12)
+    est = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(est.getParam("regParam"), [0.0, 0.1]).build()
+    ev = RegressionEvaluator(metricName="rmse")
+    serial = CrossValidator(
+        estimator=est, estimatorParamMaps=grid, evaluator=ev, seed=2, parallelism=1
+    ).fit(df)
+    parallel = CrossValidator(
+        estimator=est, estimatorParamMaps=grid, evaluator=ev, seed=2, parallelism=3
+    ).fit(df)
+    np.testing.assert_allclose(serial.avgMetrics, parallel.avgMetrics, atol=1e-12)
+
+
+def test_cv_model_persistence(tmp_path):
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    df = _make_reg_df(n=150, seed=13)
+    est = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(est.getParam("regParam"), [0.0, 0.1]).build()
+    cv_model = CrossValidator(
+        estimator=est,
+        estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(metricName="rmse"),
+    ).fit(df)
+    path = str(tmp_path / "cv")
+    cv_model.save(path)
+    loaded = CrossValidatorModel.load(path)
+    np.testing.assert_allclose(loaded.avgMetrics, cv_model.avgMetrics)
+    np.testing.assert_allclose(
+        loaded.transform(df)["prediction"], cv_model.transform(df)["prediction"]
+    )
+
+
+def test_multiclass_prediction_only_class_no_crash():
+    """A class predicted but absent from labels must not poison recall/f1."""
+    y = np.array([0.0, 0.0, 1.0, 1.0])
+    pred = np.array([0.0, 2.0, 1.0, 1.0])
+    m = MulticlassMetrics.from_predictions(y, pred)
+    assert m.weighted_fmeasure() > 0
+    assert m.accuracy() == pytest.approx(0.75)
+    assert m.hamming_loss() == pytest.approx(0.25)
+
+
+def test_logloss_missing_probability_col_raises():
+    df = DataFrame({"label": np.array([0.0, 1.0]), "prediction": np.array([0.0, 1.0])})
+    with pytest.raises(ValueError, match="probability"):
+        MulticlassClassificationEvaluator(metricName="logLoss").evaluate(df)
+
+
+def test_cv_collect_sub_models():
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    df = _make_reg_df(n=120, seed=14)
+    est = LinearRegression(float32_inputs=False).setFeaturesCol("features")
+    grid = ParamGridBuilder().addGrid(est.getParam("regParam"), [0.0, 0.1]).build()
+    cvm = CrossValidator(
+        estimator=est,
+        estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(),
+        collectSubModels=True,
+    ).fit(df)
+    assert cvm.subModels is not None
+    assert len(cvm.subModels) == 3  # folds
+    assert len(cvm.subModels[0]) == 2  # param maps
+
+
+def test_combined_degenerate_model_keeps_multi_shape():
+    """A CV fold whose training split is single-label yields an inf-intercept
+    sub-model; the combined multi-model must still emit per-model columns."""
+    from spark_rapids_ml_tpu.classification import (
+        LogisticRegression,
+        LogisticRegressionModel,
+    )
+
+    rng = np.random.default_rng(15)
+    X = rng.normal(size=(60, 3))
+    y = (X[:, 0] > 0).astype(np.float64)
+    est = LogisticRegression(float32_inputs=False).setFeaturesCol("features")
+    normal = est.fit(DataFrame({"features": X, "label": y}))
+    degen = est.fit(DataFrame({"features": X, "label": np.ones(60)}))
+    combined = LogisticRegressionModel._combine([normal, degen])
+    df = DataFrame({"features": X, "label": y})
+    out = combined.transform(df)
+    assert out["prediction"].shape == (60, 2)
+    assert (out["prediction"][:, 1] == 1.0).all()  # degenerate model: all 1s
+    vals = combined._transformEvaluate(
+        df, MulticlassClassificationEvaluator(metricName="accuracy")
+    )
+    assert len(vals) == 2
+    assert vals[0] > vals[1]
