@@ -2,5 +2,11 @@
 ``spark_rapids_ml.classification`` (``/root/reference/python/src/spark_rapids_ml/classification.py``)."""
 
 from .models.classification import LogisticRegression, LogisticRegressionModel
+from .models.tree import RandomForestClassificationModel, RandomForestClassifier
 
-__all__ = ["LogisticRegression", "LogisticRegressionModel"]
+__all__ = [
+    "LogisticRegression",
+    "LogisticRegressionModel",
+    "RandomForestClassifier",
+    "RandomForestClassificationModel",
+]

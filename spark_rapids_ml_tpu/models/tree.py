@@ -1,0 +1,711 @@
+"""RandomForest — Spark ML drop-ins, TPU-native histogram forest builder.
+
+Reference: ``/root/reference/python/src/spark_rapids_ml/tree.py`` (614 LoC
+shared base driving per-worker cuML RandomForest fits, treelite model
+allGather at :319-366), ``classification.py:298-648`` (classifier) and
+``regression.py:787-1068`` (regressor). Param-mapping parity with
+``tree.py:66-110``: ``maxBins→n_bins``, ``maxDepth→max_depth``,
+``numTrees→n_estimators``, ``impurity→split_criterion``,
+``featureSubsetStrategy→max_features``, ``bootstrap→bootstrap``,
+``seed→random_state``, ``minInstancesPerNode→min_samples_leaf``;
+``subsamplingRate``/``maxMemoryInMB``/``cacheNodeIds``/``checkpointInterval``/
+``minWeightFractionPerNode`` accepted-but-ignored; ``weightCol``/``leafCol``
+unsupported (raise). (Improvement over the reference: ``minInfoGain`` is
+honored rather than ignored.)
+
+The compute path is ``ops/tree_kernels.py``: quantize → level-wise histogram
+splits, trees split across mesh devices exactly like the reference splits
+trees across workers (``tree.py:256-267``), zero collectives during growth.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import FitFunc, FitInputs, _TpuEstimatorSupervised, _TpuModel
+from ..data.dataframe import DataFrame
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    HasPredictionCol,
+    HasProbabilityCol,
+    HasRawPredictionCol,
+    HasSeed,
+    TypeConverters,
+    _mk,
+)
+from ..parallel.mesh import DP_AXIS
+from ..ops.tree_kernels import (
+    ForestConfig,
+    binize,
+    build_forest,
+    make_bin_edges,
+    max_nodes,
+    next_pow2,
+    rf_classify,
+    rf_regress,
+)
+
+_MAX_SUPPORTED_DEPTH = 18  # full binary layout: 2^(d+1)-1 nodes per tree
+
+
+def _str_or_numerical(value: str) -> Union[str, float, int]:
+    """Parse featureSubsetStrategy strings that encode numbers (reference
+    ``utils._str_or_numerical``, used at ``tree.py:94-105``)."""
+    try:
+        return int(value)
+    except ValueError:
+        pass
+    try:
+        return float(value)
+    except ValueError:
+        pass
+    return value
+
+
+class _RandomForestClass:
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        # reference ``tree.py:66-91``
+        return {
+            "maxBins": "n_bins",
+            "maxDepth": "max_depth",
+            "numTrees": "n_estimators",
+            "impurity": "split_criterion",
+            "featureSubsetStrategy": "max_features",
+            "bootstrap": "bootstrap",
+            "seed": "random_state",
+            "minInstancesPerNode": "min_samples_leaf",
+            "minInfoGain": "min_impurity_decrease",
+            "maxMemoryInMB": "",
+            "cacheNodeIds": "",
+            "checkpointInterval": "",
+            "subsamplingRate": "",
+            "minWeightFractionPerNode": "",
+            "weightCol": None,
+            "leafCol": None,
+        }
+
+    @classmethod
+    def _param_value_mapping(cls) -> Dict[str, Callable[[Any], Any]]:
+        # reference ``tree.py:93-110``
+        def _tree_mapping(v: Any) -> Union[None, str, float, int]:
+            if isinstance(v, (int, float)):
+                return v
+            maybe = _str_or_numerical(str(v))
+            if isinstance(maybe, (int, float)):
+                return maybe
+            mapping: Dict[str, Union[str, float]] = {
+                "onethird": 1.0 / 3.0,
+                "all": 1.0,
+                "auto": "auto",
+                "sqrt": "sqrt",
+                "log2": "log2",
+            }
+            if maybe not in mapping:
+                raise ValueError(f"Unsupported featureSubsetStrategy: {v!r}")
+            return mapping[maybe]
+
+        return {"max_features": _tree_mapping}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "n_estimators": 100,
+            "max_depth": 16,
+            "n_bins": 128,
+            "max_features": "auto",
+            "bootstrap": True,
+            "min_samples_leaf": 1,
+            "min_samples_split": 2,
+            "min_impurity_decrease": 0.0,
+            "random_state": None,
+        }
+
+
+class _RandomForestParams(
+    HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasPredictionCol, HasSeed
+):
+    numTrees = _mk("numTrees", "number of trees", TypeConverters.toInt)
+    maxDepth = _mk("maxDepth", "maximum tree depth", TypeConverters.toInt)
+    maxBins = _mk("maxBins", "max histogram bins per feature", TypeConverters.toInt)
+    impurity = _mk("impurity", "split criterion", TypeConverters.toString)
+    featureSubsetStrategy = _mk(
+        "featureSubsetStrategy",
+        "features considered per split: auto|all|sqrt|log2|onethird|fraction|n",
+        TypeConverters.toString,
+    )
+    bootstrap = _mk("bootstrap", "bootstrap-sample rows per tree", TypeConverters.toBoolean)
+    minInstancesPerNode = _mk(
+        "minInstancesPerNode", "min rows per child node", TypeConverters.toInt
+    )
+    minInfoGain = _mk("minInfoGain", "min gain for a split", TypeConverters.toFloat)
+    subsamplingRate = _mk("subsamplingRate", "row subsample rate (ignored)", TypeConverters.toFloat)
+    maxMemoryInMB = _mk("maxMemoryInMB", "memory hint (ignored)", TypeConverters.toInt)
+    cacheNodeIds = _mk("cacheNodeIds", "node-id caching (ignored)", TypeConverters.toBoolean)
+    checkpointInterval = _mk("checkpointInterval", "checkpointing (ignored)", TypeConverters.toInt)
+    minWeightFractionPerNode = _mk(
+        "minWeightFractionPerNode", "min weight fraction (ignored)", TypeConverters.toFloat
+    )
+    weightCol = _mk("weightCol", "weight column (unsupported)", TypeConverters.toString)
+    leafCol = _mk("leafCol", "leaf index column (unsupported)", TypeConverters.toString)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(
+            numTrees=20,
+            maxDepth=5,
+            maxBins=32,
+            featureSubsetStrategy="auto",
+            bootstrap=True,
+            minInstancesPerNode=1,
+            minInfoGain=0.0,
+            subsamplingRate=1.0,
+            seed=0,
+        )
+
+    def getNumTrees(self) -> int:
+        return self.getOrDefault("numTrees")
+
+    def getMaxDepth(self) -> int:
+        return self.getOrDefault("maxDepth")
+
+    def getMaxBins(self) -> int:
+        return self.getOrDefault("maxBins")
+
+    def getImpurity(self) -> str:
+        return self.getOrDefault("impurity")
+
+    def getFeatureSubsetStrategy(self) -> str:
+        return self.getOrDefault("featureSubsetStrategy")
+
+
+def _resolve_k_features(
+    max_features: Union[str, float, int], d: int, is_classification: bool
+) -> int:
+    """Resolve the per-node feature-sample count (cuML max_features
+    semantics; 'auto' follows Spark: sqrt for classification, 1/3 for
+    regression)."""
+    if max_features == "auto":
+        k = math.ceil(math.sqrt(d)) if is_classification else math.ceil(d / 3.0)
+    elif max_features == "sqrt":
+        k = math.ceil(math.sqrt(d))
+    elif max_features == "log2":
+        k = math.ceil(math.log2(max(d, 2)))
+    elif isinstance(max_features, int):
+        k = max_features
+    elif isinstance(max_features, float):
+        k = math.ceil(max_features * d)
+    else:
+        raise ValueError(f"Unsupported max_features: {max_features!r}")
+    return max(1, min(int(k), d))
+
+
+class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _RandomForestParams):
+    """Shared fit machinery (reference ``_RandomForestEstimator``,
+    ``tree.py:230-420``)."""
+
+    _is_classification = False
+    _default_impurity = "variance"
+
+    def __init__(self, **kwargs: Any) -> None:
+        _TpuEstimatorSupervised.__init__(self)
+        _RandomForestParams.__init__(self)
+        self._setDefault(impurity=self._default_impurity)
+        self._set_params(**kwargs)
+
+    def setNumTrees(self, value: int) -> "_RandomForestEstimator":
+        self._set_params(numTrees=value)
+        return self
+
+    def setMaxDepth(self, value: int) -> "_RandomForestEstimator":
+        self._set_params(maxDepth=value)
+        return self
+
+    def setMaxBins(self, value: int) -> "_RandomForestEstimator":
+        self._set_params(maxBins=value)
+        return self
+
+    def setImpurity(self, value: str) -> "_RandomForestEstimator":
+        self._set_params(impurity=value)
+        return self
+
+    def setFeatureSubsetStrategy(self, value: str) -> "_RandomForestEstimator":
+        self._set_params(featureSubsetStrategy=value)
+        return self
+
+    def setSeed(self, value: int) -> "_RandomForestEstimator":
+        self._set_params(seed=value)
+        return self
+
+    def _enable_fit_multiple_in_single_pass(self) -> bool:
+        # reference fits all param maps inside one pass (``tree.py:368-400``)
+        return True
+
+    def _supportsTransformEvaluate(self, evaluator: Any) -> bool:
+        # reference ``classification.py:505-513`` / ``regression.py:972-980``
+        from ..evaluation import (
+            MulticlassClassificationEvaluator,
+            RegressionEvaluator,
+        )
+
+        if self._is_classification:
+            return isinstance(evaluator, MulticlassClassificationEvaluator)
+        return isinstance(evaluator, RegressionEvaluator)
+
+    # -- label handling ----------------------------------------------------
+    def _process_labels(self, y_host: np.ndarray) -> int:
+        """Returns n_stats (classifier: validates integer labels, returns
+        n_classes; regressor: 3 moment slots)."""
+        raise NotImplementedError
+
+    def _label_stats(self, y: jax.Array, n_stats: int) -> jax.Array:
+        """Device-side per-row sufficient-stat vectors from labels."""
+        raise NotImplementedError
+
+    def _impurity_name(self, params: Dict[str, Any]) -> str:
+        raise NotImplementedError
+
+    # -- fit ---------------------------------------------------------------
+    def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
+        label_col = self.getOrDefault("labelCol")
+        y_host_raw = np.asarray(dataset.column(label_col))
+        n_stats = self._process_labels(y_host_raw)
+        is_classification = self._is_classification
+
+        def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            max_depth = int(params["max_depth"])
+            if max_depth > _MAX_SUPPORTED_DEPTH:
+                raise ValueError(
+                    f"maxDepth={max_depth} exceeds supported depth "
+                    f"{_MAX_SUPPORTED_DEPTH} (full binary node layout)"
+                )
+            n_trees = int(params["n_estimators"])
+            if n_trees < 1:
+                raise ValueError("numTrees must be >= 1")
+            n_bins = int(min(params["n_bins"], max(2, inputs.n_rows)))
+            if n_bins > 256:
+                # uint8 bin storage; quantile histograms gain nothing past 256
+                self.logger.warning("maxBins=%d clamped to 256", n_bins)
+                n_bins = 256
+            d = inputs.n_features
+            d_pad = next_pow2(d)
+            seed = int(params.get("random_state") or 0)
+
+            # 1) quantize features (host quantile sketch -> device binize).
+            # Strided row sample: unbiased under any dataset sort order
+            # (a prefix sample would skew edges on label/feature-sorted data)
+            step = max(1, inputs.n_rows // 131072)
+            edges_np = make_bin_edges(
+                np.asarray(inputs.X[: inputs.n_rows : step]), n_bins, seed=seed
+            )
+            bins = binize(inputs.X, jnp.asarray(edges_np), d_pad=d_pad)
+
+            # 2) per-row sufficient stats
+            stats = self._label_stats(inputs.y, n_stats)
+
+            # 3) per-device tree split (reference ``tree.py:256-267``)
+            n_dp = inputs.mesh.shape[DP_AXIS]
+            t_local = -(-n_trees // n_dp)
+            keys = jax.random.split(
+                jax.random.PRNGKey(seed), n_dp * t_local
+            ).reshape(n_dp, t_local, 2)
+            keys = jax.device_put(
+                np.asarray(keys), NamedSharding(inputs.mesh, P(DP_AXIS))
+            )
+
+            cfg = ForestConfig(
+                max_depth=max_depth,
+                n_bins=n_bins,
+                n_features=d,
+                n_stats=n_stats,
+                impurity=self._impurity_name(params),
+                k_features=_resolve_k_features(
+                    params["max_features"], d, is_classification
+                ),
+                min_samples_leaf=int(params["min_samples_leaf"]),
+                min_info_gain=float(params.get("min_impurity_decrease", 0.0) or 0.0),
+                min_samples_split=int(params.get("min_samples_split", 2)),
+                bootstrap=bool(params["bootstrap"]),
+            )
+            out = build_forest(bins, inputs.mask, stats, keys, mesh=inputs.mesh, cfg=cfg)
+
+            # interleave device-major -> tree-major so the slice to n_trees
+            # takes trees evenly from every device
+            def _gather(a: jax.Array) -> np.ndarray:
+                a = np.asarray(a)
+                shaped = a.reshape(n_dp, t_local, *a.shape[1:])
+                return np.swapaxes(shaped, 0, 1).reshape(-1, *a.shape[1:])[:n_trees]
+
+            feat = _gather(out["feature"])
+            thr_bin = _gather(out["threshold_bin"])
+            leaf_stats = _gather(out["leaf_stats"])
+            gains = _gather(out["gain"])
+
+            # bin thresholds -> raw feature-space values (x >= thr -> right)
+            thr = np.where(
+                feat >= 0,
+                edges_np[np.clip(feat, 0, d - 1), np.clip(thr_bin, 0, n_bins - 2)],
+                0.0,
+            ).astype(np.float32)
+
+            return {
+                "features": feat.astype(np.int32),
+                "thresholds": thr,
+                "leaf_stats": leaf_stats.astype(np.float32),
+                "gains": gains.astype(np.float32),
+                "n_classes": n_stats if is_classification else 0,
+                "num_features": d,
+            }
+
+        return _fit
+
+
+class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
+    """Shared model surface (reference ``_RandomForestModel``,
+    ``tree.py:423-614``)."""
+
+    def __init__(self, **attrs: Any) -> None:
+        _TpuModel.__init__(self, **attrs)
+        _RandomForestParams.__init__(self)
+
+    # -- forest structure --------------------------------------------------
+    @property
+    def _features_arr(self) -> np.ndarray:
+        return np.asarray(self._model_attributes["features"])
+
+    @property
+    def _thresholds_arr(self) -> np.ndarray:
+        return np.asarray(self._model_attributes["thresholds"])
+
+    @property
+    def _leaf_stats_arr(self) -> np.ndarray:
+        return np.asarray(self._model_attributes["leaf_stats"])
+
+    @property
+    def _gains_arr(self) -> np.ndarray:
+        return np.asarray(self._model_attributes["gains"])
+
+    @property
+    def _max_depth_built(self) -> int:
+        m = self._features_arr.shape[1]
+        return int(math.log2(m + 1)) - 1
+
+    @property
+    def numFeatures(self) -> int:
+        return int(self._model_attributes["num_features"])
+
+    def getNumTrees(self) -> int:
+        # NOTE: the fitted tree count, intentionally NOT a ``numTrees``
+        # property — that name is the Param and must stay a Param
+        return int(self._features_arr.shape[0])
+
+    @property
+    def treeWeights(self) -> List[float]:
+        return [1.0] * self.getNumTrees()
+
+    @property
+    def totalNumNodes(self) -> int:
+        # every split adds two children to the initial root
+        return int(self.getNumTrees() + 2 * (self._features_arr >= 0).sum())
+
+    def _leaf_counts(self) -> np.ndarray:
+        """(T, M) row counts behind every node."""
+        ls = self._leaf_stats_arr
+        if int(self._model_attributes["n_classes"]) > 0:
+            return ls.sum(axis=2)
+        return ls[:, :, 0]
+
+    @property
+    def featureImportances(self) -> np.ndarray:
+        """Gain-weighted importances, Spark semantics: per-tree importance of
+        feature f = sum over f's split nodes of gain * node row count;
+        normalized per tree, averaged, normalized to sum 1."""
+        feat, gains = self._features_arr, self._gains_arr
+        counts = self._leaf_counts()
+        d = self.numFeatures
+        total = np.zeros(d)
+        for t in range(feat.shape[0]):
+            split = feat[t] >= 0
+            contrib = np.zeros(d)
+            np.add.at(contrib, feat[t][split], (gains[t] * counts[t])[split])
+            s = contrib.sum()
+            if s > 0:
+                total += contrib / s
+        s = total.sum()
+        return total / s if s > 0 else total
+
+    @property
+    def trees(self) -> List[Dict[str, Any]]:
+        """Per-tree nested-dict export (the reference keeps per-tree JSON from
+        cuML for ``cpu()`` translation, ``tree.py:319-366``)."""
+        out = []
+        feat, thr = self._features_arr, self._thresholds_arr
+        leaf = self._leaf_stats_arr
+        for t in range(feat.shape[0]):
+            def build(i: int) -> Dict[str, Any]:
+                if feat[t, i] < 0:
+                    return {"leaf_value": leaf[t, i].tolist()}
+                return {
+                    "split_feature": int(feat[t, i]),
+                    "threshold": float(thr[t, i]),
+                    "left_child": build(2 * i + 1),
+                    "right_child": build(2 * i + 2),
+                }
+
+            out.append(build(0))
+        return out
+
+    def toDebugString(self) -> str:
+        lines = [
+            f"{type(self).__name__} with {self.getNumTrees()} trees, "
+            f"{self.totalNumNodes} nodes, depth<={self._max_depth_built}"
+        ]
+        return "\n".join(lines)
+
+    # -- multi-model support (CV single-pass) ------------------------------
+    @classmethod
+    def _combine(cls, models: List["_RandomForestModel"]) -> "_RandomForestModel":
+        """Forests are ragged across param maps (different numTrees/maxDepth),
+        so unlike the coefficient models the combined model keeps the
+        sub-model list and evaluates them against ONE feature extraction
+        (the reference likewise combines treelite sub-models,
+        ``tree.py:600-614``)."""
+        combined = models[0].copy()
+        combined._cv_models = list(models)
+        return combined
+
+    def _eval_models(self) -> List["_RandomForestModel"]:
+        return getattr(self, "_cv_models", None) or [self]
+
+
+# ---------------------------------------------------------------------------
+# classifier
+# ---------------------------------------------------------------------------
+
+
+class RandomForestClassifier(_RandomForestEstimator, HasProbabilityCol, HasRawPredictionCol):
+    """``RandomForestClassifier(numTrees=50, maxDepth=13).fit(df)`` — drop-in
+    for ``pyspark.ml.classification.RandomForestClassifier`` (reference
+    ``classification.py:308-513``)."""
+
+    _is_classification = True
+    _default_impurity = "gini"
+
+    @classmethod
+    def _param_value_mapping(cls) -> Dict[str, Callable[[Any], Any]]:
+        m = dict(super()._param_value_mapping())
+
+        def _crit(v: str) -> str:
+            if v not in ("gini", "entropy"):
+                raise ValueError(f"Unsupported impurity for classification: {v!r}")
+            return v
+
+        m["split_criterion"] = _crit
+        return m
+
+    def _process_labels(self, y_host: np.ndarray) -> int:
+        if y_host.size == 0:
+            raise ValueError("Labels column is empty")
+        if np.any(y_host < 0) or np.any(y_host != np.floor(y_host)):
+            raise RuntimeError("Labels MUST be non-negative integers")
+        return max(int(y_host.max()) + 1, 2)
+
+    def _label_stats(self, y: jax.Array, n_stats: int) -> jax.Array:
+        return jax.nn.one_hot(y.astype(jnp.int32), n_stats, dtype=jnp.float32)
+
+    def _impurity_name(self, params: Dict[str, Any]) -> str:
+        return str(params.get("split_criterion", "gini"))
+
+    def _create_model(self, result: Dict[str, Any]) -> "RandomForestClassificationModel":
+        return RandomForestClassificationModel(**result)
+
+
+class RandomForestClassificationModel(
+    _RandomForestModel, HasProbabilityCol, HasRawPredictionCol
+):
+    """Reference ``classification.py:516-648``."""
+
+    @property
+    def numClasses(self) -> int:
+        return int(self._model_attributes["n_classes"])
+
+    @property
+    def classes_(self) -> np.ndarray:
+        return np.arange(self.numClasses, dtype=np.float64)
+
+    def _leaf_probs(self) -> np.ndarray:
+        ls = self._leaf_stats_arr
+        tot = np.maximum(ls.sum(axis=2, keepdims=True), 1e-12)
+        return (ls / tot).astype(np.float32)
+
+    def _out_cols(self) -> List[str]:
+        return [
+            self.getOrDefault("predictionCol"),
+            self.getOrDefault("probabilityCol"),
+            self.getOrDefault("rawPredictionCol"),
+        ]
+
+    def _get_tpu_transform_func(
+        self, dataset: Optional[DataFrame] = None
+    ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        pred_col = self.getOrDefault("predictionCol")
+        prob_col = self.getOrDefault("probabilityCol")
+        raw_col = self.getOrDefault("rawPredictionCol")
+        feat = jnp.asarray(self._features_arr)
+        thr = jnp.asarray(self._thresholds_arr)
+        leafp = jnp.asarray(self._leaf_probs())
+        depth = self._max_depth_built
+
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            pred, prob, raw = rf_classify(
+                jnp.asarray(Xb), feat, jnp.asarray(thr, Xb.dtype), leafp,
+                max_depth=depth,
+            )
+            return {
+                pred_col: np.asarray(pred),
+                prob_col: np.asarray(prob),
+                raw_col: np.asarray(raw),
+            }
+
+        return _fn
+
+    # -- single-row API ----------------------------------------------------
+    def predict(self, vector: Any) -> float:
+        x = np.asarray(vector, dtype=np.float32).reshape(1, -1)
+        fn = self._get_tpu_transform_func()
+        return float(fn(x)[self.getOrDefault("predictionCol")][0])
+
+    def predictProbability(self, vector: Any) -> np.ndarray:
+        x = np.asarray(vector, dtype=np.float32).reshape(1, -1)
+        fn = self._get_tpu_transform_func()
+        return fn(x)[self.getOrDefault("probabilityCol")][0]
+
+    def predictRaw(self, vector: Any) -> np.ndarray:
+        x = np.asarray(vector, dtype=np.float32).reshape(1, -1)
+        fn = self._get_tpu_transform_func()
+        return fn(x)[self.getOrDefault("rawPredictionCol")][0]
+
+    def _transformEvaluate(self, dataset: DataFrame, evaluator: Any) -> List[float]:
+        from ..evaluation import MulticlassClassificationEvaluator
+        from ..metrics import MulticlassMetrics
+
+        if not isinstance(evaluator, MulticlassClassificationEvaluator):
+            raise NotImplementedError(
+                f"Evaluator {type(evaluator).__name__} is not supported"
+            )
+        X = self._extract_features_for_transform(dataset)
+        y = np.asarray(dataset.column(evaluator.getLabelCol()), dtype=np.float64)
+        need_probs = evaluator.getMetricName() == "logLoss"
+        results = []
+        for m in self._eval_models():
+            out = m._apply_batched(m._get_tpu_transform_func(dataset), X)
+            results.append(
+                MulticlassMetrics.from_predictions(
+                    y,
+                    out[m.getOrDefault("predictionCol")],
+                    out[m.getOrDefault("probabilityCol")] if need_probs else None,
+                    evaluator.getEps(),
+                ).evaluate(evaluator)
+            )
+        return results
+
+
+# ---------------------------------------------------------------------------
+# regressor
+# ---------------------------------------------------------------------------
+
+
+class RandomForestRegressor(_RandomForestEstimator):
+    """``RandomForestRegressor(numTrees=30, maxDepth=6).fit(df)`` — drop-in
+    for ``pyspark.ml.regression.RandomForestRegressor`` (reference
+    ``regression.py:802-973``)."""
+
+    _is_classification = False
+    _default_impurity = "variance"
+
+    @classmethod
+    def _param_value_mapping(cls) -> Dict[str, Callable[[Any], Any]]:
+        m = dict(super()._param_value_mapping())
+
+        def _crit(v: str) -> str:
+            if v != "variance":
+                raise ValueError(f"Unsupported impurity for regression: {v!r}")
+            return v
+
+        m["split_criterion"] = _crit
+        return m
+
+    def _process_labels(self, y_host: np.ndarray) -> int:
+        if y_host.size == 0:
+            raise ValueError("Labels column is empty")
+        return 3  # (weight, w*y, w*y^2)
+
+    def _label_stats(self, y: jax.Array, n_stats: int) -> jax.Array:
+        yf = y.astype(jnp.float32)
+        return jnp.stack([jnp.ones_like(yf), yf, yf * yf], axis=1)
+
+    def _impurity_name(self, params: Dict[str, Any]) -> str:
+        return "variance"
+
+    def _create_model(self, result: Dict[str, Any]) -> "RandomForestRegressionModel":
+        return RandomForestRegressionModel(**result)
+
+
+class RandomForestRegressionModel(_RandomForestModel):
+    """Reference ``regression.py:976-1068``."""
+
+    def _leaf_means(self) -> np.ndarray:
+        ls = self._leaf_stats_arr
+        return (ls[:, :, 1] / np.maximum(ls[:, :, 0], 1e-12)).astype(np.float32)
+
+    def _get_tpu_transform_func(
+        self, dataset: Optional[DataFrame] = None
+    ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        pred_col = self.getOrDefault("predictionCol")
+        feat = jnp.asarray(self._features_arr)
+        thr = self._thresholds_arr
+        leafv = jnp.asarray(self._leaf_means())
+        depth = self._max_depth_built
+
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            pred = rf_regress(
+                jnp.asarray(Xb), feat, jnp.asarray(thr, Xb.dtype), leafv,
+                max_depth=depth,
+            )
+            return {pred_col: np.asarray(pred)}
+
+        return _fn
+
+    def predict(self, vector: Any) -> float:
+        x = np.asarray(vector, dtype=np.float32).reshape(1, -1)
+        fn = self._get_tpu_transform_func()
+        return float(fn(x)[self.getOrDefault("predictionCol")][0])
+
+    def _transformEvaluate(self, dataset: DataFrame, evaluator: Any) -> List[float]:
+        from ..evaluation import RegressionEvaluator
+        from ..metrics import RegressionMetrics
+
+        if not isinstance(evaluator, RegressionEvaluator):
+            raise NotImplementedError(
+                f"Evaluator {type(evaluator).__name__} is not supported"
+            )
+        X = self._extract_features_for_transform(dataset)
+        y = np.asarray(dataset.column(evaluator.getLabelCol()), dtype=np.float64)
+        return [
+            RegressionMetrics.from_predictions(
+                y,
+                m._apply_batched(m._get_tpu_transform_func(dataset), X)[
+                    m.getOrDefault("predictionCol")
+                ],
+            ).evaluate(evaluator)
+            for m in self._eval_models()
+        ]

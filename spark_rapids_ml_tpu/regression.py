@@ -2,5 +2,11 @@
 ``spark_rapids_ml.regression`` (``/root/reference/python/src/spark_rapids_ml/regression.py``)."""
 
 from .models.regression import LinearRegression, LinearRegressionModel
+from .models.tree import RandomForestRegressionModel, RandomForestRegressor
 
-__all__ = ["LinearRegression", "LinearRegressionModel"]
+__all__ = [
+    "LinearRegression",
+    "LinearRegressionModel",
+    "RandomForestRegressor",
+    "RandomForestRegressionModel",
+]

@@ -1,0 +1,313 @@
+"""RandomForest tests: toy exactness, sklearn compat oracles, param
+mapping, persistence (reference test model:
+``/root/reference/python/tests/test_random_forest.py``)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import (
+    RandomForestClassificationModel,
+    RandomForestClassifier,
+)
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.regression import (
+    RandomForestRegressionModel,
+    RandomForestRegressor,
+)
+
+
+def _blobs(n=600, d=8, k=3, seed=0, spread=0.4):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)) * 4
+    labels = rng.integers(0, k, size=n)
+    X = centers[labels] + spread * rng.normal(size=(n, d))
+    return X.astype(np.float32), labels.astype(np.float64)
+
+
+def _regression_data(n=800, d=6, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, size=(n, d))
+    y = np.sin(X[:, 0]) * 3 + X[:, 1] ** 2 + 0.5 * X[:, 2] + 0.05 * rng.normal(size=n)
+    return X.astype(np.float32), y.astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# classifier
+# ---------------------------------------------------------------------------
+
+
+def test_rfc_toy_separable():
+    X = np.array(
+        [[0.0, 0.0], [0.2, 0.1], [0.1, 0.3], [5.0, 5.0], [5.2, 5.1], [5.1, 4.9]],
+        dtype=np.float32,
+    )
+    y = np.array([0, 0, 0, 1, 1, 1], dtype=np.float64)
+    df = DataFrame({"features": X, "label": y})
+    model = RandomForestClassifier(
+        numTrees=5, maxDepth=3, seed=7, num_workers=1
+    ).fit(df)
+    out = model.transform(df)
+    np.testing.assert_array_equal(out["prediction"], y)
+    probs = out["probability"]
+    assert probs.shape == (6, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    # raw = sum of per-tree votes; scales with numTrees
+    np.testing.assert_allclose(out["rawPrediction"].sum(axis=1), 5.0, atol=1e-4)
+
+
+@pytest.mark.compat
+def test_rfc_matches_sklearn_accuracy(n_workers):
+    X, y = _blobs(n=900, d=10, k=3, spread=1.5)
+    n_train = 700
+    df = DataFrame({"features": X[:n_train], "label": y[:n_train]})
+    model = RandomForestClassifier(
+        numTrees=30, maxDepth=8, seed=3, num_workers=n_workers
+    ).fit(df)
+    test_df = DataFrame({"features": X[n_train:]})
+    pred = model.transform(test_df)["prediction"]
+    acc = (pred == y[n_train:]).mean()
+
+    from sklearn.ensemble import RandomForestClassifier as SkRF
+
+    sk = SkRF(n_estimators=30, max_depth=8, random_state=0).fit(X[:n_train], y[:n_train])
+    sk_acc = sk.score(X[n_train:], y[n_train:])
+    assert acc >= sk_acc - 0.05, f"acc {acc} vs sklearn {sk_acc}"
+
+
+def test_rfc_multiclass_probabilities():
+    X, y = _blobs(n=500, d=6, k=4, spread=0.5)
+    df = DataFrame({"features": X, "label": y})
+    model = RandomForestClassifier(numTrees=10, maxDepth=6, seed=1, num_workers=2).fit(df)
+    assert model.numClasses == 4
+    out = model.transform(df)
+    assert out["probability"].shape == (500, 4)
+    np.testing.assert_allclose(out["probability"].sum(axis=1), 1.0, atol=1e-5)
+    acc = (out["prediction"] == y).mean()
+    assert acc > 0.95
+    # single-row API
+    p = model.predictProbability(X[0])
+    assert p.shape == (4,)
+    assert model.predict(X[0]) == out["prediction"][0]
+
+
+def test_rfc_entropy_impurity():
+    X, y = _blobs(n=300, d=5, k=2, spread=0.5)
+    df = DataFrame({"features": X, "label": y})
+    model = RandomForestClassifier(
+        numTrees=8, maxDepth=5, impurity="entropy", seed=2, num_workers=1
+    ).fit(df)
+    acc = (model.transform(df)["prediction"] == y).mean()
+    assert acc > 0.95
+
+
+def test_rfc_feature_importances_identify_signal():
+    rng = np.random.default_rng(5)
+    n = 800
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 2] > 0).astype(np.float64)  # only feature 2 matters
+    df = DataFrame({"features": X, "label": y})
+    model = RandomForestClassifier(
+        numTrees=10, maxDepth=4, seed=0, num_workers=1, featureSubsetStrategy="all"
+    ).fit(df)
+    imp = model.featureImportances
+    assert imp.shape == (6,)
+    np.testing.assert_allclose(imp.sum(), 1.0, atol=1e-6)
+    assert np.argmax(imp) == 2 and imp[2] > 0.8
+
+
+def test_rfc_labels_must_be_integers():
+    X = np.random.default_rng(0).normal(size=(20, 3)).astype(np.float32)
+    y = np.linspace(0, 1, 20)
+    df = DataFrame({"features": X, "label": y})
+    with pytest.raises(RuntimeError, match="non-negative integers"):
+        RandomForestClassifier(numTrees=2, num_workers=1).fit(df)
+
+
+def test_rfc_persistence_roundtrip(tmp_path):
+    X, y = _blobs(n=200, d=4, k=2)
+    df = DataFrame({"features": X, "label": y})
+    model = RandomForestClassifier(numTrees=6, maxDepth=4, seed=9, num_workers=1).fit(df)
+    path = str(tmp_path / "rfc_model")
+    model.save(path)
+    loaded = RandomForestClassificationModel.load(path)
+    assert loaded.numClasses == model.numClasses
+    assert loaded.getNumTrees() == 6
+    np.testing.assert_array_equal(
+        loaded.transform(df)["prediction"], model.transform(df)["prediction"]
+    )
+
+
+def test_rfc_deterministic_given_seed():
+    X, y = _blobs(n=300, d=5, k=2)
+    df = DataFrame({"features": X, "label": y})
+    m1 = RandomForestClassifier(numTrees=4, maxDepth=4, seed=11, num_workers=2).fit(df)
+    m2 = RandomForestClassifier(numTrees=4, maxDepth=4, seed=11, num_workers=2).fit(df)
+    np.testing.assert_array_equal(m1._features_arr, m2._features_arr)
+    np.testing.assert_array_equal(m1._thresholds_arr, m2._thresholds_arr)
+
+
+def test_rfc_param_mapping():
+    est = RandomForestClassifier(
+        numTrees=7, maxDepth=3, maxBins=16, impurity="entropy", seed=5,
+        minInstancesPerNode=2, num_workers=1,
+    )
+    assert est._tpu_params["n_estimators"] == 7
+    assert est._tpu_params["max_depth"] == 3
+    assert est._tpu_params["n_bins"] == 16
+    assert est._tpu_params["split_criterion"] == "entropy"
+    assert est._tpu_params["random_state"] == 5
+    assert est._tpu_params["min_samples_leaf"] == 2
+    # featureSubsetStrategy value mapping (reference tree.py:93-110)
+    est2 = RandomForestClassifier(featureSubsetStrategy="onethird")
+    assert abs(est2._tpu_params["max_features"] - 1 / 3) < 1e-9
+    est3 = RandomForestClassifier(featureSubsetStrategy="0.5")
+    assert est3._tpu_params["max_features"] == 0.5
+    est4 = RandomForestClassifier(featureSubsetStrategy="3")
+    assert est4._tpu_params["max_features"] == 3
+    with pytest.raises(ValueError):
+        RandomForestClassifier(featureSubsetStrategy="bogus")
+    with pytest.raises(ValueError):
+        RandomForestClassifier(impurity="variance")
+    # unsupported params raise (None-mapped)
+    with pytest.raises(ValueError):
+        RandomForestClassifier(weightCol="w")
+
+
+def test_rfc_ignored_params_accepted():
+    # ""-mapped params are accepted silently (reference params.py:96-124)
+    est = RandomForestClassifier(subsamplingRate=0.5, maxMemoryInMB=128, checkpointInterval=5)
+    assert "subsamplingRate" not in est._tpu_params
+
+
+# ---------------------------------------------------------------------------
+# regressor
+# ---------------------------------------------------------------------------
+
+
+def test_rfr_toy_step_function():
+    X = np.array([[0.0], [0.1], [0.2], [5.0], [5.1], [5.2]], dtype=np.float32)
+    y = np.array([1.0, 1.0, 1.0, 9.0, 9.0, 9.0])
+    df = DataFrame({"features": X, "label": y})
+    model = RandomForestRegressor(
+        numTrees=5, maxDepth=2, bootstrap=False, seed=0, num_workers=1
+    ).fit(df)
+    pred = model.transform(df)["prediction"]
+    np.testing.assert_allclose(pred, y, atol=1e-5)
+
+
+@pytest.mark.compat
+def test_rfr_matches_sklearn_r2(n_workers):
+    X, y = _regression_data(n=1000, d=6)
+    n_train = 800
+    df = DataFrame({"features": X[:n_train], "label": y[:n_train]})
+    model = RandomForestRegressor(
+        numTrees=30, maxDepth=8, seed=2, num_workers=n_workers,
+        featureSubsetStrategy="all",
+    ).fit(df)
+    pred = model.transform(DataFrame({"features": X[n_train:]}))["prediction"]
+    yt = y[n_train:]
+    r2 = 1 - ((pred - yt) ** 2).sum() / ((yt - yt.mean()) ** 2).sum()
+
+    from sklearn.ensemble import RandomForestRegressor as SkRF
+
+    sk = SkRF(n_estimators=30, max_depth=8, random_state=0).fit(X[:n_train], y[:n_train])
+    sk_r2 = sk.score(X[n_train:], yt)
+    assert r2 >= sk_r2 - 0.1, f"r2 {r2} vs sklearn {sk_r2}"
+
+
+def test_rfr_min_instances_per_node():
+    X, y = _regression_data(n=200, d=3)
+    df = DataFrame({"features": X, "label": y})
+    model = RandomForestRegressor(
+        numTrees=3, maxDepth=8, minInstancesPerNode=50, bootstrap=False,
+        seed=1, num_workers=1,
+    ).fit(df)
+    # every leaf must hold >= 50 rows
+    feat = model._features_arr
+    counts = model._leaf_counts()
+    reachable_leaf = (feat < 0) & (counts > 0)
+    assert counts[reachable_leaf].min() >= 50
+
+
+def test_rfr_persistence_roundtrip(tmp_path):
+    X, y = _regression_data(n=150, d=4)
+    df = DataFrame({"features": X, "label": y})
+    model = RandomForestRegressor(numTrees=4, maxDepth=3, seed=3, num_workers=1).fit(df)
+    path = str(tmp_path / "rfr_model")
+    model.save(path)
+    loaded = RandomForestRegressionModel.load(path)
+    np.testing.assert_allclose(
+        loaded.transform(df)["prediction"], model.transform(df)["prediction"],
+        rtol=1e-6,
+    )
+
+
+def test_rf_fit_multiple_single_pass():
+    X, y = _blobs(n=300, d=5, k=2)
+    df = DataFrame({"features": X, "label": y})
+    est = RandomForestClassifier(numTrees=4, maxDepth=3, seed=0, num_workers=1)
+    maps = [{"numTrees": 2}, {"numTrees": 6}]
+    models = dict(est.fitMultiple(df, maps))
+    assert models[0].getNumTrees() == 2
+    assert models[1].getNumTrees() == 6
+
+
+def test_rf_trees_export():
+    X, y = _blobs(n=100, d=3, k=2)
+    df = DataFrame({"features": X, "label": y})
+    model = RandomForestClassifier(numTrees=2, maxDepth=2, seed=0, num_workers=1).fit(df)
+    trees = model.trees
+    assert len(trees) == 2
+    root = trees[0]
+    assert "split_feature" in root or "leaf_value" in root
+    assert model.totalNumNodes >= 2
+    assert model.treeWeights == [1.0, 1.0]
+
+
+def test_rf_cross_validator_single_pass():
+    """RF must ride the CV fast path (fitMultiple + _combine +
+    _transformEvaluate), like the reference (tree.py:600, classification.py:505)."""
+    from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+    from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    X, y = _blobs(n=400, d=5, k=2, spread=2.0)
+    df = DataFrame({"features": X, "label": y})
+    est = RandomForestClassifier(seed=1, num_workers=1)
+    eva = MulticlassClassificationEvaluator(metricName="accuracy")
+    assert est._supportsTransformEvaluate(eva)
+    grid = (
+        ParamGridBuilder()
+        .addGrid(est.getParam("maxDepth"), [2, 6])
+        .addGrid(est.getParam("numTrees"), [5])
+        .build()
+    )
+    cv_model = CrossValidator(
+        estimator=est, estimatorParamMaps=grid, evaluator=eva, numFolds=3, seed=2
+    ).fit(df)
+    assert len(cv_model.avgMetrics) == 2
+    assert max(cv_model.avgMetrics) > 0.7
+
+
+def test_rf_combine_evaluates_each_submodel():
+    from spark_rapids_ml_tpu.evaluation import RegressionEvaluator
+
+    X, yr = _regression_data(n=300, d=4)
+    df = DataFrame({"features": X, "label": yr})
+    est = RandomForestRegressor(seed=0, num_workers=1, featureSubsetStrategy="all")
+    m_deep = est.fit(df, {"maxDepth": 8, "numTrees": 10})
+    m_stump = est.fit(df, {"maxDepth": 1, "numTrees": 2})
+    combined = type(m_deep)._combine([m_deep, m_stump])
+    eva = RegressionEvaluator(metricName="rmse")
+    rmses = combined._transformEvaluate(df, eva)
+    assert len(rmses) == 2
+    assert rmses[0] < rmses[1]  # deeper forest fits train data better
+
+
+def test_rf_maxbins_clamped_to_uint8_range():
+    X, y = _blobs(n=400, d=3, k=2)
+    df = DataFrame({"features": X, "label": y})
+    model = RandomForestClassifier(
+        numTrees=2, maxDepth=3, maxBins=500, seed=0, num_workers=1
+    ).fit(df)
+    acc = (model.transform(df)["prediction"] == y).mean()
+    assert acc > 0.9
