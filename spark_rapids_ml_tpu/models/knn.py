@@ -1,0 +1,271 @@
+"""Exact NearestNeighbors — no Spark ML equivalent; API-parity with the
+reference's ``spark_rapids_ml.knn`` (``/root/reference/python/src/spark_rapids_ml/knn.py``).
+
+Contract parity:
+* ``fit(item_df)`` only captures the item DataFrame (reference
+  ``knn.py:297-317`` — no compute at fit time);
+* ``kneighbors(query_df)`` -> ``(item_df_withid, query_df_withid, knn_df)``
+  with knn_df columns ``(query_<id>, indices, distances)`` sorted by query
+  id (reference ``knn.py:412-467``); euclidean distances, float32;
+* ``exactNearestNeighborsJoin(query_df, distCol)`` explodes the knn result
+  into one row per (item, query) pair (reference ``knn.py:612-680``; struct
+  columns are flattened to ``item_<col>`` / ``query_<col>`` prefixes since
+  this DataFrame has no struct type);
+* no persistence — ``write``/``read`` raise (reference ``knn.py:334-343``).
+
+The compute path replaces the reference's UCX endpoint exchange with the
+``ops/knn_kernels.ring_knn`` ppermute ring.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import _TpuEstimator, _TpuModel
+from ..data.dataframe import DataFrame
+from ..params import Params, TypeConverters, _TpuParams, _mk
+from ..parallel.mesh import make_mesh, shard_rows
+from ..ops.knn_kernels import ring_knn
+from ..utils.logging import get_logger
+
+_DEFAULT_ID_COL = "unique_id"
+
+
+class NearestNeighborsClass:
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        return {"k": "n_neighbors"}
+
+    @classmethod
+    def _param_value_mapping(cls) -> Dict[str, Callable[[Any], Any]]:
+        return {}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {"n_neighbors": 5}
+
+
+class _NearestNeighborsParams(Params):
+    k = _mk("k", "number of nearest neighbors", TypeConverters.toInt)
+    inputCol = _mk("inputCol", "features column (vector/array)", TypeConverters.toString)
+    inputCols = _mk("inputCols", "scalar feature columns", TypeConverters.toListString)
+    idCol = _mk("idCol", "row id column", TypeConverters.toString)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(k=5, inputCol="features")
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def setK(self, value: int) -> "_NearestNeighborsParams":
+        self._set_params(k=value)  # type: ignore[attr-defined]
+        return self
+
+    def setInputCol(self, value: Union[str, List[str]]) -> "_NearestNeighborsParams":
+        if isinstance(value, (list, tuple)):
+            self._set(inputCols=list(value))
+        else:
+            self._set(inputCol=value)
+        return self
+
+    def setInputCols(self, value: List[str]) -> "_NearestNeighborsParams":
+        self._set(inputCols=value)
+        return self
+
+    def setIdCol(self, value: str) -> "_NearestNeighborsParams":
+        self._set(idCol=value)
+        return self
+
+    def getIdCol(self) -> str:
+        return (
+            self.getOrDefault("idCol") if self.isDefined("idCol") else _DEFAULT_ID_COL
+        )
+
+    def _ensureIdCol(self, df: DataFrame) -> DataFrame:
+        """Add a monotonically-increasing id column when the user didn't set
+        one (reference ``knn.py:135-152``)."""
+        if self.isDefined("idCol"):
+            id_col = self.getOrDefault("idCol")
+            if id_col not in df:
+                raise ValueError(f"idCol {id_col!r} not in DataFrame columns {df.columns}")
+            return df
+        if _DEFAULT_ID_COL in df:
+            return df
+        return df.withColumn(_DEFAULT_ID_COL, np.arange(df.count(), dtype=np.int64))
+
+    def _resolve_features(self, df: DataFrame) -> np.ndarray:
+        # single resolution path shared with the whole framework
+        # (core._resolve_feature_matrix); kNN is float32-only (reference
+        # ``knn.py:289-292``)
+        from ..core import _resolve_feature_matrix
+
+        X, X_sparse = _resolve_feature_matrix(self, df)
+        if X is None:
+            X = np.asarray(X_sparse.todense())
+        return np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+
+
+class NearestNeighbors(NearestNeighborsClass, _TpuEstimator, _NearestNeighborsParams):
+    """``NearestNeighbors(k=3).fit(item_df).kneighbors(query_df)`` — exact
+    brute-force kNN (reference ``knn.py:154-343``)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        _TpuEstimator.__init__(self)
+        _NearestNeighborsParams.__init__(self)
+        if kwargs.pop("float32_inputs", True) is False:
+            self.logger.warning(
+                "This estimator does not support double precision inputs; ignoring"
+            )
+        self._set_params(**kwargs)
+
+    def fit(self, dataset: DataFrame, params: Optional[Dict[Any, Any]] = None) -> "NearestNeighborsModel":
+        if params:
+            est = self.copy()
+            self._copy_tpu_params(est)
+            kw = {p.name if hasattr(p, "name") else p: v for p, v in params.items()}
+            est._set_params(**kw)
+            return est.fit(dataset)
+        # no compute at fit time (reference ``knn.py:297-317``)
+        item_df_withid = self._ensureIdCol(dataset)
+        model = NearestNeighborsModel(item_df=item_df_withid)
+        self._copyValues(model)
+        self._copy_tpu_params(model)
+        return model
+
+    def _fit(self, dataset: DataFrame) -> "NearestNeighborsModel":
+        return self.fit(dataset)
+
+    def _get_tpu_fit_func(self, dataset: DataFrame):  # pragma: no cover
+        raise NotImplementedError("NearestNeighbors overrides fit directly")
+
+    def _create_model(self, result: Dict[str, Any]):  # pragma: no cover
+        raise NotImplementedError("NearestNeighbors overrides fit directly")
+
+    def write(self) -> Any:
+        raise NotImplementedError(
+            "NearestNeighbors does not support saving/loading, just re-create the estimator."
+        )
+
+    @classmethod
+    def read(cls) -> Any:
+        raise NotImplementedError(
+            "NearestNeighbors does not support saving/loading, just re-create the estimator."
+        )
+
+
+class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsParams):
+    """Reference ``knn.py:346-690``. Holds the item DataFrame; ``kneighbors``
+    runs the distributed ring search."""
+
+    def __init__(self, item_df: DataFrame, **attrs: Any) -> None:
+        _TpuModel.__init__(self, **attrs)
+        _NearestNeighborsParams.__init__(self)
+        self._item_df_withid = item_df
+
+    # -- core search -------------------------------------------------------
+    def kneighbors(
+        self, query_df: DataFrame
+    ) -> Tuple[DataFrame, DataFrame, DataFrame]:
+        k = self.getK()
+        item_df = self._item_df_withid
+        n_items = item_df.count()
+        if k > n_items:
+            raise ValueError(f"k={k} must be <= number of item rows {n_items}")
+        query_df_withid = self._ensureIdCol(query_df)
+        id_col = self.getIdCol()
+
+        Xi = self._resolve_features(item_df)
+        Xq = self._resolve_features(query_df_withid)
+        if Xi.shape[1] != Xq.shape[1]:
+            raise ValueError(
+                f"item/query dims differ: {Xi.shape[1]} vs {Xq.shape[1]}"
+            )
+
+        mesh = make_mesh(self.num_workers)
+        Xi_d, mi_d = shard_rows(Xi, mesh)
+        Xq_d, _ = shard_rows(Xq, mesh)
+        row_ids = np.arange(Xi_d.shape[0], dtype=np.int32)
+        ids_d, _ = shard_rows(row_ids, mesh)
+
+        d2, idx = ring_knn(Xq_d, Xi_d, mi_d, ids_d, mesh=mesh, k=k)
+        nq = Xq.shape[0]
+        d2 = np.asarray(d2)[:nq]
+        idx = np.asarray(idx)[:nq]
+
+        distances = np.sqrt(np.maximum(d2, 0.0)).astype(np.float32)
+        item_ids = np.asarray(item_df.column(id_col))
+        indices = item_ids[np.clip(idx, 0, n_items - 1)]
+
+        query_ids = np.asarray(query_df_withid.column(id_col))
+        order = np.argsort(query_ids, kind="stable")
+        knn_df = DataFrame(
+            {
+                f"query_{id_col}": query_ids[order],
+                "indices": indices[order],
+                "distances": distances[order],
+            }
+        )
+        return item_df, query_df_withid, knn_df
+
+    def exactNearestNeighborsJoin(
+        self, query_df: DataFrame, distCol: str = "distCol"
+    ) -> DataFrame:
+        id_col = self.getIdCol()
+        item_df_withid, query_df_withid, knn_df = self.kneighbors(query_df)
+        k = self.getK()
+
+        query_ids = np.asarray(knn_df.column(f"query_{id_col}"))
+        indices = np.asarray(knn_df.column("indices"))      # (nq, k)
+        distances = np.asarray(knn_df.column("distances"))  # (nq, k)
+
+        flat_query = np.repeat(query_ids, k)
+        flat_item = indices.reshape(-1)
+        flat_dist = distances.reshape(-1)
+
+        # join back full item/query rows by id (reference joins struct
+        # columns, ``knn.py:655-668``; flattened to prefixed columns here)
+        def _positions(ids: np.ndarray, values: np.ndarray) -> np.ndarray:
+            order = np.argsort(ids, kind="stable")
+            return order[np.searchsorted(ids[order], values)]
+
+        item_rows = _positions(np.asarray(item_df_withid.column(id_col)), flat_item)
+        query_rows = _positions(np.asarray(query_df_withid.column(id_col)), flat_query)
+
+        drop_generated = not self.isDefined("idCol")
+        data: Dict[str, Any] = {}
+        for c in item_df_withid.columns:
+            if drop_generated and c == _DEFAULT_ID_COL:
+                continue
+            data[f"item_{c}"] = np.asarray(item_df_withid.column(c))[item_rows]
+        for c in query_df_withid.columns:
+            if drop_generated and c == _DEFAULT_ID_COL:
+                continue
+            data[f"query_{c}"] = np.asarray(query_df_withid.column(c))[query_rows]
+        data[distCol] = flat_dist
+        return DataFrame(data)
+
+    # -- unsupported surfaces (parity with reference) ----------------------
+    def transform(self, dataset: DataFrame) -> DataFrame:
+        raise NotImplementedError(
+            "NearestNeighborsModel does not provide transform; use kneighbors instead."
+        )
+
+    def _get_tpu_transform_func(self, dataset: Optional[DataFrame] = None):  # pragma: no cover
+        raise NotImplementedError("use kneighbors")
+
+    def write(self) -> Any:
+        raise NotImplementedError(
+            "NearestNeighborsModel does not support saving/loading, just re-fit the estimator to re-create a model."
+        )
+
+    @classmethod
+    def read(cls) -> Any:
+        raise NotImplementedError(
+            "NearestNeighborsModel does not support saving/loading, just re-fit the estimator to re-create a model."
+        )

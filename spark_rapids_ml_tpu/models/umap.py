@@ -1,0 +1,372 @@
+"""UMAP — API parity with the reference's ``spark_rapids_ml.umap``
+(``/root/reference/python/src/spark_rapids_ml/umap.py``, 1327 LoC).
+
+Architecture parity:
+* fit is **single-host** (the reference coalesces to one partition,
+  ``umap.py:830-909``), optionally on a ``sample_fraction`` subsample;
+* the model holds the embedding + raw training data (the reference
+  broadcasts both in chunks, ``umap.py:873-895``); transform is
+  embarrassingly parallel over query batches (``umap.py:1149-1230``);
+* the 18-param surface matches ``umap.py:148-341``.
+
+Compute path (``ops/umap_kernels.py``): brute-force kNN graph → fuzzy
+simplicial set (host scipy symmetrization) → spectral/random init →
+negative-sampling SGD, jitted end-to-end. Transform embeds new points by
+membership-weighted neighbor averaging refined with the same SGD against
+the frozen training embedding (cuML's transform algorithm).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import _TpuEstimator, _TpuModel
+from ..data.dataframe import DataFrame
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    HasOutputCol,
+    TypeConverters,
+    _mk,
+)
+from ..ops.kmeans_kernels import pairwise_sq_dists
+from ..ops.umap_kernels import (
+    default_n_epochs,
+    find_ab_params,
+    fuzzy_simplicial_set,
+    membership_strengths,
+    optimize_embedding,
+    smooth_knn_dist,
+    spectral_init,
+)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "qchunk"))
+def knn_brute(X: jax.Array, Xq: jax.Array, *, k: int, qchunk: int = 4096):
+    """Single-host brute-force kNN: (dists ascending, indices), (nq, k)."""
+    nq = Xq.shape[0]
+    pad = (-nq) % qchunk
+    Xqp = jnp.pad(Xq, ((0, pad), (0, 0)))
+    chunks = Xqp.reshape(-1, qchunk, Xq.shape[1])
+
+    def body(_, xc):
+        d2 = pairwise_sq_dists(xc, X)
+        negd, idx = lax.top_k(-d2, k)
+        return None, (-negd, idx)
+
+    _, (d2, idx) = lax.scan(body, None, chunks)
+    d2 = d2.reshape(-1, k)[:nq]
+    idx = idx.reshape(-1, k)[:nq]
+    return jnp.sqrt(jnp.maximum(d2, 0.0)), idx
+
+
+class UMAPClass:
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        # all params are dedicated, names identical on both sides (reference
+        # ``umap.py:92-94``); identity-mapped so ``_set_params`` syncs them
+        # into ``_tpu_params``
+        return {
+            name: name
+            for name in (
+                "n_neighbors", "n_components", "metric", "n_epochs",
+                "learning_rate", "init", "min_dist", "spread",
+                "set_op_mix_ratio", "local_connectivity", "repulsion_strength",
+                "negative_sample_rate", "transform_queue_size", "a", "b",
+                "random_state",
+            )
+        }
+
+    @classmethod
+    def _param_value_mapping(cls) -> Dict[str, Callable[[Any], Any]]:
+        def _metric(v: str) -> str:
+            if v != "euclidean":
+                raise ValueError(
+                    f"Only the euclidean metric is supported, got {v!r}"
+                )
+            return v
+
+        def _init(v: str) -> str:
+            if v not in ("spectral", "random"):
+                raise ValueError(f"Unsupported init: {v!r}")
+            return v
+
+        return {"metric": _metric, "init": _init}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        # reference ``umap.py:96-118`` (cuML defaults)
+        return {
+            "n_neighbors": 15,
+            "n_components": 2,
+            "metric": "euclidean",
+            "n_epochs": None,
+            "learning_rate": 1.0,
+            "init": "spectral",
+            "min_dist": 0.1,
+            "spread": 1.0,
+            "set_op_mix_ratio": 1.0,
+            "local_connectivity": 1.0,
+            "repulsion_strength": 1.0,
+            "negative_sample_rate": 5,
+            "transform_queue_size": 4.0,
+            "a": None,
+            "b": None,
+            "random_state": None,
+        }
+
+
+class _UMAPParams(HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasOutputCol):
+    n_neighbors = _mk("n_neighbors", "local neighborhood size", TypeConverters.toFloat)
+    n_components = _mk("n_components", "embedding dimension", TypeConverters.toInt)
+    metric = _mk("metric", "distance metric (euclidean)", TypeConverters.toString)
+    n_epochs = _mk("n_epochs", "optimization epochs", TypeConverters.toInt)
+    learning_rate = _mk("learning_rate", "initial SGD alpha", TypeConverters.toFloat)
+    init = _mk("init", "embedding init: spectral | random", TypeConverters.toString)
+    min_dist = _mk("min_dist", "min embedded point spacing", TypeConverters.toFloat)
+    spread = _mk("spread", "embedded cluster scale", TypeConverters.toFloat)
+    set_op_mix_ratio = _mk("set_op_mix_ratio", "union/intersection mix", TypeConverters.toFloat)
+    local_connectivity = _mk("local_connectivity", "assumed local connectivity", TypeConverters.toFloat)
+    repulsion_strength = _mk("repulsion_strength", "negative-sample gamma", TypeConverters.toFloat)
+    negative_sample_rate = _mk("negative_sample_rate", "negatives per positive", TypeConverters.toInt)
+    transform_queue_size = _mk("transform_queue_size", "transform search factor (ignored: search is exact)", TypeConverters.toFloat)
+    a = _mk("a", "curve param a (None: from min_dist/spread)", TypeConverters.toFloat)
+    b = _mk("b", "curve param b (None: from min_dist/spread)", TypeConverters.toFloat)
+    random_state = _mk("random_state", "random seed", TypeConverters.toInt)
+    sample_fraction = _mk("sample_fraction", "fit subsample fraction", TypeConverters.toFloat)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(
+            n_neighbors=15.0,
+            n_components=2,
+            metric="euclidean",
+            learning_rate=1.0,
+            init="spectral",
+            min_dist=0.1,
+            spread=1.0,
+            set_op_mix_ratio=1.0,
+            local_connectivity=1.0,
+            repulsion_strength=1.0,
+            negative_sample_rate=5,
+            transform_queue_size=4.0,
+            sample_fraction=1.0,
+            outputCol="embedding",
+        )
+
+    def getNNeighbors(self) -> float:
+        return self.getOrDefault("n_neighbors")
+
+    def setNNeighbors(self, value: float) -> "_UMAPParams":
+        self._set_params(n_neighbors=value)  # type: ignore[attr-defined]
+        return self
+
+    def getNComponents(self) -> int:
+        return self.getOrDefault("n_components")
+
+    def setNComponents(self, value: int) -> "_UMAPParams":
+        self._set_params(n_components=value)  # type: ignore[attr-defined]
+        return self
+
+    def getSampleFraction(self) -> float:
+        return self.getOrDefault("sample_fraction")
+
+    def setSampleFraction(self, value: float) -> "_UMAPParams":
+        self._set_params(sample_fraction=value)  # type: ignore[attr-defined]
+        return self
+
+    def setOutputCol(self, value: str) -> "_UMAPParams":
+        self._set(outputCol=value)
+        return self
+
+    def setFeaturesCol(self, value: Union[str, List[str]]) -> "_UMAPParams":
+        if isinstance(value, (list, tuple)):
+            self._set(featuresCols=list(value))
+        else:
+            self._set(featuresCol=value)
+        return self
+
+    def _resolve_features(self, df: DataFrame) -> np.ndarray:
+        # single resolution path shared with the whole framework
+        # (core._resolve_feature_matrix); UMAP compute is float32
+        from ..core import _resolve_feature_matrix
+
+        X, X_sparse = _resolve_feature_matrix(self, df)
+        if X is None:
+            X = np.asarray(X_sparse.todense())
+        return np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+
+
+class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
+    """``UMAP(n_components=2).fit(df)`` — unsupervised manifold embedding
+    (reference ``umap.py:620-957``)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        _TpuEstimator.__init__(self)
+        _UMAPParams.__init__(self)
+        self._set_params(**kwargs)
+
+    def fit(self, dataset: DataFrame, params: Optional[Dict[Any, Any]] = None) -> "UMAPModel":
+        if params:
+            est = self.copy()
+            self._copy_tpu_params(est)
+            est._set_params(**{p.name if hasattr(p, "name") else p: v for p, v in params.items()})
+            return est.fit(dataset)
+        if self.isDefined("labelCol") and self.isSet("labelCol"):
+            self.logger.warning("supervised UMAP (labelCol) is not supported; ignoring")
+
+        seed = int(self._tpu_params.get("random_state") or 0)
+        frac = float(self.getSampleFraction())
+        df = dataset if frac >= 1.0 else dataset.sample(frac, seed=seed)
+        X = self._resolve_features(df)
+        n = X.shape[0]
+        k = int(self._tpu_params.get("n_neighbors", 15))
+        if k >= n:
+            raise ValueError(f"n_neighbors={k} must be < number of rows {n}")
+
+        # 1) kNN graph (k+1 including self; drop the self column)
+        Xd = jnp.asarray(X)
+        dists, idx = knn_brute(Xd, Xd, k=k + 1)
+        knn_d = np.asarray(dists)[:, 1:]
+        knn_i = np.asarray(idx)[:, 1:]
+
+        # 2) fuzzy simplicial set
+        heads, tails, weights = fuzzy_simplicial_set(
+            knn_i,
+            knn_d,
+            float(self._tpu_params.get("local_connectivity", 1.0)),
+            float(self._tpu_params.get("set_op_mix_ratio", 1.0)),
+        )
+
+        # 3) curve params + init
+        a = self._tpu_params.get("a")
+        b = self._tpu_params.get("b")
+        if a is None or b is None:
+            a, b = find_ab_params(
+                float(self._tpu_params.get("spread", 1.0)),
+                float(self._tpu_params.get("min_dist", 0.1)),
+            )
+        n_comp = int(self._tpu_params.get("n_components", 2))
+        if self._tpu_params.get("init", "spectral") == "spectral":
+            emb0 = spectral_init(heads, tails, weights, n, n_comp, seed)
+        else:
+            emb0 = (
+                np.random.default_rng(seed)
+                .uniform(-10, 10, size=(n, n_comp))
+                .astype(np.float32)
+            )
+
+        # 4) SGD
+        n_epochs = self._tpu_params.get("n_epochs") or default_n_epochs(n)
+        emb = optimize_embedding(
+            jnp.asarray(emb0),
+            jnp.asarray(emb0),
+            jnp.asarray(heads),
+            jnp.asarray(tails),
+            jnp.asarray(weights),
+            jax.random.PRNGKey(seed),
+            n_epochs=int(n_epochs),
+            n_vertices=n,
+            a=float(a),
+            b=float(b),
+            gamma=float(self._tpu_params.get("repulsion_strength", 1.0)),
+            initial_alpha=float(self._tpu_params.get("learning_rate", 1.0)),
+            negative_sample_rate=int(self._tpu_params.get("negative_sample_rate", 5)),
+            move_other=True,
+        )
+
+        model = UMAPModel(
+            embedding_=np.asarray(emb, dtype=np.float32),
+            raw_data_=X,
+            a=float(a),
+            b=float(b),
+        )
+        self._copyValues(model)
+        self._copy_tpu_params(model)
+        return model
+
+    def _get_tpu_fit_func(self, dataset: DataFrame):  # pragma: no cover
+        raise NotImplementedError("UMAP overrides fit directly")
+
+    def _create_model(self, result: Dict[str, Any]):  # pragma: no cover
+        raise NotImplementedError("UMAP overrides fit directly")
+
+
+class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
+    """Reference ``umap.py:1118-1259``. Holds (embedding, raw data); transform
+    embeds new points against the frozen training embedding."""
+
+    def __init__(self, **attrs: Any) -> None:
+        _TpuModel.__init__(self, **attrs)
+        _UMAPParams.__init__(self)
+
+    @property
+    def embedding_(self) -> np.ndarray:
+        return np.asarray(self._model_attributes["embedding_"])
+
+    @property
+    def embedding(self) -> List[List[float]]:
+        return self.embedding_.tolist()
+
+    @property
+    def raw_data_(self) -> np.ndarray:
+        return np.asarray(self._model_attributes["raw_data_"])
+
+    def _out_cols(self) -> List[str]:
+        return [self.getOrDefault("outputCol")]
+
+    def _get_tpu_transform_func(
+        self, dataset: Optional[DataFrame] = None
+    ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        out_col = self.getOrDefault("outputCol")
+        train_X = jnp.asarray(self.raw_data_)
+        train_emb = jnp.asarray(self.embedding_)
+        k = int(self._tpu_params.get("n_neighbors", 15))
+        k = min(k, train_X.shape[0])
+        a = float(self._model_attributes["a"])
+        b = float(self._model_attributes["b"])
+        seed = int(self._tpu_params.get("random_state") or 0)
+        n_epochs = int(self._tpu_params.get("n_epochs") or default_n_epochs(train_X.shape[0]))
+        refine = max(n_epochs // 3, 10)
+        lc = float(self._tpu_params.get("local_connectivity", 1.0))
+        gamma = float(self._tpu_params.get("repulsion_strength", 1.0))
+        neg = int(self._tpu_params.get("negative_sample_rate", 5))
+        alpha = float(self._tpu_params.get("learning_rate", 1.0))
+
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            nq = Xb.shape[0]
+            dists, idx = knn_brute(train_X, jnp.asarray(Xb, jnp.float32), k=k)
+            rho, sigma = smooth_knn_dist(dists, lc)
+            w = membership_strengths(dists, rho, sigma)       # (nq, k)
+            wn = w / jnp.maximum(w.sum(axis=1, keepdims=True), 1e-12)
+            emb0 = jnp.einsum("qk,qkc->qc", wn, train_emb[idx])
+            heads = jnp.repeat(jnp.arange(nq, dtype=jnp.int32), k)
+            tails = idx.reshape(-1).astype(jnp.int32)
+            weights = w.reshape(-1)
+            emb = optimize_embedding(
+                emb0,
+                train_emb,
+                heads,
+                tails,
+                weights,
+                jax.random.PRNGKey(seed),
+                n_epochs=refine,
+                n_vertices=int(train_emb.shape[0]),
+                a=a,
+                b=b,
+                gamma=gamma,
+                initial_alpha=alpha,
+                negative_sample_rate=neg,
+                move_other=False,
+            )
+            return {out_col: np.asarray(emb)}
+
+        return _fn

@@ -606,6 +606,8 @@ class _TpuParams(_TpuClass):
         input_cols: Optional[List[str]] = None
         if self.hasParam("featuresCols") and self.isSet("featuresCols"):
             input_cols = self.getOrDefault("featuresCols")
+        elif self.hasParam("inputCols") and self.isSet("inputCols"):
+            input_cols = self.getOrDefault("inputCols")
         elif self.hasParam("featuresCol") and self.isSet("featuresCol"):
             input_col = self.getOrDefault("featuresCol")
         elif self.hasParam("inputCol") and self.isSet("inputCol"):

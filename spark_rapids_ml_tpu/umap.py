@@ -1,0 +1,6 @@
+"""Drop-in module alias: ``spark_rapids_ml_tpu.umap`` ≙ reference
+``spark_rapids_ml.umap`` (``/root/reference/python/src/spark_rapids_ml/umap.py``)."""
+
+from .models.umap import UMAP, UMAPModel
+
+__all__ = ["UMAP", "UMAPModel"]

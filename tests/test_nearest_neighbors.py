@@ -1,0 +1,117 @@
+"""Exact kNN tests: sklearn oracle, id mapping, join, worker invariance
+(reference test model: ``/root/reference/python/tests/test_nearest_neighbors.py``)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.knn import NearestNeighbors, NearestNeighborsModel
+
+
+def _data(n_items=200, n_query=40, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    Xi = rng.normal(size=(n_items, d)).astype(np.float32)
+    Xq = rng.normal(size=(n_query, d)).astype(np.float32)
+    return Xi, Xq
+
+
+def _sklearn_knn(Xi, Xq, k):
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    nn = SkNN(n_neighbors=k, algorithm="brute").fit(Xi)
+    dist, idx = nn.kneighbors(Xq)
+    return dist, idx
+
+
+def test_knn_toy_exact():
+    Xi = np.array([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]], dtype=np.float32)
+    Xq = np.array([[1.0, 1.0], [3.0, 3.0]], dtype=np.float32)
+    model = NearestNeighbors(k=2, num_workers=1).fit(DataFrame({"features": Xi}))
+    item_df, query_df, knn_df = model.kneighbors(DataFrame({"features": Xq}))
+    idx = knn_df["indices"]
+    dist = knn_df["distances"]
+    np.testing.assert_array_equal(idx[0], [0, 1])
+    np.testing.assert_array_equal(idx[1], [2, 1])
+    np.testing.assert_allclose(dist[0], [0.0, np.sqrt(2)], atol=1e-6)
+    np.testing.assert_allclose(dist[1], [0.0, np.sqrt(2)], atol=1e-6)
+
+
+@pytest.mark.compat
+def test_knn_matches_sklearn(n_workers):
+    Xi, Xq = _data(n_items=317, n_query=53, d=8)  # odd sizes exercise padding
+    k = 7
+    model = NearestNeighbors(k=k, num_workers=n_workers).fit(
+        DataFrame({"features": Xi})
+    )
+    _, _, knn_df = model.kneighbors(DataFrame({"features": Xq}))
+    dist, idx = _sklearn_knn(Xi, Xq, k)
+    np.testing.assert_allclose(knn_df["distances"], dist, atol=1e-4)
+    np.testing.assert_array_equal(knn_df["indices"], idx)
+
+
+def test_knn_custom_id_col():
+    Xi, Xq = _data(n_items=50, n_query=10, d=4)
+    ids = np.arange(1000, 1050)
+    model = (
+        NearestNeighbors(k=3, num_workers=2)
+        .setIdCol("my_id")
+        .fit(DataFrame({"features": Xi, "my_id": ids}))
+    )
+    q_ids = np.arange(77, 87)
+    _, qdf, knn_df = model.kneighbors(DataFrame({"features": Xq, "my_id": q_ids}))
+    assert "query_my_id" in knn_df
+    np.testing.assert_array_equal(np.sort(knn_df["query_my_id"]), np.sort(q_ids))
+    _, sk_idx = _sklearn_knn(Xi, Xq, 3)
+    # returned indices are the user ids, not row numbers
+    order = np.argsort(knn_df["query_my_id"])
+    np.testing.assert_array_equal(knn_df["indices"][order], sk_idx + 1000)
+
+
+def test_knn_multi_col_input():
+    Xi, Xq = _data(n_items=60, n_query=12, d=3)
+    item_df = DataFrame({"f0": Xi[:, 0], "f1": Xi[:, 1], "f2": Xi[:, 2]})
+    query_df = DataFrame({"f0": Xq[:, 0], "f1": Xq[:, 1], "f2": Xq[:, 2]})
+    model = (
+        NearestNeighbors(k=4, num_workers=2)
+        .setInputCol(["f0", "f1", "f2"])
+        .fit(item_df)
+    )
+    _, _, knn_df = model.kneighbors(query_df)
+    dist, idx = _sklearn_knn(Xi, Xq, 4)
+    np.testing.assert_allclose(knn_df["distances"], dist, atol=1e-4)
+    np.testing.assert_array_equal(knn_df["indices"], idx)
+
+
+def test_knn_join():
+    Xi, Xq = _data(n_items=30, n_query=6, d=4)
+    k = 2
+    model = NearestNeighbors(k=k, num_workers=1).fit(DataFrame({"features": Xi}))
+    joined = model.exactNearestNeighborsJoin(DataFrame({"features": Xq}), distCol="d")
+    assert joined.count() == 6 * k
+    assert "d" in joined and "item_features" in joined and "query_features" in joined
+    # generated id columns are dropped when idCol was not set (reference knn.py:671-678)
+    assert "item_unique_id" not in joined
+    dist, _ = _sklearn_knn(Xi, Xq, k)
+    np.testing.assert_allclose(np.sort(joined["d"]), np.sort(dist.ravel()), atol=1e-4)
+
+
+def test_knn_k_larger_than_items_raises():
+    Xi, Xq = _data(n_items=5, n_query=2, d=3)
+    model = NearestNeighbors(k=10, num_workers=1).fit(DataFrame({"features": Xi}))
+    with pytest.raises(ValueError, match="k=10"):
+        model.kneighbors(DataFrame({"features": Xq}))
+
+
+def test_knn_no_persistence():
+    Xi, _ = _data(n_items=10, n_query=2, d=3)
+    model = NearestNeighbors(k=2, num_workers=1).fit(DataFrame({"features": Xi}))
+    with pytest.raises(NotImplementedError):
+        model.write()
+    with pytest.raises(NotImplementedError):
+        NearestNeighborsModel.read()
+
+
+def test_knn_param_mapping():
+    est = NearestNeighbors(k=9)
+    assert est._tpu_params["n_neighbors"] == 9
+    assert est.getK() == 9

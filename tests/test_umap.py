@@ -1,0 +1,123 @@
+"""UMAP tests: embedding quality (trustworthiness oracle), transform,
+persistence, params (reference test model:
+``/root/reference/python/tests/test_umap.py``, which gates on
+trustworthiness of the embedding)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.umap import UMAP, UMAPModel
+
+
+def _blobs(n=400, d=10, k=4, seed=0, spread=0.3):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)) * 5
+    labels = rng.integers(0, k, size=n)
+    X = centers[labels] + spread * rng.normal(size=(n, d))
+    return X.astype(np.float32), labels
+
+
+def _trust(X, emb, n_neighbors=15):
+    from sklearn.manifold import trustworthiness
+
+    return trustworthiness(X, emb, n_neighbors=n_neighbors)
+
+
+@pytest.mark.compat
+def test_umap_embedding_trustworthy():
+    X, labels = _blobs(n=500, d=12, k=5)
+    df = DataFrame({"features": X})
+    model = UMAP(n_neighbors=12, random_state=42, init="random", num_workers=1).fit(df)
+    emb = model.embedding_
+    assert emb.shape == (500, 2)
+    t = _trust(X, emb, n_neighbors=12)
+    assert t > 0.85, f"trustworthiness {t}"
+    # clusters must be separated in embedding space: intra-cluster distance
+    # far below inter-cluster distance
+    cents = np.stack([emb[labels == c].mean(axis=0) for c in range(5)])
+    intra = np.mean([np.linalg.norm(emb[labels == c] - cents[c], axis=1).mean() for c in range(5)])
+    inter = np.mean(
+        [np.linalg.norm(cents[i] - cents[j]) for i in range(5) for j in range(i + 1, 5)]
+    )
+    assert inter > 2 * intra
+
+
+def test_umap_spectral_init():
+    X, _ = _blobs(n=300, d=8, k=3)
+    df = DataFrame({"features": X})
+    model = UMAP(n_neighbors=10, random_state=7, init="spectral", num_workers=1).fit(df)
+    t = _trust(X, model.embedding_, n_neighbors=10)
+    assert t > 0.85
+
+
+def test_umap_transform_consistent_with_fit():
+    X, labels = _blobs(n=400, d=10, k=3, seed=3)
+    df = DataFrame({"features": X})
+    model = UMAP(n_neighbors=10, random_state=0, init="random").fit(df)
+    out = model.transform(DataFrame({"features": X[:100]}))
+    emb_new = out["embedding"]
+    assert emb_new.shape == (100, 2)
+    # transformed points must land near their fitted positions' cluster
+    emb_fit = model.embedding_[:100]
+    # same-cluster consistency: nearest fitted neighbor shares the label
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    nn = SkNN(n_neighbors=1).fit(model.embedding_)
+    _, idx = nn.kneighbors(emb_new)
+    match = (labels[idx[:, 0]] == labels[:100]).mean()
+    assert match > 0.95
+
+
+def test_umap_n_components():
+    X, _ = _blobs(n=200, d=6, k=2)
+    model = UMAP(n_components=3, n_neighbors=8, random_state=1, init="random").fit(
+        DataFrame({"features": X})
+    )
+    assert model.embedding_.shape == (200, 3)
+
+
+def test_umap_sample_fraction():
+    X, _ = _blobs(n=400, d=6, k=2)
+    model = UMAP(
+        n_neighbors=8, random_state=1, init="random", sample_fraction=0.5
+    ).fit(DataFrame({"features": X}))
+    # fit on ~half the rows
+    assert 120 < model.embedding_.shape[0] < 280
+    # transform still works for all rows
+    out = model.transform(DataFrame({"features": X}))
+    assert out["embedding"].shape == (400, 2)
+
+
+def test_umap_persistence_roundtrip(tmp_path):
+    X, _ = _blobs(n=150, d=5, k=2)
+    df = DataFrame({"features": X})
+    model = UMAP(n_neighbors=6, random_state=2, init="random").fit(df)
+    path = str(tmp_path / "umap_model")
+    model.save(path)
+    loaded = UMAPModel.load(path)
+    np.testing.assert_allclose(loaded.embedding_, model.embedding_, rtol=1e-6)
+    np.testing.assert_allclose(
+        loaded.transform(df)["embedding"], model.transform(df)["embedding"], rtol=1e-5
+    )
+
+
+def test_umap_param_surface():
+    est = UMAP(
+        n_neighbors=7, min_dist=0.2, spread=1.5, negative_sample_rate=3,
+        learning_rate=0.5, random_state=9,
+    )
+    assert est._tpu_params["n_neighbors"] == 7
+    assert est._tpu_params["min_dist"] == 0.2
+    assert est._tpu_params["negative_sample_rate"] == 3
+    assert est.getNNeighbors() == 7
+    est.setNComponents(4)
+    assert est._tpu_params["n_components"] == 4
+    with pytest.raises(ValueError):
+        UMAP(bogus=1)
+
+
+def test_umap_n_neighbors_validation():
+    X, _ = _blobs(n=10, d=4, k=2)
+    with pytest.raises(ValueError, match="n_neighbors"):
+        UMAP(n_neighbors=15).fit(DataFrame({"features": X}))
