@@ -1,0 +1,8 @@
+"""Benchmark harness for spark_rapids_ml_tpu.
+
+Mirrors the reference's ``python/benchmark/benchmark`` package
+(``/root/reference/python/benchmark/``): a per-algorithm ``BenchmarkBase``
+subclass parses CLI flags, runs fit/transform ``num_runs`` times on either
+the TPU framework or a CPU (sklearn) baseline, and appends timing + quality
+rows to a CSV report.
+"""

@@ -1,0 +1,114 @@
+"""Benchmark base class (reference ``python/benchmark/benchmark/base.py``,
+283 LoC: arg parsing at :106-137, run loop + CSV report at :221-270).
+
+Each subclass declares its algorithm params via ``add_arguments`` and
+implements ``run_once(df, transform_df) -> dict`` returning timing/quality
+metrics. ``--mode tpu`` runs the spark_rapids_ml_tpu estimator on the active
+jax backend; ``--mode cpu`` runs the sklearn equivalent (the reference's
+pyspark.ml CPU path analog) for apples-to-apples comparisons.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import datetime
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data import DataFrame
+
+from .gen_data import make_dataframe
+
+
+class BenchmarkBase:
+    name: str = "base"
+    default_dataset: str = "blobs"
+
+    def __init__(self, argv: List[str]) -> None:
+        parser = argparse.ArgumentParser(description=f"Benchmark {self.name}")
+        parser.add_argument("--mode", choices=["tpu", "cpu"], default="tpu",
+                            help="tpu = spark_rapids_ml_tpu; cpu = sklearn baseline")
+        parser.add_argument("--num_runs", type=int, default=2)
+        parser.add_argument("--num_chips", "--num_gpus", dest="num_chips", type=int,
+                            default=None, help="mesh size (default: all devices)")
+        parser.add_argument("--num_rows", type=int, default=5000)
+        parser.add_argument("--num_cols", type=int, default=3000)
+        parser.add_argument("--train_path", default=None, help="parquet input dir")
+        parser.add_argument("--transform_path", default=None)
+        parser.add_argument("--report_path", default="", help="append CSV here")
+        parser.add_argument("--random_seed", type=int, default=0)
+        self.add_arguments(parser)
+        self.args = parser.parse_args(argv)
+
+    def add_arguments(self, parser: argparse.ArgumentParser) -> None:
+        pass
+
+    # -- data --------------------------------------------------------------
+    def load_data(self) -> DataFrame:
+        a = self.args
+        if a.train_path:
+            return DataFrame.read_parquet(a.train_path)
+        return make_dataframe(
+            self.default_dataset, a.num_rows, a.num_cols, seed=a.random_seed
+        )
+
+    def load_transform_data(self, train_df: DataFrame) -> DataFrame:
+        if self.args.transform_path:
+            return DataFrame.read_parquet(self.args.transform_path)
+        return train_df
+
+    # -- execution ---------------------------------------------------------
+    def run_once(self, train_df: DataFrame, transform_df: DataFrame) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def run(self) -> None:
+        train_df = self.load_data()
+        transform_df = self.load_transform_data(train_df)
+        self._actual_rows = train_df.count()
+        self._actual_cols = (
+            train_df.column("features").shape[1] if "features" in train_df else 0
+        )
+        print(
+            f"[{self.name}] mode={self.args.mode} rows={self._actual_rows} "
+            f"cols={self._actual_cols} runs={self.args.num_runs}"
+        )
+        results: List[Dict[str, Any]] = []
+        for r in range(self.args.num_runs):
+            res = self.run_once(train_df, transform_df)
+            print(f"  run {r}: " + ", ".join(f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}" for k, v in res.items()))
+            results.append(res)
+        best = {
+            k: (min(r[k] for r in results) if k.endswith("_time") else results[-1][k])
+            for k in results[0]
+        }
+        print(f"  best: " + ", ".join(f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}" for k, v in best.items()))
+        self.report(best)
+
+    def report(self, row: Dict[str, Any]) -> None:
+        path = self.args.report_path
+        if not path:
+            return
+        exists = os.path.exists(path)
+        meta = {
+            "datetime": datetime.datetime.now().isoformat(timespec="seconds"),
+            "algorithm": self.name,
+            "mode": self.args.mode,
+            "num_rows": getattr(self, "_actual_rows", self.args.num_rows),
+            "num_cols": getattr(self, "_actual_cols", self.args.num_cols),
+        }
+        out = {**meta, **row}
+        with open(path, "a", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(out.keys()))
+            if not exists:
+                w.writeheader()
+            w.writerow(out)
+
+    # -- helpers -----------------------------------------------------------
+    def features_and_label(self, df: DataFrame):
+        X = np.asarray(df.column("features"))
+        y = np.asarray(df.column("label")) if "label" in df else None
+        return X, y

@@ -1,0 +1,53 @@
+"""KMeans benchmark (reference ``bench_kmeans.py``; reference headline
+config: k=1000, maxIter=30, init=random, ``databricks/run_benchmark.sh:44-60``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkKMeans(BenchmarkBase):
+    name = "kmeans"
+    default_dataset = "blobs"
+
+    def add_arguments(self, parser) -> None:
+        parser.add_argument("--k", type=int, default=1000)
+        parser.add_argument("--max_iter", type=int, default=30)
+        parser.add_argument("--tol", type=float, default=1e-4)
+        parser.add_argument("--init", default="random")
+
+    def run_once(self, train_df, transform_df):
+        a = self.args
+        if a.mode == "cpu":
+            from sklearn.cluster import KMeans as SkKMeans
+
+            X, _ = self.features_and_label(train_df)
+            model, fit_t = with_benchmark(
+                "fit",
+                lambda: SkKMeans(
+                    n_clusters=a.k, max_iter=a.max_iter, tol=a.tol, n_init=1,
+                    init="random" if a.init == "random" else "k-means++",
+                    random_state=a.random_seed,
+                ).fit(X),
+            )
+            _, tr_t = with_benchmark("transform", lambda: model.predict(X))
+            cost = float(model.inertia_)
+        else:
+            from spark_rapids_ml_tpu.clustering import KMeans
+
+            est = KMeans(
+                k=a.k, maxIter=a.max_iter, tol=a.tol, initMode=a.init,
+                seed=a.random_seed, num_workers=a.num_chips,
+            )
+            model, fit_t = with_benchmark("fit", lambda: est.fit(train_df))
+            _, tr_t = with_benchmark("transform", lambda: model.transform(transform_df))
+            cost = model.trainingCost
+        return {
+            "fit_time": fit_t,
+            "transform_time": tr_t,
+            "total_time": fit_t + tr_t,
+            "training_cost": cost,
+        }

@@ -1,0 +1,51 @@
+"""LinearRegression benchmark (reference ``bench_linear_regression.py``;
+the reference sweeps 3 regularization configs, ``run_benchmark.sh:62-86``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkLinearRegression(BenchmarkBase):
+    name = "linear_regression"
+    default_dataset = "regression"
+
+    def add_arguments(self, parser) -> None:
+        parser.add_argument("--regParam", type=float, default=0.0)
+        parser.add_argument("--elasticNetParam", type=float, default=0.0)
+        parser.add_argument("--maxIter", type=int, default=100)
+
+    def run_once(self, train_df, transform_df):
+        a = self.args
+        X, y = self.features_and_label(train_df)
+        if a.mode == "cpu":
+            from sklearn.linear_model import ElasticNet, LinearRegression as SkLR, Ridge
+
+            if a.regParam == 0.0:
+                sk = SkLR()
+            elif a.elasticNetParam == 0.0:
+                sk = Ridge(alpha=a.regParam * len(y))
+            else:
+                sk = ElasticNet(alpha=a.regParam, l1_ratio=a.elasticNetParam)
+            model, fit_t = with_benchmark("fit", lambda: sk.fit(X, y))
+            pred, tr_t = with_benchmark("transform", lambda: model.predict(X))
+        else:
+            from spark_rapids_ml_tpu.regression import LinearRegression
+
+            est = LinearRegression(
+                regParam=a.regParam, elasticNetParam=a.elasticNetParam,
+                maxIter=a.maxIter, num_workers=a.num_chips,
+            )
+            model, fit_t = with_benchmark("fit", lambda: est.fit(train_df))
+            out, tr_t = with_benchmark("transform", lambda: model.transform(transform_df))
+            pred = np.asarray(out["prediction"])
+        rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+        return {
+            "fit_time": fit_t,
+            "transform_time": tr_t,
+            "total_time": fit_t + tr_t,
+            "rmse": rmse,
+        }

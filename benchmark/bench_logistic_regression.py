@@ -1,0 +1,48 @@
+"""LogisticRegression benchmark (reference ``bench_logistic_regression.py``;
+reference headline config maxIter=200, ``run_benchmark.sh:115-135``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkLogisticRegression(BenchmarkBase):
+    name = "logistic_regression"
+    default_dataset = "classification"
+
+    def add_arguments(self, parser) -> None:
+        parser.add_argument("--maxIter", type=int, default=200)
+        parser.add_argument("--regParam", type=float, default=0.0)
+        parser.add_argument("--tol", type=float, default=1e-6)
+
+    def run_once(self, train_df, transform_df):
+        a = self.args
+        X, y = self.features_and_label(train_df)
+        if a.mode == "cpu":
+            from sklearn.linear_model import LogisticRegression as SkLR
+
+            c = 1.0 / (a.regParam * len(y)) if a.regParam > 0 else 1e12
+            model, fit_t = with_benchmark(
+                "fit", lambda: SkLR(max_iter=a.maxIter, C=c, tol=a.tol).fit(X, y)
+            )
+            pred, tr_t = with_benchmark("transform", lambda: model.predict(X))
+        else:
+            from spark_rapids_ml_tpu.classification import LogisticRegression
+
+            est = LogisticRegression(
+                maxIter=a.maxIter, regParam=a.regParam, tol=a.tol,
+                num_workers=a.num_chips,
+            )
+            model, fit_t = with_benchmark("fit", lambda: est.fit(train_df))
+            out, tr_t = with_benchmark("transform", lambda: model.transform(transform_df))
+            pred = np.asarray(out["prediction"])
+        acc = float((pred == y).mean())
+        return {
+            "fit_time": fit_t,
+            "transform_time": tr_t,
+            "total_time": fit_t + tr_t,
+            "accuracy": acc,
+        }

@@ -1,0 +1,45 @@
+"""Exact kNN benchmark (reference ``bench_nearest_neighbors.py``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+from spark_rapids_ml_tpu.data import DataFrame
+
+
+class BenchmarkNearestNeighbors(BenchmarkBase):
+    name = "knn"
+    default_dataset = "blobs"
+
+    def add_arguments(self, parser) -> None:
+        parser.add_argument("--k", type=int, default=200)
+        parser.add_argument("--num_queries", type=int, default=1000)
+
+    def run_once(self, train_df, transform_df):
+        a = self.args
+        X, _ = self.features_and_label(train_df)
+        Xq = X[: a.num_queries]
+        if a.mode == "cpu":
+            from sklearn.neighbors import NearestNeighbors as SkNN
+
+            model, fit_t = with_benchmark(
+                "fit", lambda: SkNN(n_neighbors=a.k, algorithm="brute").fit(X)
+            )
+            _, search_t = with_benchmark("kneighbors", lambda: model.kneighbors(Xq))
+        else:
+            from spark_rapids_ml_tpu.knn import NearestNeighbors
+
+            est = NearestNeighbors(k=a.k, num_workers=a.num_chips)
+            model, fit_t = with_benchmark("fit", lambda: est.fit(train_df))
+            _, search_t = with_benchmark(
+                "kneighbors",
+                lambda: model.kneighbors(DataFrame({"features": Xq})),
+            )
+        return {
+            "fit_time": fit_t,
+            "transform_time": search_t,
+            "total_time": fit_t + search_t,
+        }

@@ -1,0 +1,48 @@
+"""PCA benchmark (reference ``python/benchmark/benchmark/bench_pca.py``;
+quality = component orthonormality + explained variance, :58-110)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkPCA(BenchmarkBase):
+    name = "pca"
+    default_dataset = "low_rank_matrix"
+
+    def add_arguments(self, parser) -> None:
+        parser.add_argument("--k", type=int, default=3)
+
+    def run_once(self, train_df, transform_df):
+        k = self.args.k
+        if self.args.mode == "cpu":
+            from sklearn.decomposition import PCA as SkPCA
+
+            X, _ = self.features_and_label(train_df)
+            model, fit_t = with_benchmark("fit", lambda: SkPCA(n_components=k).fit(X))
+            _, tr_t = with_benchmark("transform", lambda: model.transform(X))
+            comps = model.components_
+            evr = float(model.explained_variance_ratio_.sum())
+        else:
+            from spark_rapids_ml_tpu.feature import PCA
+
+            est = PCA(k=k, num_workers=self.args.num_chips)
+            model, fit_t = with_benchmark("fit", lambda: est.fit(train_df))
+            _, tr_t = with_benchmark(
+                "transform", lambda: model.transform(transform_df)
+            )
+            comps = np.asarray(model.components_)
+            evr = float(np.sum(model.explained_variance_ratio_))
+        # orthonormality score (reference bench_pca.py:58-110)
+        gram = comps @ comps.T
+        ortho_err = float(np.abs(gram - np.eye(k)).max())
+        return {
+            "fit_time": fit_t,
+            "transform_time": tr_t,
+            "total_time": fit_t + tr_t,
+            "orthonormality_error": ortho_err,
+            "explained_variance_ratio": evr,
+        }

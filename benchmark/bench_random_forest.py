@@ -1,0 +1,73 @@
+"""RandomForest benchmarks (reference ``bench_random_forest.py``; reference
+headline configs: classifier 50 trees depth 13 bins 128, regressor 30 trees
+depth 6, ``databricks/run_benchmark.sh:88-113``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class _BenchmarkRF(BenchmarkBase):
+    _is_classifier = True
+
+    def add_arguments(self, parser) -> None:
+        d = 50 if self._is_classifier else 30
+        depth = 13 if self._is_classifier else 6
+        parser.add_argument("--numTrees", type=int, default=d)
+        parser.add_argument("--maxDepth", type=int, default=depth)
+        parser.add_argument("--maxBins", type=int, default=128)
+
+    def run_once(self, train_df, transform_df):
+        a = self.args
+        X, y = self.features_and_label(train_df)
+        if a.mode == "cpu":
+            from sklearn.ensemble import (
+                RandomForestClassifier as SkC,
+                RandomForestRegressor as SkR,
+            )
+
+            cls = SkC if self._is_classifier else SkR
+            sk = cls(
+                n_estimators=a.numTrees, max_depth=a.maxDepth,
+                random_state=a.random_seed, n_jobs=-1,
+            )
+            model, fit_t = with_benchmark("fit", lambda: sk.fit(X, y))
+            pred, tr_t = with_benchmark("transform", lambda: model.predict(X))
+        else:
+            if self._is_classifier:
+                from spark_rapids_ml_tpu.classification import RandomForestClassifier as Est
+            else:
+                from spark_rapids_ml_tpu.regression import RandomForestRegressor as Est
+
+            est = Est(
+                numTrees=a.numTrees, maxDepth=a.maxDepth, maxBins=a.maxBins,
+                seed=a.random_seed, num_workers=a.num_chips,
+            )
+            model, fit_t = with_benchmark("fit", lambda: est.fit(train_df))
+            out, tr_t = with_benchmark("transform", lambda: model.transform(transform_df))
+            pred = np.asarray(out["prediction"])
+        if self._is_classifier:
+            quality = {"accuracy": float((pred == y).mean())}
+        else:
+            quality = {"rmse": float(np.sqrt(np.mean((pred - y) ** 2)))}
+        return {
+            "fit_time": fit_t,
+            "transform_time": tr_t,
+            "total_time": fit_t + tr_t,
+            **quality,
+        }
+
+
+class BenchmarkRandomForestClassifier(_BenchmarkRF):
+    name = "random_forest_classifier"
+    default_dataset = "classification"
+    _is_classifier = True
+
+
+class BenchmarkRandomForestRegressor(_BenchmarkRF):
+    name = "random_forest_regressor"
+    default_dataset = "regression"
+    _is_classifier = False

@@ -1,0 +1,51 @@
+"""UMAP benchmark (reference ``bench_umap.py``; quality = trustworthiness
+of the embedding, the reference's score)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BenchmarkBase
+from .utils import with_benchmark
+
+
+class BenchmarkUMAP(BenchmarkBase):
+    name = "umap"
+    default_dataset = "blobs"
+
+    def add_arguments(self, parser) -> None:
+        parser.add_argument("--n_neighbors", type=float, default=15)
+        parser.add_argument("--n_components", type=int, default=2)
+        parser.add_argument("--sample_fraction", type=float, default=1.0)
+
+    def run_once(self, train_df, transform_df):
+        a = self.args
+        if a.mode == "cpu":
+            raise NotImplementedError(
+                "umap-learn is not available in this environment; the CPU "
+                "baseline for UMAP is not supported"
+            )
+        from spark_rapids_ml_tpu.umap import UMAP
+
+        est = UMAP(
+            n_neighbors=a.n_neighbors, n_components=a.n_components,
+            sample_fraction=a.sample_fraction, random_state=a.random_seed,
+            init="random", num_workers=a.num_chips,
+        )
+        model, fit_t = with_benchmark("fit", lambda: est.fit(train_df))
+        out, tr_t = with_benchmark("transform", lambda: model.transform(transform_df))
+        # trustworthiness on a bounded subsample (exact score is O(n^2))
+        X, _ = self.features_and_label(train_df)
+        ns = min(2000, model.embedding_.shape[0])
+        from sklearn.manifold import trustworthiness
+
+        Xs = np.asarray(model.raw_data_)[:ns]
+        trust = float(
+            trustworthiness(Xs, model.embedding_[:ns], n_neighbors=int(a.n_neighbors))
+        )
+        return {
+            "fit_time": fit_t,
+            "transform_time": tr_t,
+            "total_time": fit_t + tr_t,
+            "trustworthiness": trust,
+        }

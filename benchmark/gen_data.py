@@ -1,0 +1,165 @@
+"""Synthetic dataset generators (reference ``python/benchmark/gen_data.py``,
+550 LoC, registry at ``gen_data_distributed.py:1164-1169``: blobs, low_rank,
+regression, classification, sparse_regression).
+
+Datasets are generated in per-partition chunks with independent seeds (the
+reference generates partitions in parallel executors with per-partition
+seeds) and written as multi-file parquet through ``DataFrame.write_parquet``.
+
+CLI: ``python -m benchmark.gen_data blobs --num_rows 100000 --num_cols 256
+--output_dir /tmp/blobs``
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data import DataFrame
+
+
+def _chunked(n_rows: int, chunk: int = 1_000_000):
+    lo = 0
+    while lo < n_rows:
+        yield lo, min(lo + chunk, n_rows)
+        lo = lo + chunk
+
+
+def gen_blobs(
+    n_rows: int, n_cols: int, *, centers: int = 1000, cluster_std: float = 1.0,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """KMeans benchmark data (reference default k=1000)."""
+    rng = np.random.default_rng(seed)
+    C = (rng.normal(size=(centers, n_cols)) * 10).astype(np.float32)
+    X = np.empty((n_rows, n_cols), dtype=np.float32)
+    y = np.empty((n_rows,), dtype=np.int32)
+    for i, (lo, hi) in enumerate(_chunked(n_rows)):
+        r = np.random.default_rng(seed + 1 + i)
+        lab = r.integers(0, centers, hi - lo)
+        X[lo:hi] = C[lab] + cluster_std * r.normal(size=(hi - lo, n_cols))
+        y[lo:hi] = lab
+    return X, y
+
+
+def gen_low_rank_matrix(
+    n_rows: int, n_cols: int, *, effective_rank: int = 10, tail_strength: float = 0.5,
+    seed: int = 0,
+) -> Tuple[np.ndarray, None]:
+    """PCA benchmark data: bell-shaped singular-value profile (the sklearn
+    ``make_low_rank_matrix`` construction, computed chunk-wise)."""
+    rng = np.random.default_rng(seed)
+    n = min(n_rows, n_cols)
+    sv = np.arange(n, dtype=np.float64) / effective_rank
+    low_rank = (1 - tail_strength) * np.exp(-(sv**2))
+    tail = tail_strength * np.exp(-0.1 * sv)
+    s = low_rank + tail
+    V, _ = np.linalg.qr(rng.normal(size=(n_cols, n)))
+    X = np.empty((n_rows, n_cols), dtype=np.float32)
+    for i, (lo, hi) in enumerate(_chunked(n_rows)):
+        r = np.random.default_rng(seed + 1 + i)
+        U = r.normal(size=(hi - lo, n)) / np.sqrt(n_rows)
+        X[lo:hi] = (U * s) @ V.T
+    return X, None
+
+
+def gen_regression(
+    n_rows: int, n_cols: int, *, n_informative: Optional[int] = None,
+    noise: float = 1.0, bias: float = 0.0, seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    n_informative = n_informative or max(1, n_cols // 10)
+    w = np.zeros((n_cols,), dtype=np.float64)
+    idx = rng.permutation(n_cols)[:n_informative]
+    w[idx] = 100.0 * rng.random(n_informative)
+    X = np.empty((n_rows, n_cols), dtype=np.float32)
+    y = np.empty((n_rows,), dtype=np.float32)
+    for i, (lo, hi) in enumerate(_chunked(n_rows)):
+        r = np.random.default_rng(seed + 1 + i)
+        Xc = r.normal(size=(hi - lo, n_cols))
+        X[lo:hi] = Xc
+        y[lo:hi] = Xc @ w + bias + noise * r.normal(size=hi - lo)
+    return X, y
+
+
+def gen_classification(
+    n_rows: int, n_cols: int, *, n_classes: int = 2,
+    n_informative: Optional[int] = None, class_sep: float = 1.0, seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Gaussian class clusters on informative dims + noise dims (the shape
+    sklearn's make_classification produces; chunk-parallel construction)."""
+    rng = np.random.default_rng(seed)
+    n_informative = n_informative or max(2, n_cols // 10)
+    centers = (rng.normal(size=(n_classes, n_informative)) * 2 * class_sep).astype(
+        np.float32
+    )
+    X = np.empty((n_rows, n_cols), dtype=np.float32)
+    y = np.empty((n_rows,), dtype=np.float32)
+    for i, (lo, hi) in enumerate(_chunked(n_rows)):
+        r = np.random.default_rng(seed + 1 + i)
+        lab = r.integers(0, n_classes, hi - lo)
+        X[lo:hi, :n_informative] = centers[lab] + r.normal(
+            size=(hi - lo, n_informative)
+        )
+        if n_cols > n_informative:
+            X[lo:hi, n_informative:] = r.normal(size=(hi - lo, n_cols - n_informative))
+        y[lo:hi] = lab
+    return X, y
+
+
+def gen_sparse_regression(
+    n_rows: int, n_cols: int, *, density: float = 0.1, noise: float = 1.0,
+    seed: int = 0,
+):
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(seed)
+    X = sp.random(
+        n_rows, n_cols, density=density, format="csr", dtype=np.float32,
+        random_state=np.random.RandomState(seed),
+    )
+    w = rng.normal(size=n_cols).astype(np.float32)
+    y = np.asarray(X @ w).ravel() + noise * rng.normal(size=n_rows).astype(np.float32)
+    return X, y
+
+
+GENERATORS: Dict[str, Dict] = {
+    "blobs": {"fn": gen_blobs, "label": True},
+    "low_rank_matrix": {"fn": gen_low_rank_matrix, "label": False},
+    "regression": {"fn": gen_regression, "label": True},
+    "classification": {"fn": gen_classification, "label": True},
+    "sparse_regression": {"fn": gen_sparse_regression, "label": True},
+}
+
+
+def make_dataframe(
+    kind: str, n_rows: int, n_cols: int, seed: int = 0, **kwargs
+) -> DataFrame:
+    spec = GENERATORS[kind]
+    X, y = spec["fn"](n_rows, n_cols, seed=seed, **kwargs)
+    data = {"features": X}
+    if y is not None:
+        data["label"] = np.asarray(y, dtype=np.float64)
+    return DataFrame(data)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="Generate synthetic benchmark data")
+    parser.add_argument("kind", choices=sorted(GENERATORS.keys()))
+    parser.add_argument("--num_rows", type=int, default=5000)
+    parser.add_argument("--num_cols", type=int, default=3000)
+    parser.add_argument("--output_dir", required=True)
+    parser.add_argument("--output_num_files", type=int, default=50)
+    parser.add_argument("--random_seed", type=int, default=0)
+    args = parser.parse_args()
+
+    df = make_dataframe(args.kind, args.num_rows, args.num_cols, seed=args.random_seed)
+    rows_per_file = max(1, args.num_rows // args.output_num_files)
+    df.write_parquet(args.output_dir, rows_per_file=rows_per_file)
+    print(f"wrote {args.num_rows}x{args.num_cols} {args.kind} -> {args.output_dir}")
+
+
+if __name__ == "__main__":
+    main()

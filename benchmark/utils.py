@@ -1,0 +1,15 @@
+"""Timing helpers (reference ``python/benchmark/benchmark/utils.py:42``)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Tuple
+
+
+def with_benchmark(label: str, fn: Callable[[], Any]) -> Tuple[Any, float]:
+    """Run fn, print and return (result, elapsed_seconds)."""
+    t0 = time.perf_counter()
+    result = fn()
+    elapsed = time.perf_counter() - t0
+    print(f"{label}: {elapsed:.3f} s")
+    return result, elapsed

@@ -1,0 +1,45 @@
+"""Benchmark entry point (reference
+``/root/reference/python/benchmark/benchmark_runner.py``), same CLI shape:
+
+    python benchmark_runner.py <algorithm> [--mode tpu|cpu] [--num_chips N]
+        [--num_rows N --num_cols D | --train_path dir] [algo flags...]
+
+Supported algorithms: kmeans, knn, linear_regression, pca,
+random_forest_classifier, random_forest_regressor, logistic_regression, umap.
+"""
+
+import sys
+
+from benchmark.bench_kmeans import BenchmarkKMeans
+from benchmark.bench_linear_regression import BenchmarkLinearRegression
+from benchmark.bench_logistic_regression import BenchmarkLogisticRegression
+from benchmark.bench_nearest_neighbors import BenchmarkNearestNeighbors
+from benchmark.bench_pca import BenchmarkPCA
+from benchmark.bench_random_forest import (
+    BenchmarkRandomForestClassifier,
+    BenchmarkRandomForestRegressor,
+)
+from benchmark.bench_umap import BenchmarkUMAP
+
+REGISTERED = {
+    "kmeans": BenchmarkKMeans,
+    "knn": BenchmarkNearestNeighbors,
+    "linear_regression": BenchmarkLinearRegression,
+    "pca": BenchmarkPCA,
+    "random_forest_classifier": BenchmarkRandomForestClassifier,
+    "random_forest_regressor": BenchmarkRandomForestRegressor,
+    "logistic_regression": BenchmarkLogisticRegression,
+    "umap": BenchmarkUMAP,
+}
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help") or sys.argv[1] not in REGISTERED:
+        names = "\n    ".join(sorted(REGISTERED))
+        print(f"usage: benchmark_runner.py <algorithm> [<args>]\n\nalgorithms:\n    {names}")
+        sys.exit(0 if len(sys.argv) >= 2 and sys.argv[1] in ("-h", "--help") else 1)
+    REGISTERED[sys.argv[1]](sys.argv[2:]).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,16 @@ def _resolve_feature_matrix(obj: "_TpuParams", dataset: DataFrame):
         raise ValueError(f"Features column {input_col!r} must be a 2-D vector column")
     return X, None
 
+def _resolve_features_f32(obj: "_TpuParams", dataset: DataFrame) -> np.ndarray:
+    """Resolve features to one dense contiguous float32 matrix — the shared
+    path for float32-only algorithms (kNN, UMAP; reference ``knn.py:289-292``
+    converts all inputs to float32)."""
+    X, X_sparse = _resolve_feature_matrix(obj, dataset)
+    if X is None:
+        X = np.asarray(X_sparse.todense())
+    return np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+
+
 def _x64_ctx(dtype: Any):
     """Scoped x64 enablement for the float64 path.
 

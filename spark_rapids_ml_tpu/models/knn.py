@@ -61,6 +61,11 @@ class _NearestNeighborsParams(Params):
         self._setDefault(k=5, inputCol="features")
 
     def getK(self) -> int:
+        # _tpu_params is authoritative: users may set either the Spark name
+        # ``k`` (synced there by _set_params) or the backend name
+        # ``n_neighbors`` (stored only there)
+        if getattr(self, "_tpu_params", None) and "n_neighbors" in self._tpu_params:
+            return int(self._tpu_params["n_neighbors"])
         return self.getOrDefault("k")
 
     def setK(self, value: int) -> "_NearestNeighborsParams":
@@ -100,15 +105,9 @@ class _NearestNeighborsParams(Params):
         return df.withColumn(_DEFAULT_ID_COL, np.arange(df.count(), dtype=np.int64))
 
     def _resolve_features(self, df: DataFrame) -> np.ndarray:
-        # single resolution path shared with the whole framework
-        # (core._resolve_feature_matrix); kNN is float32-only (reference
-        # ``knn.py:289-292``)
-        from ..core import _resolve_feature_matrix
+        from ..core import _resolve_features_f32
 
-        X, X_sparse = _resolve_feature_matrix(self, df)
-        if X is None:
-            X = np.asarray(X_sparse.todense())
-        return np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+        return _resolve_features_f32(self, df)
 
 
 class NearestNeighbors(NearestNeighborsClass, _TpuEstimator, _NearestNeighborsParams):

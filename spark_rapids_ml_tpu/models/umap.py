@@ -195,14 +195,9 @@ class _UMAPParams(HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasOutputCol):
         return self
 
     def _resolve_features(self, df: DataFrame) -> np.ndarray:
-        # single resolution path shared with the whole framework
-        # (core._resolve_feature_matrix); UMAP compute is float32
-        from ..core import _resolve_feature_matrix
+        from ..core import _resolve_features_f32
 
-        X, X_sparse = _resolve_feature_matrix(self, df)
-        if X is None:
-            X = np.asarray(X_sparse.todense())
-        return np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+        return _resolve_features_f32(self, df)
 
 
 class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
@@ -232,11 +227,21 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         if k >= n:
             raise ValueError(f"n_neighbors={k} must be < number of rows {n}")
 
-        # 1) kNN graph (k+1 including self; drop the self column)
+        # 1) kNN graph: fetch k+1 and drop the SELF entry by index match —
+        # with duplicate rows, top_k tie-breaking can put self anywhere in
+        # the tie run, so dropping column 0 would discard a real neighbor
+        # and keep a self-loop
         Xd = jnp.asarray(X)
         dists, idx = knn_brute(Xd, Xd, k=k + 1)
-        knn_d = np.asarray(dists)[:, 1:]
-        knn_i = np.asarray(idx)[:, 1:]
+        idx_np = np.asarray(idx)
+        dists_np = np.asarray(dists)
+        self_mask = idx_np == np.arange(n)[:, None]
+        has_self = self_mask.any(axis=1)
+        drop_col = np.where(has_self, self_mask.argmax(axis=1), k)
+        keep = np.ones_like(self_mask)
+        keep[np.arange(n), drop_col] = False
+        knn_i = idx_np[keep].reshape(n, k)
+        knn_d = dists_np[keep].reshape(n, k)
 
         # 2) fuzzy simplicial set
         heads, tails, weights = fuzzy_simplicial_set(

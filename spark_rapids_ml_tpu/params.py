@@ -554,7 +554,8 @@ class _TpuParams(_TpuClass):
         value_mapping = self._param_value_mapping()
         for name, value in kwargs.items():
             if name == "num_workers":
-                self.num_workers = int(value)
+                if value is not None:  # None = use all local devices
+                    self.num_workers = int(value)
                 continue
             if name == "float32_inputs":
                 self._float32_inputs = bool(value)

@@ -1,0 +1,115 @@
+"""Data-generator + benchmark-harness tests (reference model:
+``/root/reference/python/benchmark/test_gen_data.py``, 489 LoC: validates
+rank/correlation/label structure of the synthetic datasets)."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from benchmark.gen_data import (
+    gen_blobs,
+    gen_classification,
+    gen_low_rank_matrix,
+    gen_regression,
+    gen_sparse_regression,
+    make_dataframe,
+)
+
+
+def test_blobs_cluster_structure():
+    X, y = gen_blobs(2000, 8, centers=5, cluster_std=0.1, seed=1)
+    assert X.shape == (2000, 8) and y.shape == (2000,)
+    assert set(np.unique(y)) <= set(range(5))
+    # within-cluster spread far below global spread
+    global_std = X.std()
+    within = np.mean([X[y == c].std(axis=0).mean() for c in np.unique(y)])
+    assert within < global_std / 5
+
+
+def test_low_rank_matrix_rank():
+    X, y = gen_low_rank_matrix(500, 60, effective_rank=5, tail_strength=0.1, seed=0)
+    assert y is None
+    s = np.linalg.svd(X.astype(np.float64), compute_uv=False)
+    # energy concentrates in the first ~effective_rank singular values
+    assert s[:10].sum() / s.sum() > 0.55
+    assert s[0] / s[30] > 3
+
+
+def test_regression_recoverable_weights():
+    X, y = gen_regression(3000, 20, n_informative=5, noise=0.1, seed=2)
+    w, *_ = np.linalg.lstsq(X.astype(np.float64), y.astype(np.float64), rcond=None)
+    pred = X @ w
+    r2 = 1 - ((pred - y) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+    assert r2 > 0.99
+    # exactly n_informative large weights
+    assert (np.abs(w) > 1.0).sum() == 5
+
+
+def test_classification_separable():
+    X, y = gen_classification(2000, 12, n_classes=3, class_sep=3.0, seed=3)
+    assert set(np.unique(y)) == {0.0, 1.0, 2.0}
+    from sklearn.linear_model import LogisticRegression
+
+    acc = LogisticRegression(max_iter=200).fit(X, y).score(X, y)
+    assert acc > 0.9
+
+
+def test_sparse_regression_density():
+    X, y = gen_sparse_regression(1000, 50, density=0.1, seed=4)
+    assert X.shape == (1000, 50)
+    density = X.nnz / (1000 * 50)
+    assert 0.05 < density < 0.15
+    assert y.shape == (1000,)
+
+
+def test_make_dataframe_and_parquet_roundtrip(tmp_path):
+    df = make_dataframe("classification", 300, 6, seed=5)
+    assert "features" in df and "label" in df
+    path = str(tmp_path / "ds")
+    df.write_parquet(path, rows_per_file=100)
+    from spark_rapids_ml_tpu.data import DataFrame
+
+    back = DataFrame.read_parquet(path)
+    assert back.count() == 300
+    np.testing.assert_allclose(back["features"], df["features"], rtol=1e-6)
+
+
+def test_chunked_generation_deterministic():
+    X1, y1 = gen_blobs(1000, 4, centers=3, seed=7)
+    X2, y2 = gen_blobs(1000, 4, centers=3, seed=7)
+    np.testing.assert_array_equal(X1, X2)
+    np.testing.assert_array_equal(y1, y2)
+
+
+@pytest.mark.parametrize(
+    "algo,extra",
+    [
+        ("pca", ["--k", "3"]),
+        ("kmeans", ["--k", "8", "--max_iter", "5"]),
+        ("linear_regression", []),
+        ("logistic_regression", ["--maxIter", "20"]),
+        ("random_forest_classifier", ["--numTrees", "4", "--maxDepth", "4"]),
+        ("knn", ["--k", "5", "--num_queries", "50"]),
+    ],
+)
+def test_benchmark_runner_smoke(algo, extra, tmp_path):
+    """The harness must run end-to-end at smoke scale on the CPU mesh
+    (reference CI smoke run: ``python/run_benchmark.sh:66-68``)."""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    report = str(tmp_path / "report.csv")
+    cmd = [
+        sys.executable, "benchmark_runner.py", algo,
+        "--num_rows", "400", "--num_cols", "8", "--num_runs", "1",
+        "--num_chips", "2", "--report_path", report,
+    ] + extra
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=600, env=env, cwd="/root/repo"
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "fit_time" in open(report).read()

@@ -115,3 +115,11 @@ def test_knn_param_mapping():
     est = NearestNeighbors(k=9)
     assert est._tpu_params["n_neighbors"] == 9
     assert est.getK() == 9
+
+
+def test_knn_backend_param_name():
+    # cuML-name n_neighbors must be honored like the Spark name k
+    Xi, Xq = _data(n_items=30, n_query=5, d=3)
+    model = NearestNeighbors(n_neighbors=2, num_workers=1).fit(DataFrame({"features": Xi}))
+    _, _, knn_df = model.kneighbors(DataFrame({"features": Xq}))
+    assert knn_df["indices"].shape == (5, 2)

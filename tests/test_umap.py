@@ -121,3 +121,16 @@ def test_umap_n_neighbors_validation():
     X, _ = _blobs(n=10, d=4, k=2)
     with pytest.raises(ValueError, match="n_neighbors"):
         UMAP(n_neighbors=15).fit(DataFrame({"features": X}))
+
+
+def test_umap_handles_duplicate_rows():
+    # duplicate rows: the self entry may appear anywhere in the top-k tie
+    # run; the graph must still exclude self and keep real neighbors
+    X, _ = _blobs(n=200, d=6, k=2, seed=11)
+    X[1] = X[0]
+    X[50:55] = X[49]
+    model = UMAP(n_neighbors=8, random_state=0, init="random").fit(
+        DataFrame({"features": X})
+    )
+    t = _trust(X, model.embedding_, n_neighbors=8)
+    assert t > 0.8
