@@ -92,7 +92,6 @@ class BenchmarkBase:
         path = self.args.report_path
         if not path:
             return
-        exists = os.path.exists(path)
         meta = {
             "datetime": datetime.datetime.now().isoformat(timespec="seconds"),
             "algorithm": self.name,
@@ -101,9 +100,18 @@ class BenchmarkBase:
             "num_cols": getattr(self, "_actual_cols", self.args.num_cols),
         }
         out = {**meta, **row}
+        # different algorithms report different columns; re-emit the header
+        # whenever the field set changes so rows never silently misalign
+        prev_header = None
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    if line.startswith("datetime,"):  # header rows only
+                        prev_header = line.strip()
+        header = ",".join(out.keys())
         with open(path, "a", newline="") as f:
             w = csv.DictWriter(f, fieldnames=list(out.keys()))
-            if not exists:
+            if prev_header != header:
                 w.writeheader()
             w.writerow(out)
 

@@ -21,6 +21,7 @@ class BenchmarkLinearRegression(BenchmarkBase):
     def run_once(self, train_df, transform_df):
         a = self.args
         X, y = self.features_and_label(train_df)
+        Xe, ye = self.features_and_label(transform_df)
         if a.mode == "cpu":
             from sklearn.linear_model import ElasticNet, LinearRegression as SkLR, Ridge
 
@@ -31,7 +32,7 @@ class BenchmarkLinearRegression(BenchmarkBase):
             else:
                 sk = ElasticNet(alpha=a.regParam, l1_ratio=a.elasticNetParam)
             model, fit_t = with_benchmark("fit", lambda: sk.fit(X, y))
-            pred, tr_t = with_benchmark("transform", lambda: model.predict(X))
+            pred, tr_t = with_benchmark("transform", lambda: model.predict(Xe))
         else:
             from spark_rapids_ml_tpu.regression import LinearRegression
 
@@ -42,7 +43,7 @@ class BenchmarkLinearRegression(BenchmarkBase):
             model, fit_t = with_benchmark("fit", lambda: est.fit(train_df))
             out, tr_t = with_benchmark("transform", lambda: model.transform(transform_df))
             pred = np.asarray(out["prediction"])
-        rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+        rmse = float(np.sqrt(np.mean((pred - ye) ** 2)))
         return {
             "fit_time": fit_t,
             "transform_time": tr_t,

@@ -21,6 +21,7 @@ class BenchmarkLogisticRegression(BenchmarkBase):
     def run_once(self, train_df, transform_df):
         a = self.args
         X, y = self.features_and_label(train_df)
+        Xe, ye = self.features_and_label(transform_df)
         if a.mode == "cpu":
             from sklearn.linear_model import LogisticRegression as SkLR
 
@@ -28,7 +29,7 @@ class BenchmarkLogisticRegression(BenchmarkBase):
             model, fit_t = with_benchmark(
                 "fit", lambda: SkLR(max_iter=a.maxIter, C=c, tol=a.tol).fit(X, y)
             )
-            pred, tr_t = with_benchmark("transform", lambda: model.predict(X))
+            pred, tr_t = with_benchmark("transform", lambda: model.predict(Xe))
         else:
             from spark_rapids_ml_tpu.classification import LogisticRegression
 
@@ -39,7 +40,7 @@ class BenchmarkLogisticRegression(BenchmarkBase):
             model, fit_t = with_benchmark("fit", lambda: est.fit(train_df))
             out, tr_t = with_benchmark("transform", lambda: model.transform(transform_df))
             pred = np.asarray(out["prediction"])
-        acc = float((pred == y).mean())
+        acc = float((pred == ye).mean())
         return {
             "fit_time": fit_t,
             "transform_time": tr_t,

@@ -21,7 +21,9 @@ class BenchmarkNearestNeighbors(BenchmarkBase):
     def run_once(self, train_df, transform_df):
         a = self.args
         X, _ = self.features_and_label(train_df)
-        Xq = X[: a.num_queries]
+        # queries come from transform_df (== train_df unless --transform_path)
+        Xq_all, _ = self.features_and_label(transform_df)
+        Xq = Xq_all[: a.num_queries]
         if a.mode == "cpu":
             from sklearn.neighbors import NearestNeighbors as SkNN
 

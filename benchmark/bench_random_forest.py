@@ -23,6 +23,7 @@ class _BenchmarkRF(BenchmarkBase):
     def run_once(self, train_df, transform_df):
         a = self.args
         X, y = self.features_and_label(train_df)
+        Xe, ye = self.features_and_label(transform_df)
         if a.mode == "cpu":
             from sklearn.ensemble import (
                 RandomForestClassifier as SkC,
@@ -35,7 +36,7 @@ class _BenchmarkRF(BenchmarkBase):
                 random_state=a.random_seed, n_jobs=-1,
             )
             model, fit_t = with_benchmark("fit", lambda: sk.fit(X, y))
-            pred, tr_t = with_benchmark("transform", lambda: model.predict(X))
+            pred, tr_t = with_benchmark("transform", lambda: model.predict(Xe))
         else:
             if self._is_classifier:
                 from spark_rapids_ml_tpu.classification import RandomForestClassifier as Est
@@ -50,9 +51,9 @@ class _BenchmarkRF(BenchmarkBase):
             out, tr_t = with_benchmark("transform", lambda: model.transform(transform_df))
             pred = np.asarray(out["prediction"])
         if self._is_classifier:
-            quality = {"accuracy": float((pred == y).mean())}
+            quality = {"accuracy": float((pred == ye).mean())}
         else:
-            quality = {"rmse": float(np.sqrt(np.mean((pred - y) ** 2)))}
+            quality = {"rmse": float(np.sqrt(np.mean((pred - ye) ** 2)))}
         return {
             "fit_time": fit_t,
             "transform_time": tr_t,

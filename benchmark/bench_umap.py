@@ -35,7 +35,6 @@ class BenchmarkUMAP(BenchmarkBase):
         model, fit_t = with_benchmark("fit", lambda: est.fit(train_df))
         out, tr_t = with_benchmark("transform", lambda: model.transform(transform_df))
         # trustworthiness on a bounded subsample (exact score is O(n^2))
-        X, _ = self.features_and_label(train_df)
         ns = min(2000, model.embedding_.shape[0])
         from sklearn.manifold import trustworthiness
 

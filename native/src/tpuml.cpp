@@ -1,0 +1,273 @@
+// libtpuml — native linalg kernels for the host-side PCA pipeline.
+//
+// TPU-native equivalent of the reference's JNI CUDA library
+// (/root/reference/jvm/native/src/rapidsml_jni.cu, 270 LoC):
+//   signFlip  (rapidsml_jni.cu:35-60)   -> tpuml_sign_flip
+//   dgemmCov  (rapidsml_jni.cu:109-127) -> tpuml_gram (blocked A^T A)
+//   dgemm     (rapidsml_jni.cu:75-107)  -> tpuml_gemm_transform
+//   calSVD    (rapidsml_jni.cu:215-268) -> tpuml_eigh (tred2/tql2 symmetric
+//              eigensolver + descending reorder + sqrt -> singular values,
+//              the role raft::linalg::eigDC + colReverse/seqRoot played)
+//
+// The reference offloads these to cuBLAS/cuSOLVER on device; on TPU the
+// device path is XLA (ops/linalg.py) and this library serves the same role
+// the JNI .so served for the Scala API: a dependency-free native runtime
+// for host-resident covariance accumulation across partitions
+// (RapidsRowMatrix.scala:110-141 reduces per-partition Grams on the driver).
+//
+// Build: cmake -S native -B native/build && cmake --build native/build
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Gram matrix: out(d,d) += X^T X for a row-major (n,d) batch.
+// Blocked over rows for cache locality; parallel over column tiles.
+// ---------------------------------------------------------------------------
+void tpuml_gram_f32(const float* X, int64_t n, int64_t d, double* out) {
+  const int64_t RB = 256;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic)
+#endif
+  for (int64_t i = 0; i < d; ++i) {
+    for (int64_t r0 = 0; r0 < n; r0 += RB) {
+      const int64_t r1 = r0 + RB < n ? r0 + RB : n;
+      for (int64_t r = r0; r < r1; ++r) {
+        const float xi = X[r * d + i];
+        if (xi == 0.0f) continue;
+        const float* row = X + r * d;
+        double* o = out + i * d;
+        for (int64_t j = i; j < d; ++j) o[j] += (double)xi * (double)row[j];
+      }
+    }
+  }
+  // mirror the upper triangle
+  for (int64_t i = 0; i < d; ++i)
+    for (int64_t j = 0; j < i; ++j) out[i * d + j] = out[j * d + i];
+}
+
+void tpuml_gram_f64(const double* X, int64_t n, int64_t d, double* out) {
+  const int64_t RB = 256;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic)
+#endif
+  for (int64_t i = 0; i < d; ++i) {
+    for (int64_t r0 = 0; r0 < n; r0 += RB) {
+      const int64_t r1 = r0 + RB < n ? r0 + RB : n;
+      for (int64_t r = r0; r < r1; ++r) {
+        const double xi = X[r * d + i];
+        if (xi == 0.0) continue;
+        const double* row = X + r * d;
+        double* o = out + i * d;
+        for (int64_t j = i; j < d; ++j) o[j] += xi * row[j];
+      }
+    }
+  }
+  for (int64_t i = 0; i < d; ++i)
+    for (int64_t j = 0; j < i; ++j) out[i * d + j] = out[j * d + i];
+}
+
+// column sums (for mean removal on the driver, like RapidsRowMatrix's
+// covariance assembly)
+void tpuml_colsum_f32(const float* X, int64_t n, int64_t d, double* out) {
+  for (int64_t r = 0; r < n; ++r) {
+    const float* row = X + r * d;
+    for (int64_t j = 0; j < d; ++j) out[j] += (double)row[j];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Deterministic eigenvector sign convention (rapidsml_jni.cu:35-60): flip
+// each column so its max-|.|-element is positive. components: (k, d)
+// row-major (one component per row).
+// ---------------------------------------------------------------------------
+void tpuml_sign_flip(double* components, int64_t k, int64_t d) {
+  for (int64_t c = 0; c < k; ++c) {
+    double* row = components + c * d;
+    double mx = 0.0;
+    int64_t arg = 0;
+    for (int64_t j = 0; j < d; ++j) {
+      const double a = std::fabs(row[j]);
+      if (a > mx) { mx = a; arg = j; }
+    }
+    if (row[arg] < 0.0)
+      for (int64_t j = 0; j < d; ++j) row[j] = -row[j];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Symmetric eigendecomposition, EISPACK-style: Householder tridiagonal
+// reduction (tred2) + implicit-shift QL (tql2). Ascending eigenvalues.
+// A: (d,d) row-major, destroyed; on return A holds eigenvectors as COLUMNS
+// (A[i*d+j] = component i of eigenvector j), w holds eigenvalues.
+// Returns 0 on success, l+1 on QL non-convergence.
+// ---------------------------------------------------------------------------
+static int eigh_inplace(double* a, int64_t d, double* w) {
+  std::vector<double> e(d, 0.0);
+  // --- tred2 ---
+  for (int64_t i = d - 1; i >= 1; --i) {
+    int64_t l = i - 1;
+    double h = 0.0, scale = 0.0;
+    if (l > 0) {
+      for (int64_t k = 0; k <= l; ++k) scale += std::fabs(a[i * d + k]);
+      if (scale == 0.0) {
+        e[i] = a[i * d + l];
+      } else {
+        for (int64_t k = 0; k <= l; ++k) {
+          a[i * d + k] /= scale;
+          h += a[i * d + k] * a[i * d + k];
+        }
+        double f = a[i * d + l];
+        double g = f >= 0.0 ? -std::sqrt(h) : std::sqrt(h);
+        e[i] = scale * g;
+        h -= f * g;
+        a[i * d + l] = f - g;
+        f = 0.0;
+        for (int64_t j = 0; j <= l; ++j) {
+          a[j * d + i] = a[i * d + j] / h;
+          g = 0.0;
+          for (int64_t k = 0; k <= j; ++k) g += a[j * d + k] * a[i * d + k];
+          for (int64_t k = j + 1; k <= l; ++k) g += a[k * d + j] * a[i * d + k];
+          e[j] = g / h;
+          f += e[j] * a[i * d + j];
+        }
+        double hh = f / (h + h);
+        for (int64_t j = 0; j <= l; ++j) {
+          f = a[i * d + j];
+          e[j] = g = e[j] - hh * f;
+          for (int64_t k = 0; k <= j; ++k)
+            a[j * d + k] -= f * e[k] + g * a[i * d + k];
+        }
+      }
+    } else {
+      e[i] = a[i * d + l];
+    }
+    w[i] = h;
+  }
+  w[0] = 0.0;
+  e[0] = 0.0;
+  for (int64_t i = 0; i < d; ++i) {
+    int64_t l = i - 1;
+    if (w[i] != 0.0) {
+      for (int64_t j = 0; j <= l; ++j) {
+        double g = 0.0;
+        for (int64_t k = 0; k <= l; ++k) g += a[i * d + k] * a[k * d + j];
+        for (int64_t k = 0; k <= l; ++k) a[k * d + j] -= g * a[k * d + i];
+      }
+    }
+    w[i] = a[i * d + i];
+    a[i * d + i] = 1.0;
+    for (int64_t j = 0; j <= l; ++j) a[j * d + i] = a[i * d + j] = 0.0;
+  }
+  // --- tql2 ---
+  for (int64_t i = 1; i < d; ++i) e[i - 1] = e[i];
+  e[d - 1] = 0.0;
+  for (int64_t l = 0; l < d; ++l) {
+    int iter = 0;
+    int64_t m;
+    do {
+      for (m = l; m < d - 1; ++m) {
+        double dd = std::fabs(w[m]) + std::fabs(w[m + 1]);
+        if (std::fabs(e[m]) <= 1e-300 + 2.3e-16 * dd) break;
+      }
+      if (m != l) {
+        if (iter++ == 50) return (int)l + 1;
+        double g = (w[l + 1] - w[l]) / (2.0 * e[l]);
+        double r = std::hypot(g, 1.0);
+        g = w[m] - w[l] + e[l] / (g + (g >= 0.0 ? std::fabs(r) : -std::fabs(r)));
+        double s = 1.0, c = 1.0, p = 0.0;
+        bool underflow = false;
+        for (int64_t i = m - 1; i >= l; --i) {
+          double f = s * e[i];
+          double b = c * e[i];
+          r = std::hypot(f, g);
+          e[i + 1] = r;
+          if (r == 0.0) {
+            w[i + 1] -= p;
+            e[m] = 0.0;
+            underflow = true;
+            break;
+          }
+          s = f / r;
+          c = g / r;
+          g = w[i + 1] - p;
+          r = (w[i] - g) * s + 2.0 * c * b;
+          p = s * r;
+          w[i + 1] = g + p;
+          g = c * r - b;
+          for (int64_t k = 0; k < d; ++k) {
+            f = a[k * d + i + 1];
+            a[k * d + i + 1] = s * a[k * d + i] + c * f;
+            a[k * d + i] = c * a[k * d + i] - s * f;
+          }
+        }
+        if (underflow) continue;
+        w[l] -= p;
+        e[l] = g;
+        e[m] = 0.0;
+      }
+    } while (m != l);
+  }
+  return 0;
+}
+
+// Top-k principal components of a symmetric (d,d) covariance, descending
+// eigenvalue order (the calSVD contract, rapidsml_jni.cu:215-268):
+//   components  (k, d) row-major
+//   eigenvalues (k,)   descending
+//   singular    (k,)   sqrt(max(eig,0) * scale)  [scale = n-1 style factor]
+// Returns 0 on success.
+int tpuml_eig_cov(const double* cov, int64_t d, int64_t k, double scale,
+                  double* components, double* eigenvalues, double* singular) {
+  std::vector<double> A(cov, cov + d * d);
+  std::vector<double> w(d);
+  int rc = eigh_inplace(A.data(), d, w.data());
+  if (rc != 0) return rc;
+  // QL leaves eigenvalues unsorted; order indices descending
+  std::vector<int64_t> order(d);
+  for (int64_t i = 0; i < d; ++i) order[i] = i;
+  std::sort(order.begin(), order.end(),
+            [&](int64_t x, int64_t y) { return w[x] > w[y]; });
+  for (int64_t c = 0; c < k; ++c) {
+    const int64_t src = order[c];
+    eigenvalues[c] = w[src];
+    const double ev = w[src] > 0.0 ? w[src] : 0.0;
+    singular[c] = std::sqrt(ev * scale);
+    for (int64_t j = 0; j < d; ++j) components[c * d + j] = A[j * d + src];
+  }
+  tpuml_sign_flip(components, k, d);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Transform: out(n,k) = X(n,d) @ components(k,d)^T (rapidsml_jni.cu:75-107)
+// ---------------------------------------------------------------------------
+void tpuml_gemm_transform_f32(const float* X, int64_t n, int64_t d,
+                              const double* components, int64_t k, float* out) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    const float* row = X + r * d;
+    float* o = out + r * k;
+    for (int64_t c = 0; c < k; ++c) {
+      const double* comp = components + c * d;
+      double acc = 0.0;
+      for (int64_t j = 0; j < d; ++j) acc += (double)row[j] * comp[j];
+      o[c] = (float)acc;
+    }
+  }
+}
+
+int tpuml_version() { return 1; }
+
+}  // extern "C"

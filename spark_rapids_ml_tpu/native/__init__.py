@@ -1,0 +1,161 @@
+"""ctypes bindings for libtpuml — the native runtime layer.
+
+The reference loads its JNI CUDA library through
+``jvm/src/main/java/com/nvidia/spark/ml/linalg/JniRAPIDSML.java:27-58``
+(extract .so by os/arch, System.load). The TPU-native equivalent: locate or
+build ``libtpuml.so`` (cmake, ``/root/repo/native``) and bind the four
+kernels the reference exposes (sign flip, Gram, eig-SVD, gemm transform)
+via ctypes — pybind11 is not available in this environment.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _lib_path() -> str:
+    env = os.environ.get("TPUML_LIB")
+    if env:
+        return env
+    return os.path.join(_BUILD_DIR, "libtpuml.so")
+
+
+def build_native(force: bool = False) -> str:
+    """Build libtpuml.so with cmake (idempotent). Returns the .so path."""
+    path = _lib_path()
+    if os.path.exists(path) and not force:
+        return path
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    subprocess.run(
+        ["cmake", "-S", _NATIVE_DIR, "-B", _BUILD_DIR, "-DCMAKE_BUILD_TYPE=Release"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", _BUILD_DIR, "--parallel"],
+        check=True, capture_output=True,
+    )
+    return path
+
+
+def is_available() -> bool:
+    try:
+        load()
+        return True
+    except Exception:
+        return False
+
+
+def load() -> ctypes.CDLL:
+    """Load (building on first use) and type the library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = _lib_path()
+    if not os.path.exists(path):
+        build_native()
+    lib = ctypes.CDLL(path)
+
+    dp = ctypes.POINTER(ctypes.c_double)
+    fp = ctypes.POINTER(ctypes.c_float)
+    i64 = ctypes.c_int64
+
+    lib.tpuml_version.restype = ctypes.c_int
+    lib.tpuml_gram_f32.argtypes = [fp, i64, i64, dp]
+    lib.tpuml_gram_f64.argtypes = [dp, i64, i64, dp]
+    lib.tpuml_colsum_f32.argtypes = [fp, i64, i64, dp]
+    lib.tpuml_sign_flip.argtypes = [dp, i64, i64]
+    lib.tpuml_eig_cov.argtypes = [dp, i64, i64, ctypes.c_double, dp, dp, dp]
+    lib.tpuml_eig_cov.restype = ctypes.c_int
+    lib.tpuml_gemm_transform_f32.argtypes = [fp, i64, i64, dp, i64, fp]
+    _lib = lib
+    return lib
+
+
+def _dptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+# -- typed wrappers (the RAPIDSML.scala facade analog, RAPIDSML.scala:56-155) --
+
+
+def gram(X: np.ndarray, out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Accumulate X^T X into out (f64). Call per partition, like
+    ``RapidsRowMatrix.computeCovariance`` accumulates per-batch Grams."""
+    X = np.ascontiguousarray(X)
+    n, d = X.shape
+    if out is None:
+        out = np.zeros((d, d), dtype=np.float64)
+    lib = load()
+    if X.dtype == np.float32:
+        lib.tpuml_gram_f32(_fptr(X), n, d, _dptr(out))
+    elif X.dtype == np.float64:
+        lib.tpuml_gram_f64(_dptr(X), n, d, _dptr(out))
+    else:
+        raise TypeError(f"unsupported dtype {X.dtype}")
+    return out
+
+
+def colsum(X: np.ndarray, out: Optional[np.ndarray] = None) -> np.ndarray:
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    n, d = X.shape
+    if out is None:
+        out = np.zeros((d,), dtype=np.float64)
+    load().tpuml_colsum_f32(_fptr(X), n, d, _dptr(out))
+    return out
+
+
+def sign_flip(components: np.ndarray) -> np.ndarray:
+    components = np.ascontiguousarray(components, dtype=np.float64)
+    k, d = components.shape
+    load().tpuml_sign_flip(_dptr(components), k, d)
+    return components
+
+
+def eig_cov(
+    cov: np.ndarray, k: int, scale: float = 1.0
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Top-k eigendecomposition of a symmetric covariance ->
+    (components (k,d), eigenvalues desc (k,), singular values (k,))."""
+    cov = np.ascontiguousarray(cov, dtype=np.float64)
+    d = cov.shape[0]
+    if cov.shape != (d, d):
+        raise ValueError("cov must be square")
+    if not (1 <= k <= d):
+        raise ValueError(f"k={k} out of range [1, {d}]")
+    comps = np.zeros((k, d), dtype=np.float64)
+    eigvals = np.zeros((k,), dtype=np.float64)
+    sing = np.zeros((k,), dtype=np.float64)
+    rc = load().tpuml_eig_cov(
+        _dptr(cov), d, k, ctypes.c_double(scale), _dptr(comps), _dptr(eigvals), _dptr(sing)
+    )
+    if rc != 0:
+        raise RuntimeError(f"tpuml_eig_cov: QL failed to converge (l={rc - 1})")
+    return comps, eigvals, sing
+
+
+def gemm_transform(X: np.ndarray, components: np.ndarray) -> np.ndarray:
+    """out(n,k) = X @ components^T."""
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    components = np.ascontiguousarray(components, dtype=np.float64)
+    n, d = X.shape
+    k = components.shape[0]
+    if components.shape[1] != d:
+        raise ValueError("dim mismatch")
+    out = np.empty((n, k), dtype=np.float32)
+    load().tpuml_gemm_transform_f32(_fptr(X), n, d, _dptr(components), k, _fptr(out))
+    return out

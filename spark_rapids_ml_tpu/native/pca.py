@@ -1,0 +1,119 @@
+"""NativePCA — the Scala-API PCA pipeline on the native library.
+
+Mirrors the reference's second, JNI-backed PCA implementation
+(``/root/reference/jvm/src/main/scala/org/apache/spark/ml/feature/RapidsPCA.scala``
++ ``RapidsRowMatrix.scala:59-141``): per-partition Gram matrices are
+accumulated (driver reduce), the covariance is assembled with mean removal,
+a single native eigendecomposition yields the top-k components
+(``calSVD``), and transform is a native gemm. This is the host/native
+runtime path; the primary TPU path is ``spark_rapids_ml_tpu.feature.PCA``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..data.dataframe import DataFrame
+from . import eig_cov, gemm_transform, gram, colsum
+
+
+class NativePCA:
+    """``NativePCA(k=3, meanCentering=True).fit(df)`` (the
+    ``com.nvidia.spark.ml.feature.PCA`` facade, ``PCA.scala:27-37``, incl.
+    its ``meanCentering`` param, ``RapidsPCA.scala:40-45``)."""
+
+    def __init__(
+        self,
+        k: int = 2,
+        inputCol: str = "features",
+        outputCol: str = "pca_features",
+        meanCentering: bool = True,
+    ):
+        self._k = k
+        self._input_col = inputCol
+        self._output_col = outputCol
+        self._mean_centering = meanCentering
+
+    def setK(self, k: int) -> "NativePCA":
+        self._k = k
+        return self
+
+    def setInputCol(self, v: str) -> "NativePCA":
+        self._input_col = v
+        return self
+
+    def setOutputCol(self, v: str) -> "NativePCA":
+        self._output_col = v
+        return self
+
+    def fit(self, df: DataFrame) -> "NativePCAModel":
+        X = np.asarray(df.column(self._input_col))
+        if X.ndim != 2:
+            raise ValueError("input column must be a vector column")
+        n, d = X.shape
+        if not (1 <= self._k <= d):
+            raise ValueError(f"k={self._k} out of range [1, {d}]")
+        if n < 2:
+            raise ValueError("need >= 2 rows")
+        # per-partition native Gram + column-sum accumulation (the
+        # ColumnarRdd map + driver reduce, RapidsRowMatrix.scala:110-141)
+        G = np.zeros((d, d), dtype=np.float64)
+        s = np.zeros((d,), dtype=np.float64)
+        for part in df.iter_partitions():
+            Xp = np.ascontiguousarray(np.asarray(part.column(self._input_col)), dtype=np.float32)
+            gram(Xp, out=G)
+            colsum(Xp, out=s)
+        mean = s / n
+        if self._mean_centering:
+            cov = (G - n * np.outer(mean, mean)) / (n - 1)
+        else:
+            cov = G / (n - 1)
+        comps, eigvals, sing = eig_cov(cov, self._k, scale=float(n - 1))
+        total_var = float(np.trace(cov))
+        evr = eigvals / total_var if total_var > 0 else np.zeros_like(eigvals)
+        return NativePCAModel(
+            components=comps,
+            explained_variance=eigvals,
+            explained_variance_ratio=evr,
+            singular_values=sing,
+            mean=mean,
+            input_col=self._input_col,
+            output_col=self._output_col,
+            mean_centering=self._mean_centering,
+        )
+
+
+class NativePCAModel:
+    def __init__(
+        self,
+        components: np.ndarray,
+        explained_variance: np.ndarray,
+        explained_variance_ratio: np.ndarray,
+        singular_values: np.ndarray,
+        mean: np.ndarray,
+        input_col: str,
+        output_col: str,
+        mean_centering: bool,
+    ):
+        self.components_ = components
+        self.explained_variance_ = explained_variance
+        self.explained_variance_ratio_ = explained_variance_ratio
+        self.singular_values_ = singular_values
+        self.mean_ = mean
+        self._input_col = input_col
+        self._output_col = output_col
+        self._mean_centering = mean_centering
+
+    @property
+    def pc(self) -> np.ndarray:
+        """(d, k) principal-component matrix (Spark PCAModel.pc layout)."""
+        return self.components_.T
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        X = np.asarray(df.column(self._input_col), dtype=np.float32)
+        if self._mean_centering:
+            X = X - self.mean_.astype(np.float32)[None, :]
+        out = gemm_transform(X, self.components_)
+        return df.withColumn(self._output_col, out)

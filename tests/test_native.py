@@ -1,0 +1,136 @@
+"""Native library tests — numpy oracles for every exported kernel and the
+NativePCA pipeline vs the TPU-path PCA (the reference's PCASuite.scala
+checks GPU PCA against mllib RowMatrix up to sign, 1e-5; :43-90)."""
+
+import numpy as np
+import pytest
+
+native = pytest.importorskip("spark_rapids_ml_tpu.native")
+
+from spark_rapids_ml_tpu.data import DataFrame  # noqa: E402
+from spark_rapids_ml_tpu.native.pca import NativePCA  # noqa: E402
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _build():
+    native.build_native()
+
+
+def test_version():
+    assert native.load().tpuml_version() == 1
+
+
+def test_gram_matches_numpy():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 40)).astype(np.float32)
+    G = native.gram(X)
+    np.testing.assert_allclose(G, X.astype(np.float64).T @ X, rtol=1e-5)
+    # accumulation across partitions
+    G2 = native.gram(X[:250])
+    native.gram(X[250:], out=G2)
+    np.testing.assert_allclose(G2, G, rtol=1e-6)
+    # f64 path
+    Xd = X.astype(np.float64)
+    np.testing.assert_allclose(native.gram(Xd), Xd.T @ Xd, rtol=1e-10)
+
+
+def test_sign_flip_convention():
+    comps = np.array([[0.1, -0.9, 0.2], [0.5, 0.2, 0.1]])
+    out = native.sign_flip(comps.copy())
+    np.testing.assert_allclose(out[0], -comps[0])   # max |.| was negative
+    np.testing.assert_allclose(out[1], comps[1])
+
+
+def test_eig_cov_matches_numpy():
+    rng = np.random.default_rng(1)
+    A = rng.normal(size=(200, 30))
+    cov = (A.T @ A) / 199
+    comps, eigvals, sing = native.eig_cov(cov, k=5, scale=199.0)
+    w_np, v_np = np.linalg.eigh(cov)
+    w_np = w_np[::-1]
+    np.testing.assert_allclose(eigvals, w_np[:5], rtol=1e-8)
+    np.testing.assert_allclose(sing, np.sqrt(w_np[:5] * 199), rtol=1e-8)
+    # eigenvectors match up to the (deterministic) sign convention
+    for i in range(5):
+        v = v_np[:, -1 - i]
+        v = v if v[np.argmax(np.abs(v))] > 0 else -v
+        np.testing.assert_allclose(comps[i], v, atol=1e-7)
+
+
+def test_eig_cov_large_stable():
+    rng = np.random.default_rng(2)
+    A = rng.normal(size=(300, 150))
+    cov = A.T @ A
+    comps, eigvals, _ = native.eig_cov(cov, k=150)
+    w_np = np.linalg.eigh(cov)[0][::-1]
+    np.testing.assert_allclose(eigvals, w_np, rtol=1e-7)
+    # orthonormal basis
+    np.testing.assert_allclose(comps @ comps.T, np.eye(150), atol=1e-8)
+
+
+def test_gemm_transform_matches_numpy():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(100, 20)).astype(np.float32)
+    C = rng.normal(size=(4, 20))
+    out = native.gemm_transform(X, C)
+    np.testing.assert_allclose(out, X @ C.T, rtol=1e-5, atol=1e-5)
+
+
+def test_native_pca_matches_sklearn():
+    rng = np.random.default_rng(4)
+    X = (rng.normal(size=(400, 12)) @ rng.normal(size=(12, 12)) + 3.0).astype(
+        np.float32
+    )
+    df = DataFrame({"features": X}, num_partitions=4)
+    model = NativePCA(k=3).fit(df)
+
+    from sklearn.decomposition import PCA as SkPCA
+
+    sk = SkPCA(n_components=3).fit(X)
+    np.testing.assert_allclose(
+        model.explained_variance_, sk.explained_variance_, rtol=1e-4
+    )
+    for i in range(3):
+        a, b = model.components_[i], sk.components_[i]
+        if np.dot(a, b) < 0:
+            b = -b
+        np.testing.assert_allclose(a, b, atol=1e-4)
+    out = model.transform(df)
+    skt = sk.transform(X)
+    got = out["pca_features"]
+    for i in range(3):
+        col = got[:, i] if np.dot(got[:, i], skt[:, i]) > 0 else -got[:, i]
+        np.testing.assert_allclose(col, skt[:, i], atol=1e-2)
+
+
+def test_native_pca_matches_tpu_pca():
+    """The native (Scala-path analog) and TPU PCA must agree — the
+    reference's cross-implementation equivalence check."""
+    from spark_rapids_ml_tpu.feature import PCA
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(300, 10)).astype(np.float32)
+    df = DataFrame({"features": X})
+    m_native = NativePCA(k=3).fit(df)
+    m_tpu = PCA(k=3, num_workers=2).fit(df)
+    for i in range(3):
+        a = m_native.components_[i]
+        b = np.asarray(m_tpu.components_)[i]
+        if np.dot(a, b) < 0:
+            b = -b
+        np.testing.assert_allclose(a, b, atol=1e-3)
+    np.testing.assert_allclose(
+        m_native.explained_variance_ratio_,
+        np.asarray(m_tpu.explained_variance_ratio_),
+        atol=1e-4,
+    )
+
+
+def test_native_pca_no_mean_centering():
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(200, 8)).astype(np.float32) + 5.0
+    df = DataFrame({"features": X})
+    model = NativePCA(k=2, meanCentering=False).fit(df)
+    # without centering the top component points at the mean offset
+    mean_dir = X.mean(axis=0) / np.linalg.norm(X.mean(axis=0))
+    assert abs(np.dot(model.components_[0], mean_dir)) > 0.99
