@@ -1,64 +1,287 @@
 """Benchmark entry point — prints ONE JSON line with the headline metric.
 
-Headline: PCA fit throughput in samples/sec/chip at the reference benchmark
-feature width (BASELINE.md: PCA/KMeans/LogReg fit at 100M x 256 scale; we
-measure per-chip throughput on a slice of that workload so the number scales
-linearly to pod size).
+Covers the three BASELINE.md fit workloads (PCA, KMeans, LogisticRegression;
+reference methodology ``/root/reference/python/benchmark/databricks/run_benchmark.sh:44-135``)
+at the 256-feature width of the 100M x 256 north-star, measuring per-chip fit
+throughput so the number scales linearly to pod size.  Also reports an MFU
+estimate per algorithm (FLOP model / chip peak).
 
-``vs_baseline`` compares against an A10G cuML estimate derived from the
-reference's benchmark setup (BASELINE.md: 2x g5.2xlarge, 1M x 3000): PCA fit
-is Gram-bound at 2*n*d^2 FLOPs; an A10G sustains ~15 TFLOP/s fp32 effective
-on cuBLAS SYRK-shaped work, giving ~15e12 / (2*256^2) ≈ 1.1e8 samples/sec
-per GPU at d=256. vs_baseline = ours / that.
+``vs_baseline`` compares against an A10G cuML roofline estimate derived from
+the reference's benchmark hardware (BASELINE.md: 2x g5.2xlarge, A10G 24 GB):
+
+* PCA — Gram-bound, 2*n*d^2 FLOPs; A10G sustains ~15 TFLOP/s effective fp32
+  on SYRK-shaped work -> 15e12 / (2*256^2) ~= 1.1e8 samples/sec/GPU.
+* KMeans — distance-bound, 2*n*k*d FLOPs/iter (k=1024) ->
+  15e12 / (2*1024*256) ~= 2.9e7 sample-iters/sec/GPU.
+* LogReg — bandwidth-bound (matvec-shaped): ~2 passes over X per L-BFGS
+  iter at 600 GB/s A10G HBM -> 600e9 / (2*256*4) ~= 2.9e8
+  sample-iters/sec/GPU.
+
+Headline metric stays ``pca_fit_throughput`` (round-1 continuity); the same
+JSON line carries ``kmeans``/``logreg`` sub-objects and per-algo MFU.
+
+Robustness (round-1 postmortem): any algo failing with a transient
+``UNAVAILABLE`` TPU backend error is retried once after a cooldown; partial
+results still produce a JSON line; diagnostics go to stderr.
 """
 
 import json
+import math
 import os
 import sys
 import time
+import traceback
 
 import numpy as np
 
+# Honor an env/CLI platform pin in-process (sitecustomize TPU hooks ignore
+# plain env vars) BEFORE the first backend touch.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from spark_rapids_ml_tpu.utils.platform import pin_platform  # noqa: E402
 
-def main() -> None:
+_platform = None
+for _i, _a in enumerate(sys.argv[1:], start=1):
+    if _a == "--platform":
+        if _i + 1 >= len(sys.argv):
+            sys.exit("--platform requires a value (cpu|tpu)")
+        _platform = sys.argv[_i + 1]
+    elif _a.startswith("--platform="):
+        _platform = _a.split("=", 1)[1]
+pin_platform(_platform)
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 4_000_000))
+N_COLS = int(os.environ.get("BENCH_COLS", 256))
+KMEANS_K = int(os.environ.get("BENCH_KMEANS_K", 1024))
+KMEANS_ITERS = 10
+LOGREG_ITERS = 20
+CSIZE = min(16384, max(256, N_ROWS // 8))
+
+# bf16 peak FLOP/s per chip by device kind (MFU denominator).
+_PEAK_BY_KIND = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+_CPU_PEAK = 1e12  # nominal, keeps MFU finite on the CPU fallback
+
+
+def _chip_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, peak in _PEAK_BY_KIND:
+        if key in kind:
+            return peak
+    return _CPU_PEAK
+
+
+def _best_time(fn, reps: int = 3) -> float:
     import jax
 
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def bench_pca(X, mask, mesh, n_chips):
     from spark_rapids_ml_tpu.models.feature import _pca_fit_kernel
+
+    t = _best_time(lambda: _pca_fit_kernel(X, mask, 3))
+    n = N_ROWS
+    flops = 2.0 * n * N_COLS * N_COLS  # Gram dominates
+    return {
+        "samples_per_sec_per_chip": n / t / n_chips,
+        "fit_seconds": t,
+        "flops_model": flops,
+        "baseline_samples_per_sec": 1.1e8,
+    }
+
+
+def bench_kmeans(X, mask, mesh, n_chips):
+    import jax
+
+    from spark_rapids_ml_tpu.ops.kmeans_kernels import kmeans_lloyd
+
+    rng = np.random.default_rng(1)
+    centers0 = jax.device_put(
+        rng.standard_normal((KMEANS_K, N_COLS), dtype=np.float32)
+    )
+    csize = CSIZE
+
+    def run():
+        return kmeans_lloyd(
+            X, mask, centers0, mesh=mesh, csize=csize,
+            max_iter=KMEANS_ITERS, tol=0.0,
+        )
+
+    t = _best_time(run)
+    # tol=0 -> always runs max_iter iterations (+1 final cost pass)
+    iters = KMEANS_ITERS + 1
+    # FLOPs are spent on padded rows; throughput counts real samples only
+    flops = 2.0 * X.shape[0] * KMEANS_K * N_COLS * iters
+    n = N_ROWS
+    return {
+        "samples_per_sec_per_chip": n * iters / t / n_chips,
+        "fit_seconds": t,
+        "iters": iters,
+        "flops_model": flops,
+        "baseline_samples_per_sec": 2.9e7,
+    }
+
+
+def bench_logreg(X, mask, y, mesh, n_chips):
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.logreg_kernels import logreg_fit
+
+    def run():
+        return logreg_fit(
+            X, mask, y,
+            n_classes=2, multinomial=False, fit_intercept=True,
+            standardization=False,
+            l1=jnp.float32(0.0), l2=jnp.float32(1e-5),
+            use_l1=False, max_iter=LOGREG_ITERS, tol=jnp.float32(0.0),
+        )
+
+    out = run()  # compile + get n_iter
+    iters = max(int(out["n_iter"]), 1)
+    t = _best_time(run)
+    n = N_ROWS
+    # ~2 objective evals/iter (step + line search), fwd+grad = 4*n*d each
+    flops = 8.0 * n * N_COLS * iters
+    return {
+        "samples_per_sec_per_chip": n * iters / t / n_chips,
+        "fit_seconds": t,
+        "iters": iters,
+        "flops_model": flops,
+        "baseline_samples_per_sec": 2.9e8,
+    }
+
+
+def _probe_backend(attempts: int = 2, probe_timeout: int = 90, cooldown: int = 20) -> None:
+    """Fail fast if the backend hangs at init (round-1 failure mode).
+
+    A wedged TPU tunnel blocks *inside* ``make_c_api_client`` — uninterruptible
+    from Python — so probe in a subprocess with a hard timeout before touching
+    the backend in-process.  Skipped when pinned to CPU.
+    """
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return
+    last = ""
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; print(jax.devices())"],
+                capture_output=True, text=True, timeout=probe_timeout,
+            )
+            if proc.returncode == 0:
+                return
+            last = proc.stderr[-2000:]
+        except subprocess.TimeoutExpired:
+            last = f"backend init did not respond within {probe_timeout}s (hang in make_c_api_client)"
+        print(f"[bench] backend probe attempt {attempt} failed: {last}", file=sys.stderr)
+        if attempt + 1 < attempts:
+            time.sleep(cooldown)
+    print(
+        "[bench] FATAL: accelerator backend unreachable after "
+        f"{attempts} probes; aborting instead of hanging. Last error: {last}",
+        file=sys.stderr,
+    )
+    sys.exit(1)
+
+
+def main() -> None:
+    _probe_backend()
+    import jax
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    peak = _chip_peak_flops(devices[0])
+
     from spark_rapids_ml_tpu.parallel.mesh import make_mesh, shard_rows
 
-    n_chips = len(jax.devices())
-    n, d, k = 4_000_000, 256, 3
-    rng = np.random.default_rng(0)
-    X = rng.normal(size=(n, d)).astype(np.float32)
-
     mesh = make_mesh(n_chips)
-    Xd, mask = shard_rows(X, mesh)
-    jax.block_until_ready(Xd)
+    rng = np.random.default_rng(0)
+    Xh = rng.standard_normal((N_ROWS, N_COLS), dtype=np.float32)
+    w_true = rng.standard_normal((N_COLS,), dtype=np.float32)
+    yh = (Xh @ w_true > 0).astype(np.float32)
 
-    # warmup / compile
-    out = _pca_fit_kernel(Xd, mask, k)
-    jax.block_until_ready(out)
+    csize = CSIZE
+    X, mask = shard_rows(Xh, mesh, row_multiple=csize)
+    y, _ = shard_rows(yh, mesh, row_multiple=csize)
+    jax.block_until_ready(X)
+    del Xh, yh
 
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = _pca_fit_kernel(Xd, mask, k)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-    samples_per_sec_per_chip = n / best / n_chips
+    runs = {
+        "pca": lambda: bench_pca(X, mask, mesh, n_chips),
+        "kmeans": lambda: bench_kmeans(X, mask, mesh, n_chips),
+        "logreg": lambda: bench_logreg(X, mask, y, mesh, n_chips),
+    }
+    results = {}
+    for name, fn in runs.items():
+        for attempt in (0, 1):
+            try:
+                res = fn()
+                res["mfu"] = res["flops_model"] / (
+                    res["fit_seconds"] * peak * n_chips
+                )
+                res["vs_baseline"] = (
+                    res["samples_per_sec_per_chip"] / res["baseline_samples_per_sec"]
+                )
+                results[name] = res
+                print(
+                    f"[bench] {name}: {res['samples_per_sec_per_chip']:.3e} "
+                    f"samples/sec/chip, mfu={res['mfu']:.3f}, "
+                    f"vs_baseline={res['vs_baseline']:.2f}",
+                    file=sys.stderr,
+                )
+                break
+            except Exception as e:  # noqa: BLE001
+                transient = "UNAVAILABLE" in str(e)
+                print(
+                    f"[bench] {name} attempt {attempt} failed"
+                    f"{' (transient, will retry)' if transient and attempt == 0 else ''}:\n"
+                    f"{traceback.format_exc()}",
+                    file=sys.stderr,
+                )
+                if not (transient and attempt == 0):
+                    break
+                time.sleep(15)
 
-    baseline = 1.1e8  # A10G cuML PCA estimate at d=256, see module docstring
-    print(
-        json.dumps(
-            {
-                "metric": "pca_fit_throughput",
-                "value": round(samples_per_sec_per_chip, 1),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(samples_per_sec_per_chip / baseline, 3),
-            }
-        )
-    )
+    if not results:
+        print("[bench] all algorithms failed; no metric to report", file=sys.stderr)
+        sys.exit(1)
+
+    vs = [r["vs_baseline"] for r in results.values()]
+    geomean_vs = math.exp(sum(math.log(v) for v in vs) / len(vs))
+    headline = results.get("pca") or next(iter(results.values()))
+    line = {
+        "metric": "pca_fit_throughput",
+        "value": round(headline["samples_per_sec_per_chip"], 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(headline["vs_baseline"], 3),
+        "vs_baseline_geomean": round(geomean_vs, 3),
+        "device": getattr(devices[0], "device_kind", "cpu"),
+        "n_chips": n_chips,
+        "n_rows": N_ROWS,
+        "n_cols": N_COLS,
+    }
+    for name, r in results.items():
+        line[name] = {
+            "samples_per_sec_per_chip": round(r["samples_per_sec_per_chip"], 1),
+            "fit_seconds": round(r["fit_seconds"], 4),
+            "mfu": round(r["mfu"], 4),
+            "vs_baseline": round(r["vs_baseline"], 3),
+        }
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

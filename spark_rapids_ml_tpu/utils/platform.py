@@ -1,0 +1,82 @@
+"""In-process JAX platform selection.
+
+Environment-variable pins (``JAX_PLATFORMS=cpu``) are unreliable here: a TPU
+plugin installed via ``sitecustomize`` may override the platform list after
+env vars are read, and a subprocess that merely *imports* jax and touches
+``jax.devices()`` will then block inside the TPU client handshake.  The only
+robust pin is ``jax.config.update("jax_platforms", ...)`` applied in-process
+BEFORE the first backend touch (the pattern ``tests/conftest.py`` uses).
+
+This module centralizes that dance so every entry point (tests, benchmark
+runner, driver dry-runs) pins the same way.  The reference's analog is GPU
+device selection inside the barrier task
+(``/root/reference/python/src/spark_rapids_ml/core.py:366-383``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def pin_platform(
+    platform: Optional[str] = None, host_device_count: Optional[int] = None
+) -> None:
+    """Pin the JAX platform in-process, before any backend is initialized.
+
+    Parameters
+    ----------
+    platform:
+        ``"cpu"`` / ``"tpu"`` / ``None``.  ``None`` consults the
+        ``JAX_PLATFORMS`` env var (applying it in-process so it actually
+        takes effect even under a sitecustomize TPU hook); if that is also
+        unset, nothing is pinned and jax picks its default backend.
+    host_device_count:
+        When simulating a multi-chip mesh on CPU, the number of virtual
+        host devices (``--xla_force_host_platform_device_count``).  Must be
+        applied via XLA_FLAGS before backend init; ignored if the flag is
+        already present in XLA_FLAGS.
+
+    Must be called before the first ``jax.devices()`` / array op.  Calling
+    it after backend init raises a RuntimeError rather than silently
+    pinning nothing.
+    """
+    if platform is None:
+        platform = os.environ.get("JAX_PLATFORMS") or None
+    if host_device_count is not None:
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={host_device_count}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
+            )
+        else:
+            flags = f"{flags} {flag}".strip()
+        os.environ["XLA_FLAGS"] = flags
+    if platform is None:
+        return
+
+    import jax
+
+    if backend_initialized():
+        current = jax.local_devices()[0].platform
+        if current != platform:
+            raise RuntimeError(
+                f"pin_platform({platform!r}) called after the {current!r} backend "
+                "was initialized; pin before the first jax.devices()/array op"
+            )
+        return
+    os.environ["JAX_PLATFORMS"] = platform
+    jax.config.update("jax_platforms", platform)
+
+
+def backend_initialized() -> bool:
+    """True if any jax backend has already been created in this process."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
