@@ -164,6 +164,61 @@ def bench_logreg(X, mask, y, mesh, n_chips):
     }
 
 
+def bench_pca_stream(mesh, n_chips):
+    """Out-of-core PCA: chunks stream through a bounded device buffer
+    (``ops/streaming.py``), the path that handles beyond-HBM datasets
+    (BASELINE.md 100M x 256 north-star). Self-calibrates the row count so a
+    slow host->device link cannot blow the wall-clock budget; the reported
+    rate is per-pass ingest+accumulate throughput (2 passes per fit)."""
+    import jax
+
+    from spark_rapids_ml_tpu.data.chunks import GeneratorChunkSource
+    from spark_rapids_ml_tpu.models.feature import _pca_from_cov
+    from spark_rapids_ml_tpu.ops.streaming import streamed_suffstats
+
+    d = N_COLS
+    n_dp = mesh.shape["dp"]
+    chunk_rows = int(os.environ.get("BENCH_STREAM_CHUNK", 1 << 18))
+    chunk_rows = max(n_dp, (chunk_rows // n_dp) * n_dp)
+    rng = np.random.default_rng(2)
+    block = rng.standard_normal((chunk_rows, d), dtype=np.float32)
+
+    def gen(start, count, seed):
+        return block[:count], None
+
+    def run(rows):
+        src = GeneratorChunkSource(gen, rows, d)
+        stats = streamed_suffstats(src, mesh, chunk_rows, np.float32, with_y=False)
+        cov = stats["G"] / (stats["n"] - 1.0)
+        out = _pca_from_cov(stats["mean_x"], cov, stats["n"], 3)
+        jax.block_until_ready(out)
+        return out
+
+    # calibrate: compile + measure a 4-chunk fit, then size the real run
+    calib_rows = 4 * chunk_rows
+    run(calib_rows)  # compile
+    t0 = time.perf_counter()
+    run(calib_rows)
+    t_calib = time.perf_counter() - t0
+    budget_s = float(os.environ.get("BENCH_STREAM_SECONDS", 60))
+    max_rows = int(os.environ.get("BENCH_STREAM_ROWS", 16_000_000))
+    rows = int(min(max_rows, calib_rows * max(1.0, budget_s / max(t_calib, 1e-9))))
+    rows = max(chunk_rows, (rows // chunk_rows) * chunk_rows)
+
+    t0 = time.perf_counter()
+    run(rows)
+    t = time.perf_counter() - t0
+    flops = 2.0 * rows * d * d  # pass-2 Gram dominates
+    return {
+        "samples_per_sec_per_chip": rows / t / n_chips,
+        "fit_seconds": t,
+        "rows": rows,
+        "stream_gb": round(rows * d * 4 * 2 / 1e9, 2),  # 2 passes
+        "flops_model": flops,
+        "baseline_samples_per_sec": 1.1e8,
+    }
+
+
 def _probe_backend(attempts: int = 2, probe_timeout: int = 90, cooldown: int = 20) -> None:
     """Fail fast if the backend hangs at init (round-1 failure mode).
 
@@ -224,6 +279,7 @@ def main() -> None:
         "pca": lambda: bench_pca(X, mask, mesh, n_chips),
         "kmeans": lambda: bench_kmeans(X, mask, mesh, n_chips),
         "logreg": lambda: bench_logreg(X, mask, y, mesh, n_chips),
+        "pca_stream": lambda: bench_pca_stream(mesh, n_chips),
     }
     results = {}
     for name, fn in runs.items():

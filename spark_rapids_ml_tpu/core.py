@@ -128,6 +128,46 @@ class FitInputs:
 FitFunc = Callable[[FitInputs, Dict[str, Any]], Dict[str, Any]]
 
 
+@dataclass
+class StreamInputs:
+    """Chunked-fit inputs: a re-iterable source instead of resident arrays.
+
+    The out-of-core analog of :class:`FitInputs` (reference Arrow-batch
+    streaming + UVM, ``core.py:699-741``): device memory holds one chunk
+    slab plus algorithm state, never the dataset.
+    """
+
+    source: Any                      # data.chunks.ChunkSource
+    mesh: Any
+    n_rows: int
+    n_features: int
+    dtype: Any = jnp.float32
+    chunk_rows: int = 1 << 16
+
+
+# streaming fit function: (stream_inputs, params_dict) -> named arrays
+StreamFitFunc = Callable[[StreamInputs, Dict[str, Any]], Dict[str, Any]]
+
+
+def _default_stream_threshold_bytes() -> int:
+    """Dataset size above which fit streams instead of materializing.
+
+    Overridable via ``TPUML_STREAM_THRESHOLD_BYTES``. Default: 60% of one
+    device's reported memory (the design matrix must leave room for Gram
+    temporaries), or 8 GiB when the backend doesn't report memory (CPU)."""
+    env = os.environ.get("TPUML_STREAM_THRESHOLD_BYTES")
+    if env:
+        return int(env)
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        limit = int(stats.get("bytes_limit", 0)) if stats else 0
+        if limit > 0:
+            return int(0.6 * limit * len(jax.local_devices()))
+    except Exception:
+        pass
+    return 8 << 30
+
+
 class _TpuEstimator(Params, _TpuParams):
     """Abstract estimator (reference ``_CumlEstimator``, ``core.py:834-1032``)."""
 
@@ -153,6 +193,98 @@ class _TpuEstimator(Params, _TpuParams):
 
     def _enable_fit_multiple_in_single_pass(self) -> bool:
         return False
+
+    def _get_tpu_streaming_fit_func(
+        self, dataset: DataFrame
+    ) -> Optional[StreamFitFunc]:
+        """Chunked out-of-core fit, or None when the algorithm requires the
+        resident-matrix path. Engaged by :meth:`_should_stream`."""
+        return None
+
+    # ---- streaming decision / data plane --------------------------------
+    def _should_stream(self, dataset: DataFrame) -> bool:
+        if self._streaming is not None:
+            return bool(self._streaming)
+        from .data.dataframe import ParquetScanFrame
+
+        input_col, input_cols = self._get_input_columns()
+        if isinstance(dataset, ParquetScanFrame) and not dataset.is_materialized():
+            # multi-column features are resident-only; the scan will
+            # materialize transparently on column access
+            return input_cols is None
+        if input_cols is not None:
+            n_features = len(input_cols)
+        else:
+            col = dataset.column(input_col)
+            n_features = int(col.shape[1]) if col.ndim == 2 or _is_sparse(col) else 1
+        itemsize = 4 if self._float32_inputs else 8
+        est_bytes = dataset.count() * n_features * itemsize
+        return est_bytes > _default_stream_threshold_bytes()
+
+    def _pre_process_stream(self, dataset: DataFrame) -> StreamInputs:
+        from .data.chunks import (
+            ArrayChunkSource,
+            CSRChunkSource,
+            auto_chunk_rows,
+        )
+        from .data.dataframe import ParquetScanFrame
+
+        mesh = make_mesh(self.num_workers)
+        label_col = (
+            self.getOrDefault("labelCol") if self._require_label() else None
+        )
+        weight_col = None
+        if (
+            isinstance(self, HasWeightCol)
+            and self.hasParam("weightCol")
+            and self.isSet("weightCol")
+            and self.getOrDefault("weightCol") is not None
+        ):
+            weight_col = self.getOrDefault("weightCol")
+
+        if isinstance(dataset, ParquetScanFrame) and not dataset.is_materialized():
+            input_col, input_cols = self._get_input_columns()
+            if input_cols is not None:
+                raise ValueError(
+                    "streaming fit over a parquet scan requires a single "
+                    "vector features column (featuresCols is resident-only)"
+                )
+            source = dataset.chunk_source(
+                features_col=input_col, label_col=label_col, weight_col=weight_col
+            )
+            dtype = np.float32 if self._float32_inputs else np.float64
+        else:
+            X, X_sparse = _resolve_feature_matrix(self, dataset)
+            y = (
+                np.asarray(dataset.column(label_col))
+                if label_col is not None
+                else None
+            )
+            w = (
+                np.asarray(dataset.column(weight_col))
+                if weight_col is not None
+                else None
+            )
+            if X_sparse is not None:
+                dtype = np.float32 if self._float32_inputs else np.float64
+                source = CSRChunkSource(X_sparse, y, w)
+            else:
+                dtype = self._target_dtype(X)
+                source = ArrayChunkSource(X, y, w)
+
+        chunk_rows = self._stream_chunk_rows or auto_chunk_rows(
+            source.n_features, np.dtype(dtype).itemsize, mesh.shape["dp"]
+        )
+        n_dp = mesh.shape["dp"]
+        chunk_rows = max(n_dp, (chunk_rows // n_dp) * n_dp)
+        return StreamInputs(
+            source=source,
+            mesh=mesh,
+            n_rows=int(source.n_rows),
+            n_features=int(source.n_features),
+            dtype=jnp.dtype(dtype),
+            chunk_rows=int(chunk_rows),
+        )
 
     # ---- data plane ------------------------------------------------------
     def _target_dtype(self, X: Optional[np.ndarray]) -> Any:
@@ -258,8 +390,16 @@ class _TpuEstimator(Params, _TpuParams):
     def _fit_internal_x64scoped(
         self, dataset: DataFrame, paramMaps: Optional[List[Dict[Any, Any]]]
     ) -> List["_TpuModel"]:
-        inputs = self._pre_process_data(dataset)
-        fit_func = self._get_tpu_fit_func(dataset)
+        stream_func = self._get_tpu_streaming_fit_func(dataset)
+        if stream_func is not None and self._should_stream(dataset):
+            self.logger.info(
+                "Streaming fit engaged (out-of-core chunked ingestion)."
+            )
+            inputs: Any = self._pre_process_stream(dataset)
+            fit_func: Any = stream_func
+        else:
+            inputs = self._pre_process_data(dataset)
+            fit_func = self._get_tpu_fit_func(dataset)
         models: List[_TpuModel] = []
         param_sets: List[Dict[str, Any]]
         if paramMaps is None:
@@ -481,6 +621,8 @@ class _Writer:
             "tpuParams": {k: v for k, v in inst._tpu_params.items() if _json_ok(v)},
             "numWorkers": inst._num_workers,
             "float32Inputs": inst._float32_inputs,
+            "streaming": inst._streaming,
+            "streamChunkRows": inst._stream_chunk_rows,
         }
         with open(os.path.join(path, "metadata.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)
@@ -535,6 +677,8 @@ class _Reader:
         inst._tpu_params.update(meta.get("tpuParams", {}))
         inst._num_workers = meta.get("numWorkers")
         inst._float32_inputs = meta.get("float32Inputs", True)
+        inst._streaming = meta.get("streaming")
+        inst._stream_chunk_rows = meta.get("streamChunkRows")
         return inst
 
 

@@ -1,0 +1,308 @@
+"""Chunked data sources — the out-of-core ingestion plane.
+
+The reference streams Arrow record batches into each barrier task and
+concatenates them on device, with UVM oversubscription when the dataset
+exceeds HBM (``/root/reference/python/src/spark_rapids_ml/core.py:717-741``
+and ``core.py:699-707``).  TPUs have no UVM: the equivalent is *bounded
+device residency* — a fit streams fixed-shape host chunks through a small
+device buffer while algorithm state (sufficient statistics, centroids,
+optimizer state) stays resident.  Fixed chunk shapes keep XLA compiling the
+accumulation step exactly once.
+
+A :class:`ChunkSource` is a re-iterable description of a dataset: multiple
+passes (epochs) are first-class because iterative algorithms (KMeans,
+LogisticRegression) re-read the data every iteration.
+
+Sources:
+  * :class:`ArrayChunkSource`    — in-memory dense numpy arrays
+  * :class:`CSRChunkSource`      — scipy CSR, densified one chunk at a time
+    (the sparse ingestion path, reference ``core.py:196-241``)
+  * :class:`ParquetChunkSource`  — a directory of parquet files, read
+    file-by-file (never materializes the dataset on host)
+  * :class:`GeneratorChunkSource`— synthetic data generated per chunk from
+    a per-chunk seed (benchmark-scale datasets without host materialization)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import scipy.sparse as sp
+except Exception:  # pragma: no cover
+    sp = None
+
+
+@dataclass
+class Chunk:
+    """One fixed-shape slab of rows.
+
+    ``X`` always has exactly the requested ``chunk_rows`` rows; the last
+    chunk of a pass is zero-padded and ``n_valid`` marks the real rows.
+    """
+
+    X: np.ndarray                    # (chunk_rows, d)
+    n_valid: int
+    y: Optional[np.ndarray] = None   # (chunk_rows,)
+    w: Optional[np.ndarray] = None   # (chunk_rows,)
+
+    def mask(self, dtype: Any = np.float32) -> np.ndarray:
+        m = np.zeros((self.X.shape[0],), dtype=dtype)
+        m[: self.n_valid] = 1.0
+        return m
+
+
+class ChunkSource:
+    """Abstract re-iterable chunked dataset."""
+
+    n_rows: int
+    n_features: int
+    has_label: bool = False
+    has_weight: bool = False
+
+    def iter_chunks(self, chunk_rows: int, dtype: Any = np.float32) -> Iterator[Chunk]:
+        raise NotImplementedError
+
+    def num_chunks(self, chunk_rows: int) -> int:
+        return max(1, -(-self.n_rows // chunk_rows))
+
+
+def _pad_rows_to(a: Optional[np.ndarray], rows: int) -> Optional[np.ndarray]:
+    if a is None or a.shape[0] == rows:
+        return a
+    pad = [(0, rows - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad)
+
+
+class ArrayChunkSource(ChunkSource):
+    def __init__(
+        self,
+        X: np.ndarray,
+        y: Optional[np.ndarray] = None,
+        w: Optional[np.ndarray] = None,
+    ):
+        self._X, self._y, self._w = X, y, w
+        self.n_rows, self.n_features = X.shape
+        self.has_label = y is not None
+        self.has_weight = w is not None
+
+    def iter_chunks(self, chunk_rows: int, dtype: Any = np.float32) -> Iterator[Chunk]:
+        for lo in range(0, self.n_rows, chunk_rows):
+            hi = min(lo + chunk_rows, self.n_rows)
+            X = np.ascontiguousarray(self._X[lo:hi], dtype=dtype)
+            y = None if self._y is None else np.asarray(self._y[lo:hi], dtype=dtype)
+            w = None if self._w is None else np.asarray(self._w[lo:hi], dtype=dtype)
+            yield Chunk(
+                X=_pad_rows_to(X, chunk_rows),
+                n_valid=hi - lo,
+                y=_pad_rows_to(y, chunk_rows),
+                w=_pad_rows_to(w, chunk_rows),
+            )
+
+
+class CSRChunkSource(ChunkSource):
+    """Sparse CSR rows densified one chunk at a time.
+
+    TPUs have no sparse MXU path, so the sparse compute strategy is
+    *chunked densification*: host CSR slices become dense device slabs of
+    bounded size — device memory never holds the dense full matrix
+    (reference sparse ingestion + fit: ``core.py:196-241``).
+    """
+
+    def __init__(self, X_csr: Any, y: Optional[np.ndarray] = None,
+                 w: Optional[np.ndarray] = None):
+        assert sp is not None and sp.issparse(X_csr)
+        self._X = X_csr.tocsr()
+        self._y, self._w = y, w
+        self.n_rows, self.n_features = self._X.shape
+        self.has_label = y is not None
+        self.has_weight = w is not None
+
+    def iter_chunks(self, chunk_rows: int, dtype: Any = np.float32) -> Iterator[Chunk]:
+        for lo in range(0, self.n_rows, chunk_rows):
+            hi = min(lo + chunk_rows, self.n_rows)
+            X = np.asarray(self._X[lo:hi].todense(), dtype=dtype)
+            y = None if self._y is None else np.asarray(self._y[lo:hi], dtype=dtype)
+            w = None if self._w is None else np.asarray(self._w[lo:hi], dtype=dtype)
+            yield Chunk(
+                X=_pad_rows_to(X, chunk_rows),
+                n_valid=hi - lo,
+                y=_pad_rows_to(y, chunk_rows),
+                w=_pad_rows_to(w, chunk_rows),
+            )
+
+
+class ParquetChunkSource(ChunkSource):
+    """Stream a directory of parquet files without materializing it.
+
+    Host memory is bounded by one parquet file plus one chunk buffer.
+    Row counts and the feature dimension come from parquet metadata only.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        features_col: str = "features",
+        label_col: Optional[str] = None,
+        weight_col: Optional[str] = None,
+        _files: Optional[Sequence[str]] = None,
+        _n_rows: Optional[int] = None,
+    ):
+        import pyarrow.parquet as pq
+
+        # _files/_n_rows: pre-computed metadata from a ParquetScanFrame so
+        # the directory isn't re-listed and footers aren't re-read
+        if _files is not None:
+            self._files = list(_files)
+        elif os.path.isdir(path):
+            self._files = sorted(
+                os.path.join(path, f) for f in os.listdir(path) if f.endswith(".parquet")
+            )
+        else:
+            self._files = [path]
+        if not self._files:
+            raise FileNotFoundError(f"No parquet files under {path}")
+        self._features_col = features_col
+        self._label_col = label_col
+        self._weight_col = weight_col
+
+        if _n_rows is not None:
+            n = int(_n_rows)
+        else:
+            n = 0
+            for f in self._files:
+                n += pq.ParquetFile(f).metadata.num_rows
+        self.n_rows = n
+        schema = pq.ParquetFile(self._files[0]).schema_arrow
+        ftype = schema.field(features_col).type
+        import pyarrow as pa
+
+        if isinstance(ftype, pa.FixedSizeListType):
+            self.n_features = ftype.list_size
+        else:
+            # variable list: peek one row group
+            t = pq.ParquetFile(self._files[0]).read_row_group(0, columns=[features_col])
+            self.n_features = len(t.column(0)[0].as_py())
+        self.has_label = label_col is not None
+        self.has_weight = weight_col is not None
+
+    def _read_file(self, f: str, dtype: Any):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        cols = [self._features_col]
+        if self._label_col:
+            cols.append(self._label_col)
+        if self._weight_col:
+            cols.append(self._weight_col)
+        t = pq.read_table(f, columns=cols)
+        fc = t.column(self._features_col).combine_chunks()
+        if isinstance(fc.type, pa.FixedSizeListType):
+            X = fc.flatten().to_numpy(zero_copy_only=False).reshape(-1, self.n_features)
+        else:
+            X = np.stack([np.asarray(v) for v in fc.to_pylist()])
+        X = np.asarray(X, dtype=dtype)
+        y = w = None
+        if self._label_col:
+            y = t.column(self._label_col).to_numpy(zero_copy_only=False).astype(dtype)
+        if self._weight_col:
+            w = t.column(self._weight_col).to_numpy(zero_copy_only=False).astype(dtype)
+        return X, y, w
+
+    def iter_chunks(self, chunk_rows: int, dtype: Any = np.float32) -> Iterator[Chunk]:
+        bufX: List[np.ndarray] = []
+        bufy: List[np.ndarray] = []
+        bufw: List[np.ndarray] = []
+        buffered = 0
+
+        def drain(final: bool) -> Iterator[Chunk]:
+            nonlocal bufX, bufy, bufw, buffered
+            X = np.concatenate(bufX, axis=0) if len(bufX) > 1 else bufX[0]
+            y = (np.concatenate(bufy) if len(bufy) > 1 else bufy[0]) if bufy else None
+            w = (np.concatenate(bufw) if len(bufw) > 1 else bufw[0]) if bufw else None
+            lo = 0
+            while buffered - lo >= chunk_rows or (final and lo < buffered):
+                hi = min(lo + chunk_rows, buffered)
+                yield Chunk(
+                    X=_pad_rows_to(np.ascontiguousarray(X[lo:hi]), chunk_rows),
+                    n_valid=hi - lo,
+                    y=_pad_rows_to(None if y is None else y[lo:hi], chunk_rows),
+                    w=_pad_rows_to(None if w is None else w[lo:hi], chunk_rows),
+                )
+                lo = hi
+            bufX = [X[lo:]] if lo < buffered else []
+            bufy = [y[lo:]] if (y is not None and lo < buffered) else []
+            bufw = [w[lo:]] if (w is not None and lo < buffered) else []
+            buffered -= lo
+
+        for f in self._files:
+            X, y, w = self._read_file(f, dtype)
+            bufX.append(X)
+            if y is not None:
+                bufy.append(y)
+            if w is not None:
+                bufw.append(w)
+            buffered += X.shape[0]
+            if buffered >= chunk_rows:
+                yield from drain(final=False)
+        if buffered:
+            yield from drain(final=True)
+
+
+class GeneratorChunkSource(ChunkSource):
+    """Synthetic chunks from ``fn(start_row, n_rows, seed) -> (X, y|None)``.
+
+    Each chunk is generated deterministically from ``(seed, chunk_index)``,
+    the same per-partition-seed scheme the reference's distributed data
+    generators use (``python/benchmark/gen_data_distributed.py``): any chunk
+    can be produced independently, at any scale, with no host
+    materialization of the whole dataset.
+    """
+
+    def __init__(
+        self,
+        fn: Callable[[int, int, int], Tuple[np.ndarray, Optional[np.ndarray]]],
+        n_rows: int,
+        n_features: int,
+        seed: int = 0,
+        has_label: bool = False,
+    ):
+        self._fn = fn
+        self.n_rows = n_rows
+        self.n_features = n_features
+        self._seed = seed
+        self.has_label = has_label
+
+    def iter_chunks(self, chunk_rows: int, dtype: Any = np.float32) -> Iterator[Chunk]:
+        idx = 0
+        for lo in range(0, self.n_rows, chunk_rows):
+            hi = min(lo + chunk_rows, self.n_rows)
+            X, y = self._fn(lo, hi - lo, self._seed + idx)
+            X = np.ascontiguousarray(np.asarray(X, dtype=dtype))
+            y = None if y is None else np.asarray(y, dtype=dtype)
+            yield Chunk(
+                X=_pad_rows_to(X, chunk_rows),
+                n_valid=hi - lo,
+                y=_pad_rows_to(y, chunk_rows),
+            )
+            idx += 1
+
+
+def auto_chunk_rows(
+    n_features: int,
+    itemsize: int,
+    n_dp: int,
+    target_bytes: int = 128 << 20,
+    max_rows: int = 1 << 20,
+) -> int:
+    """Rows per chunk so one chunk is ~``target_bytes`` on device, rounded
+    to a multiple of the dp mesh size (every device gets an equal slab)."""
+    rows = max(1, target_bytes // max(1, n_features * itemsize))
+    rows = min(rows, max_rows)
+    mult = max(1, n_dp)
+    rows = max(mult, (rows // mult) * mult)
+    return rows

@@ -294,6 +294,15 @@ class DataFrame:
             file_idx += 1
 
     @staticmethod
+    def scan_parquet(path: str, num_partitions: int = 1) -> "ParquetScanFrame":
+        """Lazy parquet scan: rows are never materialized on host unless a
+        column is accessed.  Estimators with a streaming fit path consume
+        this frame chunk-by-chunk (the analog of the reference reading Arrow
+        batches per task instead of collecting the DataFrame,
+        ``core.py:717-741``)."""
+        return ParquetScanFrame(path, num_partitions)
+
+    @staticmethod
     def read_parquet(path: str, num_partitions: int = 1) -> "DataFrame":
         import pyarrow.parquet as pq
 
@@ -320,6 +329,88 @@ class DataFrame:
             else:
                 data[name] = col.to_numpy(zero_copy_only=False)
         return DataFrame(data, num_partitions)
+
+
+class ParquetScanFrame(DataFrame):
+    """A DataFrame whose columns stay on disk until touched.
+
+    ``count()`` / ``columns`` / ``dtypes()`` come from parquet metadata.
+    Accessing any column (or any mutating/materializing method inherited
+    from :class:`DataFrame`) transparently reads the files; streaming
+    estimators instead take :meth:`chunk_source` and never materialize.
+    """
+
+    def __init__(self, path: str, num_partitions: int = 1):
+        import pyarrow.parquet as pq
+
+        if os.path.isdir(path):
+            files = sorted(
+                os.path.join(path, f) for f in os.listdir(path) if f.endswith(".parquet")
+            )
+        else:
+            files = [path]
+        if not files:
+            raise FileNotFoundError(f"No parquet files under {path}")
+        self._path = path
+        self._files = files
+        self._schema = pq.ParquetFile(files[0]).schema_arrow
+        self._nrows = sum(pq.ParquetFile(f).metadata.num_rows for f in files)
+        self._num_partitions = max(1, int(num_partitions))
+        self._materialized: Optional[Dict[str, ColumnLike]] = None
+
+    # `_data` drives every inherited method; materialize on first touch
+    @property
+    def _data(self) -> Dict[str, ColumnLike]:
+        if self._materialized is None:
+            self._materialized = DataFrame.read_parquet(self._path)._data
+        return self._materialized
+
+    @_data.setter
+    def _data(self, value: Dict[str, ColumnLike]) -> None:
+        self._materialized = value
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._schema.names)
+
+    def count(self) -> int:
+        return self._nrows
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._schema.names
+
+    def dtypes(self) -> List[Tuple[str, str]]:
+        import pyarrow as pa
+
+        out = []
+        for f in self._schema:
+            if isinstance(f.type, pa.FixedSizeListType):
+                out.append((f.name, f"vector<{f.type.value_type}>[{f.type.list_size}]"))
+            elif pa.types.is_list(f.type) or pa.types.is_large_list(f.type):
+                out.append((f.name, f"vector<{f.type.value_type}>[?]"))
+            else:
+                out.append((f.name, str(f.type)))
+        return out
+
+    def is_materialized(self) -> bool:
+        return self._materialized is not None
+
+    def chunk_source(
+        self,
+        features_col: str = "features",
+        label_col: Optional[str] = None,
+        weight_col: Optional[str] = None,
+    ):
+        from .chunks import ParquetChunkSource
+
+        return ParquetChunkSource(
+            self._path,
+            features_col=features_col,
+            label_col=label_col,
+            weight_col=weight_col,
+            _files=self._files,
+            _n_rows=self._nrows,
+        )
 
 
 def kfold(df: DataFrame, n_folds: int, seed: int = 0) -> List[Tuple[DataFrame, DataFrame]]:

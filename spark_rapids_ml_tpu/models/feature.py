@@ -69,8 +69,9 @@ class _PCAParams(HasInputCol, HasOutputCol, HasFeaturesCol, HasFeaturesCols):
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
-def _pca_fit_kernel(X: jax.Array, mask: jax.Array, k: int):
-    mean, cov, n = mean_and_cov(X, mask)
+def _pca_from_cov(mean: jax.Array, cov: jax.Array, n: jax.Array, k: int):
+    """Finalize PCA from (mean, covariance, count) — shared by the resident
+    and streaming fits so both produce bit-identical model attributes."""
     evals, evecs = topk_eigh(cov, k)
     evals = jnp.maximum(evals, 0.0)
     total_var = jnp.trace(cov)
@@ -83,6 +84,12 @@ def _pca_fit_kernel(X: jax.Array, mask: jax.Array, k: int):
         "explained_variance_ratio": evals / total_var,
         "singular_values": singular_values,
     }
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _pca_fit_kernel(X: jax.Array, mask: jax.Array, k: int):
+    mean, cov, n = mean_and_cov(X, mask)
+    return _pca_from_cov(mean, cov, n, k)
 
 
 class PCA(PCAClass, _TpuEstimator, _PCAParams):
@@ -113,6 +120,29 @@ class PCA(PCAClass, _TpuEstimator, _PCAParams):
                     f"k={k} must be <= number of features {inputs.n_features}"
                 )
             out = _pca_fit_kernel(inputs.X, inputs.mask, k)
+            return {key: np.asarray(v) for key, v in out.items()}
+
+        return _fit
+
+    def _get_tpu_streaming_fit_func(self, dataset: DataFrame):
+        """Out-of-core fit: two chunked passes (mean, then centered Gram)
+        accumulate the d×d covariance with O(chunk + d²) device memory; the
+        eigh finalize is shared with the resident kernel."""
+        from ..core import StreamInputs
+        from ..ops.streaming import streamed_suffstats
+
+        def _fit(inputs: StreamInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            k = int(params.get("n_components") or self.getK())
+            if k > inputs.n_features:
+                raise ValueError(
+                    f"k={k} must be <= number of features {inputs.n_features}"
+                )
+            stats = streamed_suffstats(
+                inputs.source, inputs.mesh, inputs.chunk_rows, inputs.dtype,
+                with_y=False, fit_intercept=True,
+            )
+            cov = stats["G"] / (stats["n"] - 1.0)
+            out = _pca_from_cov(stats["mean_x"], cov, stats["n"], k)
             return {key: np.asarray(v) for key, v in out.items()}
 
         return _fit

@@ -165,6 +165,38 @@ class LinearRegression(
 
         return isinstance(evaluator, RegressionEvaluator)
 
+    @staticmethod
+    def _solve_from_stats(
+        stats: Dict[str, jax.Array], params: Dict[str, Any], dtype: Any
+    ) -> Dict[str, Any]:
+        """Solver dispatch on precomputed sufficient statistics — shared by
+        the resident and streaming fits so the two paths cannot diverge."""
+        alpha = float(params["alpha"])
+        l1_ratio = float(params["l1_ratio"])
+        standardization = bool(params["standardization"])
+        l1 = alpha * l1_ratio
+        l2 = alpha * (1.0 - l1_ratio)
+        if l1 == 0.0:
+            beta, intercept = solve_normal(
+                stats, jnp.asarray(l2, dtype), standardization=standardization
+            )
+            n_iter = 1
+        else:
+            beta, intercept, it = solve_elasticnet(
+                stats,
+                jnp.asarray(l1, dtype),
+                jnp.asarray(l2, dtype),
+                standardization=standardization,
+                max_iter=int(params["max_iter"]),
+                tol=float(params["tol"]),
+            )
+            n_iter = int(it)
+        return {
+            "coefficients": np.asarray(beta),
+            "intercept": float(intercept),
+            "n_iter": n_iter,
+        }
+
     def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
         stats_cache: Dict[bool, Dict[str, jax.Array]] = {}
 
@@ -176,33 +208,32 @@ class LinearRegression(
                     inputs.X, inputs.mask, inputs.y, inputs.weight,
                     fit_intercept=fit_intercept,
                 )
-            stats = stats_cache[fit_intercept]
-            alpha = float(params["alpha"])
-            l1_ratio = float(params["l1_ratio"])
-            standardization = bool(params["standardization"])
-            l1 = alpha * l1_ratio
-            l2 = alpha * (1.0 - l1_ratio)
-            if l1 == 0.0:
-                beta, intercept = solve_normal(
-                    stats, jnp.asarray(l2, inputs.dtype),
-                    standardization=standardization,
+            return self._solve_from_stats(
+                stats_cache[fit_intercept], params, inputs.dtype
+            )
+
+        return _fit
+
+    def _get_tpu_streaming_fit_func(self, dataset: DataFrame):
+        """Out-of-core fit: the sufficient statistics (Gram, Xᵀy, moments)
+        accumulate over two chunked passes; every solver (Cholesky, FISTA)
+        and every param map then reuses them with zero further data passes —
+        the streaming analog of the resident single-pass ``fitMultiple``."""
+        from ..core import StreamInputs
+        from ..ops.streaming import streamed_suffstats
+
+        stats_cache: Dict[bool, Dict[str, jax.Array]] = {}
+
+        def _fit(inputs: StreamInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            fit_intercept = bool(params["fit_intercept"])
+            if fit_intercept not in stats_cache:
+                stats_cache[fit_intercept] = streamed_suffstats(
+                    inputs.source, inputs.mesh, inputs.chunk_rows, inputs.dtype,
+                    with_y=True, fit_intercept=fit_intercept,
                 )
-                n_iter = 1
-            else:
-                beta, intercept, it = solve_elasticnet(
-                    stats,
-                    jnp.asarray(l1, inputs.dtype),
-                    jnp.asarray(l2, inputs.dtype),
-                    standardization=standardization,
-                    max_iter=int(params["max_iter"]),
-                    tol=float(params["tol"]),
-                )
-                n_iter = int(it)
-            return {
-                "coefficients": np.asarray(beta),
-                "intercept": float(intercept),
-                "n_iter": n_iter,
-            }
+            return self._solve_from_stats(
+                stats_cache[fit_intercept], params, inputs.dtype
+            )
 
         return _fit
 

@@ -1,0 +1,176 @@
+"""Streaming (out-of-core) accumulation kernels.
+
+The reference holds the whole per-worker partition on device and lets cuML
+reduce over it (UVM for beyond-HBM datasets,
+``/root/reference/python/src/spark_rapids_ml/core.py:699-741``).  The
+TPU-native scheme: fixed-shape host chunks stream through a small device
+buffer; these jitted steps fold each chunk into replicated accumulator
+state.  Chunks are row-sharded over the ``dp`` mesh axis and accumulators
+are replicated, so XLA's SPMD partitioner inserts exactly one psum of each
+partial per chunk — the same communication the reference's NCCL allreduce
+performed, amortized over chunks.
+
+Accumulators are donated (``donate_argnums=0``) so device memory stays
+constant across chunks: one chunk slab + O(d²) state, independent of n.
+
+Numerics: means first, centered Gram second (two passes) — the same
+center-before-Gram discipline as the in-memory kernels (``ops/linalg.py``),
+avoiding the f32 catastrophic cancellation of one-pass covariance.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..data.chunks import Chunk, ChunkSource
+from ..parallel.mesh import row_sharding
+
+
+# ---------------------------------------------------------------------------
+# Chunk transfer
+# ---------------------------------------------------------------------------
+
+
+def put_chunk(chunk: Chunk, mesh, dtype) -> Dict[str, Optional[jax.Array]]:
+    """device_put one host chunk row-sharded over dp.  Transfers are async:
+    the next chunk's H2D overlaps the current chunk's accumulation step."""
+    sh = row_sharding(mesh)
+    out: Dict[str, Optional[jax.Array]] = {
+        "X": jax.device_put(np.asarray(chunk.X, dtype=dtype), sh),
+        "mask": jax.device_put(chunk.mask(dtype), sh),
+        "y": None,
+        "w": None,
+    }
+    if chunk.y is not None:
+        out["y"] = jax.device_put(np.asarray(chunk.y, dtype=dtype), sh)
+    if chunk.w is not None:
+        out["w"] = jax.device_put(np.asarray(chunk.w, dtype=dtype), sh)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: weighted first moments
+# ---------------------------------------------------------------------------
+
+
+def moments1_init(d: int, dtype, with_y: bool) -> Dict[str, jax.Array]:
+    acc = {
+        "n": jnp.zeros((), dtype),
+        "sum_x": jnp.zeros((d,), dtype),
+    }
+    if with_y:
+        acc["sum_y"] = jnp.zeros((), dtype)
+    return acc
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def moments1_step(
+    acc: Dict[str, jax.Array],
+    X: jax.Array,
+    rw: jax.Array,
+    y: Optional[jax.Array] = None,
+) -> Dict[str, jax.Array]:
+    """Fold one chunk into (Σw, Σw·x [, Σw·y]).  ``rw`` = mask·weight."""
+    out = dict(acc)
+    out["n"] = acc["n"] + rw.sum()
+    out["sum_x"] = acc["sum_x"] + (X * rw[:, None]).sum(axis=0)
+    if y is not None:
+        out["sum_y"] = acc["sum_y"] + (y * rw).sum()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: centered second moments (Gram / cross / residual)
+# ---------------------------------------------------------------------------
+
+
+def gram2_init(d: int, dtype, with_y: bool) -> Dict[str, jax.Array]:
+    acc = {"G": jnp.zeros((d, d), dtype)}
+    if with_y:
+        acc["Xy"] = jnp.zeros((d,), dtype)
+        acc["yy"] = jnp.zeros((), dtype)
+    return acc
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def gram2_step(
+    acc: Dict[str, jax.Array],
+    X: jax.Array,
+    rw: jax.Array,
+    mean_x: jax.Array,
+    y: Optional[jax.Array] = None,
+    mean_y: Optional[jax.Array] = None,
+) -> Dict[str, jax.Array]:
+    """Fold one chunk into G=(Xc√w)'(Xc√w) [, Xy, yy] centered at mean."""
+    sw = jnp.sqrt(rw)
+    Xc = (X - mean_x[None, :]) * sw[:, None]
+    out = dict(acc)
+    out["G"] = acc["G"] + Xc.T @ Xc
+    if y is not None:
+        yc = (y - mean_y) * sw
+        out["Xy"] = acc["Xy"] + Xc.T @ yc
+        out["yy"] = acc["yy"] + (yc * yc).sum()
+    return out
+
+
+def streamed_suffstats(
+    source: ChunkSource,
+    mesh,
+    chunk_rows: int,
+    dtype,
+    *,
+    with_y: bool = False,
+    fit_intercept: bool = True,
+) -> Dict[str, jax.Array]:
+    """Two streaming passes -> the same stats dict as
+    ``ops.linreg_kernels.linreg_suffstats`` (n, mean_x, mean_y, G, Xy, yy,
+    var) / the inputs of ``mean_and_cov`` — so every downstream solver
+    (Cholesky OLS/ridge, FISTA elasticnet, eigh PCA) is reused unchanged.
+    """
+    d = source.n_features
+    np_dtype = np.dtype(jnp.dtype(dtype).name)
+
+    acc1 = moments1_init(d, dtype, with_y)
+    for chunk in source.iter_chunks(chunk_rows, np_dtype):
+        dev = put_chunk(chunk, mesh, dtype)
+        rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
+        acc1 = moments1_step(acc1, dev["X"], rw, dev["y"] if with_y else None)
+    n = acc1["n"]
+    mean_all = acc1["sum_x"] / n
+    if fit_intercept:
+        mean_x = mean_all
+        mean_y = (acc1["sum_y"] / n) if with_y else None
+    else:
+        mean_x = jnp.zeros((d,), dtype)
+        mean_y = jnp.zeros((), dtype) if with_y else None
+
+    acc2 = gram2_init(d, dtype, with_y)
+    for chunk in source.iter_chunks(chunk_rows, np_dtype):
+        dev = put_chunk(chunk, mesh, dtype)
+        rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
+        acc2 = gram2_step(
+            acc2, dev["X"], rw, mean_x,
+            dev["y"] if with_y else None, mean_y,
+        )
+
+    var = jnp.diagonal(acc2["G"]) / n
+    if not fit_intercept:
+        var = var - mean_all * mean_all
+    stats: Dict[str, jax.Array] = {
+        "n": n,
+        "mean_x": mean_x,
+        "mean_all": mean_all,
+        "G": acc2["G"],
+        "var": var,
+    }
+    if with_y:
+        stats["mean_y"] = mean_y
+        stats["Xy"] = acc2["Xy"]
+        stats["yy"] = acc2["yy"]
+    return stats

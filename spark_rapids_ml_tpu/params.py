@@ -508,6 +508,10 @@ class _TpuParams(_TpuClass):
     _tpu_params: Dict[str, Any]
     _num_workers: Optional[int] = None
     _float32_inputs: bool = True
+    # streaming (out-of-core) fit: True = force, False = never, None = auto
+    # (engaged for lazy parquet scans or datasets above the device threshold)
+    _streaming: Optional[bool] = None
+    _stream_chunk_rows: Optional[int] = None
 
     def _init_tpu_params(self) -> None:
         self._tpu_params = dict(self._get_tpu_params_default())
@@ -560,6 +564,12 @@ class _TpuParams(_TpuClass):
             if name == "float32_inputs":
                 self._float32_inputs = bool(value)
                 continue
+            if name == "streaming":
+                self._streaming = None if value is None else bool(value)
+                continue
+            if name == "stream_chunk_rows":
+                self._stream_chunk_rows = None if value is None else int(value)
+                continue
             if self.hasParam(name):
                 self._set(**{name: value})
                 if name in mapping:
@@ -593,6 +603,8 @@ class _TpuParams(_TpuClass):
         to._tpu_params = dict(self._tpu_params)
         to._num_workers = self._num_workers
         to._float32_inputs = self._float32_inputs
+        to._streaming = self._streaming
+        to._stream_chunk_rows = self._stream_chunk_rows
         return to
 
     # -- input column resolution ------------------------------------------

@@ -1,0 +1,215 @@
+"""Out-of-core (streaming) fit tests.
+
+Contract: the streaming path must produce the SAME model as the resident
+path (the reference's Arrow-batch streaming is exact, not approximate —
+``core.py:717-741``), with device memory bounded by one chunk + state.
+Tiny ``stream_chunk_rows`` values force many chunks so boundary handling is
+exercised hard.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.data.chunks import (
+    ArrayChunkSource,
+    CSRChunkSource,
+    GeneratorChunkSource,
+    ParquetChunkSource,
+    auto_chunk_rows,
+)
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.regression import LinearRegression
+
+
+# ---------------------------------------------------------------------------
+# chunk sources
+# ---------------------------------------------------------------------------
+
+
+def test_array_chunk_source_padding_and_reiteration(rng):
+    X = rng.normal(size=(103, 5)).astype(np.float32)
+    y = rng.normal(size=(103,)).astype(np.float32)
+    src = ArrayChunkSource(X, y)
+    for _ in range(2):  # re-iterable
+        chunks = list(src.iter_chunks(32))
+        assert len(chunks) == 4
+        assert all(c.X.shape == (32, 5) for c in chunks)
+        assert [c.n_valid for c in chunks] == [32, 32, 32, 7]
+        # masked reconstruction matches the original
+        rec = np.concatenate([c.X[: c.n_valid] for c in chunks])
+        np.testing.assert_array_equal(rec, X)
+        recy = np.concatenate([c.y[: c.n_valid] for c in chunks])
+        np.testing.assert_array_equal(recy, y)
+        # padding rows are zero and masked out
+        assert chunks[-1].X[7:].sum() == 0
+        assert chunks[-1].mask().sum() == 7
+
+
+def test_csr_chunk_source_densifies_per_chunk(rng):
+    sp = pytest.importorskip("scipy.sparse")
+    X = sp.random(90, 7, density=0.2, format="csr", random_state=0, dtype=np.float64)
+    src = CSRChunkSource(X)
+    chunks = list(src.iter_chunks(40))
+    assert len(chunks) == 3
+    rec = np.concatenate([c.X[: c.n_valid] for c in chunks])
+    np.testing.assert_allclose(rec, np.asarray(X.todense()), rtol=1e-6)
+
+
+def test_parquet_chunk_source_crosses_file_boundaries(tmp_path, rng):
+    X = rng.normal(size=(157, 4)).astype(np.float32)
+    y = rng.normal(size=(157,)).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+    path = str(tmp_path / "ds")
+    df.write_parquet(path, rows_per_file=23)  # 7 ragged files
+    src = ParquetChunkSource(path, label_col="label")
+    assert src.n_rows == 157 and src.n_features == 4
+    # chunk size not aligned with file size: chunks must cross files
+    chunks = list(src.iter_chunks(50))
+    assert [c.n_valid for c in chunks] == [50, 50, 50, 7]
+    rec = np.concatenate([c.X[: c.n_valid] for c in chunks])
+    np.testing.assert_allclose(rec, X, rtol=1e-6)
+    recy = np.concatenate([c.y[: c.n_valid] for c in chunks])
+    np.testing.assert_allclose(recy, y, rtol=1e-6)
+
+
+def test_generator_chunk_source_deterministic():
+    def gen(start, count, seed):
+        r = np.random.default_rng(seed)
+        return r.normal(size=(count, 3)), None
+
+    a = GeneratorChunkSource(gen, 100, 3, seed=5)
+    c1 = [c.X.copy() for c in a.iter_chunks(32)]
+    c2 = [c.X.copy() for c in a.iter_chunks(32)]
+    for x1, x2 in zip(c1, c2):
+        np.testing.assert_array_equal(x1, x2)
+
+
+def test_auto_chunk_rows_dp_multiple():
+    rows = auto_chunk_rows(n_features=100, itemsize=4, n_dp=8, target_bytes=1 << 20)
+    assert rows % 8 == 0 and rows >= 8
+
+
+# ---------------------------------------------------------------------------
+# streaming == resident equivalence
+# ---------------------------------------------------------------------------
+
+
+def _pca_attrs(m):
+    return {
+        "mean": m.mean_,
+        "components": m.components_,
+        "ev": m.explained_variance_,
+        "sv": m.singular_values_,
+    }
+
+
+def test_pca_streaming_matches_resident(rng):
+    X = rng.normal(size=(301, 12)).astype(np.float32) + 5.0
+    df = DataFrame({"features": X})
+    resident = PCA(k=4, num_workers=4, streaming=False).fit(df)
+    streamed = PCA(k=4, num_workers=4, streaming=True, stream_chunk_rows=64).fit(df)
+    for k, v in _pca_attrs(resident).items():
+        np.testing.assert_allclose(
+            _pca_attrs(streamed)[k], v, rtol=2e-4, atol=2e-5, err_msg=k
+        )
+
+
+def test_pca_streaming_from_parquet_scan_no_materialize(tmp_path, rng):
+    X = rng.normal(size=(250, 8)).astype(np.float32)
+    DataFrame({"features": X}).write_parquet(str(tmp_path / "p"), rows_per_file=60)
+    scan = DataFrame.scan_parquet(str(tmp_path / "p"))
+    model = PCA(k=3, num_workers=4, stream_chunk_rows=64).fit(scan)
+    assert not scan.is_materialized(), "streaming fit must not materialize the scan"
+    resident = PCA(k=3, num_workers=4).fit(DataFrame({"features": X}))
+    np.testing.assert_allclose(
+        model.components_, resident.components_, rtol=2e-4, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        dict(regParam=0.0),
+        dict(regParam=0.1),
+        dict(regParam=0.1, elasticNetParam=0.5, maxIter=200),
+        dict(regParam=0.0, fitIntercept=False),
+        dict(regParam=0.05, standardization=False),
+    ],
+)
+def test_linreg_streaming_matches_resident(rng, kwargs):
+    n, d = 400, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=(d,))
+    y = (X @ w_true + 0.5 + 0.01 * rng.normal(size=n)).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+    m_res = LinearRegression(num_workers=4, streaming=False, **kwargs).fit(df)
+    m_str = LinearRegression(
+        num_workers=4, streaming=True, stream_chunk_rows=56, **kwargs
+    ).fit(df)
+    np.testing.assert_allclose(
+        m_str.coefficients, m_res.coefficients, rtol=5e-3, atol=5e-4
+    )
+    np.testing.assert_allclose(
+        float(m_str.intercept), float(m_res.intercept), rtol=5e-3, atol=5e-4
+    )
+
+
+def test_linreg_streaming_weighted(rng):
+    n, d = 300, 5
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=(d,))).astype(np.float32)
+    w = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    df = DataFrame({"features": X, "label": y, "w": w})
+    m_res = LinearRegression(
+        num_workers=2, weightCol="w", streaming=False, regParam=0.01
+    ).fit(df)
+    m_str = LinearRegression(
+        num_workers=2, weightCol="w", streaming=True, stream_chunk_rows=64,
+        regParam=0.01,
+    ).fit(df)
+    np.testing.assert_allclose(
+        m_str.coefficients, m_res.coefficients, rtol=5e-3, atol=5e-4
+    )
+
+
+def test_linreg_streaming_sparse_csr(rng):
+    sp = pytest.importorskip("scipy.sparse")
+    n, d = 200, 10
+    Xs = sp.random(n, d, density=0.3, format="csr", random_state=1, dtype=np.float64)
+    y = np.asarray(Xs @ rng.normal(size=(d,))).ravel().astype(np.float32)
+    df_sparse = DataFrame({"features": Xs, "label": y})
+    df_dense = DataFrame({"features": np.asarray(Xs.todense(), np.float32), "label": y})
+    m_str = LinearRegression(
+        num_workers=2, streaming=True, stream_chunk_rows=48, regParam=0.01
+    ).fit(df_sparse)
+    m_res = LinearRegression(num_workers=2, streaming=False, regParam=0.01).fit(df_dense)
+    np.testing.assert_allclose(
+        m_str.coefficients, m_res.coefficients, rtol=5e-3, atol=5e-4
+    )
+
+
+def test_fit_multiple_streaming_single_stats_pass(rng):
+    """All param maps must reuse one sufficient-statistics accumulation."""
+    n, d = 250, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=(d,))).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+    est = LinearRegression(num_workers=2, streaming=True, stream_chunk_rows=64)
+    grid = [{"regParam": 0.0}, {"regParam": 0.1}, {"regParam": 1.0}]
+    models = dict(est.fitMultiple(df, grid))
+    assert len(models) == 3
+    # stronger regularization shrinks coefficients
+    norms = [np.linalg.norm(models[i].coefficients) for i in range(3)]
+    assert norms[0] > norms[1] > norms[2]
+
+
+def test_streaming_auto_threshold_env(tmp_path, rng, monkeypatch):
+    """With a tiny threshold, auto mode engages streaming (observable via
+    the parquet scan staying unmaterialized)."""
+    monkeypatch.setenv("TPUML_STREAM_THRESHOLD_BYTES", "1")
+    X = rng.normal(size=(120, 6)).astype(np.float32)
+    DataFrame({"features": X}).write_parquet(str(tmp_path / "q"), rows_per_file=40)
+    scan = DataFrame.scan_parquet(str(tmp_path / "q"))
+    PCA(k=2, num_workers=2, stream_chunk_rows=32).fit(scan)
+    assert not scan.is_materialized()
