@@ -192,3 +192,114 @@ def minimize_lbfgs(
 
     w, f, g, S, Y, k, it, converged = lax.while_loop(cond, body, state0)
     return LbfgsResult(w=w, f=f, n_iter=it, converged=converged)
+
+
+def minimize_lbfgs_host(
+    value_grad: Callable,
+    w0,
+    *,
+    max_iter: int,
+    tol: float,
+    l1_weights=None,
+    history: int = 10,
+    max_ls: int = 30,
+) -> LbfgsResult:
+    """Host-driven L-BFGS/OWL-QN for out-of-core objectives.
+
+    Same algorithm as :func:`minimize_lbfgs` (Armijo backtracking on the
+    L1-inclusive objective, pseudo-gradient + orthant projection for L1,
+    curvature-guarded history) but the loop runs in Python: each
+    ``value_grad(w)`` call is free to stream the dataset through the device
+    in chunks (a full distributed data pass), which a ``lax.while_loop``
+    cannot express. The O(m·p) two-loop math runs in float64 on host —
+    negligible next to the data passes.
+
+    ``value_grad`` must return the SMOOTH (f, g) pair; the L1 term is added
+    here, mirroring ``full_obj_parts`` in the jitted solver.
+    """
+    import numpy as np
+
+    w = np.asarray(w0, dtype=np.float64)
+    p = w.shape[0]
+    use_l1 = l1_weights is not None
+    l1w = np.asarray(l1_weights, np.float64) if use_l1 else np.zeros((p,))
+
+    def full_obj(wv):
+        f, g = value_grad(wv)
+        return float(f) + float(np.abs(l1w * wv).sum()), np.asarray(g, np.float64)
+
+    def pseudo_grad(wv, g):
+        nonzero = g + l1w * np.sign(wv)
+        lo = g - l1w
+        hi = g + l1w
+        at_zero = np.where(lo > 0.0, lo, np.where(hi < 0.0, hi, 0.0))
+        return np.where(wv != 0.0, nonzero, at_zero)
+
+    f, g = full_obj(w)
+    S: list = []
+    Y: list = []
+    c1 = 1e-4
+    it = 0
+    converged = False
+    while it < max_iter and not converged:
+        pg = pseudo_grad(w, g) if use_l1 else g
+        # two-loop recursion over the (oldest -> newest) history
+        q = pg.copy()
+        alphas = []
+        for s, yv in reversed(list(zip(S, Y))):
+            rho = 1.0 / max(float(yv @ s), 1e-30)
+            a = rho * float(s @ q)
+            q -= a * yv
+            alphas.append((a, rho))
+        if S:
+            s_r, y_r = S[-1], Y[-1]
+            gamma = float(s_r @ y_r) / max(float(y_r @ y_r), 1e-30)
+        else:
+            gamma = 1.0
+        r = gamma * q
+        for (a, rho), (s, yv) in zip(reversed(alphas), zip(S, Y)):
+            beta = rho * float(yv @ r)
+            r += s * (a - beta)
+        d = -r
+        if use_l1:
+            d = np.where(d * pg < 0.0, d, 0.0)
+            xi = np.where(w != 0.0, np.sign(w), -np.sign(pg))
+        dir_deriv = float(pg @ d)
+
+        d_norm = float(np.sqrt(d @ d))
+        t = 1.0 / max(d_norm, 1.0) if not S else 1.0
+
+        def trial(tv):
+            wt = w + tv * d
+            if use_l1:
+                wt = np.where(wt * xi < 0.0, 0.0, wt)
+            return wt
+
+        f_t, g_t = full_obj(trial(t))
+        n_try = 0
+        while f_t > f + c1 * t * dir_deriv and n_try < max_ls:
+            t *= 0.5
+            f_t, g_t = full_obj(trial(t))
+            n_try += 1
+        w_new = trial(t)
+
+        s = w_new - w
+        yv = g_t - g
+        if float(s @ yv) > 1e-10:
+            S.append(s)
+            Y.append(yv)
+            if len(S) > history:
+                S.pop(0)
+                Y.pop(0)
+
+        denom = max(abs(f), abs(f_t), 1.0)
+        rel_impr = (f - f_t) / denom
+        converged = rel_impr <= tol or dir_deriv >= 0.0
+        w, f, g = w_new, f_t, g_t
+        it += 1
+
+    import jax.numpy as _jnp
+
+    return LbfgsResult(
+        w=w, f=_jnp.asarray(f), n_iter=_jnp.asarray(it), converged=_jnp.asarray(converged)
+    )

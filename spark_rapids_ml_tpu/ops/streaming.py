@@ -119,6 +119,121 @@ def gram2_step(
     return out
 
 
+# ---------------------------------------------------------------------------
+# KMeans chunk steps (streamed Lloyd / seeding)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def kmeans_chunk_step(
+    acc: Dict[str, jax.Array], X: jax.Array, mask: jax.Array, centers: jax.Array
+) -> Dict[str, jax.Array]:
+    """Fold one chunk's assignment statistics into (sums, counts, cost)."""
+    from .kmeans_kernels import pairwise_sq_dists
+
+    k = centers.shape[0]
+    d2 = pairwise_sq_dists(X, centers)
+    assign = jnp.argmin(d2, axis=1)
+    onehot = jax.nn.one_hot(assign, k, dtype=X.dtype) * mask[:, None]
+    return {
+        "sums": acc["sums"] + onehot.T @ X,
+        "counts": acc["counts"] + onehot.sum(axis=0).astype(jnp.int32),
+        "cost": acc["cost"] + (jnp.min(d2, axis=1) * mask).sum(),
+    }
+
+
+@jax.jit
+def chunk_min_sq_dists(
+    X: jax.Array, mask: jax.Array, centers: jax.Array
+) -> jax.Array:
+    """Per-row min squared distance to any center (padding rows -> 0)."""
+    from .kmeans_kernels import pairwise_sq_dists
+
+    return jnp.min(pairwise_sq_dists(X, centers), axis=1) * mask
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def count_closest_chunk_step(
+    counts: jax.Array, X: jax.Array, mask: jax.Array, cands: jax.Array
+) -> jax.Array:
+    """Fold one chunk into per-candidate closest-row counts (k-means||
+    candidate weighting)."""
+    from .kmeans_kernels import pairwise_sq_dists
+
+    d2 = pairwise_sq_dists(X, cands)
+    assign = jnp.argmin(d2, axis=1)
+    onehot = jax.nn.one_hot(assign, cands.shape[0], dtype=X.dtype) * mask[:, None]
+    return counts + onehot.sum(axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Logistic-regression chunk steps (streamed L-BFGS objective)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def var_chunk_step(
+    acc: jax.Array, X: jax.Array, rw: jax.Array, mean: jax.Array
+) -> jax.Array:
+    """Fold one chunk into Σ w·(x-mean)² (diagonal-only second moment —
+    cheaper than the full Gram when only feature variances are needed)."""
+    d = (X - mean[None, :]) * jnp.sqrt(rw)[:, None]
+    return acc + (d * d).sum(axis=0)
+
+
+@functools.partial(
+    jax.jit,
+    donate_argnums=(0,),
+    static_argnames=("n_classes", "multinomial", "fit_intercept", "use_center"),
+)
+def logreg_chunk_vg_step(
+    acc: Dict[str, jax.Array],
+    X: jax.Array,
+    mask: jax.Array,
+    y: jax.Array,
+    wflat: jax.Array,
+    mean: jax.Array,
+    inv_std: jax.Array,
+    *,
+    n_classes: int,
+    multinomial: bool,
+    fit_intercept: bool,
+    use_center: bool,
+) -> Dict[str, jax.Array]:
+    """Fold one chunk's data log-loss and its gradient w.r.t. the flat
+    parameter vector into the accumulator.
+
+    Same objective as the resident kernel (``ops/logreg_kernels.py``):
+    standardization is a reparametrization folded into the logits, not a
+    data copy. The regularization terms are added once on the host, not
+    per chunk.
+    """
+    dtype = X.dtype
+    d = X.shape[1]
+    K = n_classes if multinomial else 1
+    n_coef = K * d
+    yi = y.astype(jnp.int32)
+    yf = y.astype(dtype)
+
+    def chunk_loss(wf: jax.Array) -> jax.Array:
+        A = wf[:n_coef].reshape(K, d)
+        b = wf[n_coef:] if fit_intercept else jnp.zeros((K,), dtype)
+        Aeff = A * inv_std[None, :]
+        beff = b - (Aeff @ mean if use_center else jnp.zeros((), dtype))
+        logits = X @ Aeff.T + beff[None, :]
+        if multinomial:
+            ll = jax.nn.logsumexp(logits, axis=1) - jnp.take_along_axis(
+                logits, yi[:, None], axis=1
+            )[:, 0]
+        else:
+            z = logits[:, 0]
+            ll = jax.nn.softplus(z) - yf * z
+        return (ll * mask).sum()
+
+    f, g = jax.value_and_grad(chunk_loss)(wflat)
+    return {"f": acc["f"] + f, "g": acc["g"] + g}
+
+
 def streamed_suffstats(
     source: ChunkSource,
     mesh,
@@ -174,3 +289,193 @@ def streamed_suffstats(
         stats["Xy"] = acc2["Xy"]
         stats["yy"] = acc2["yy"]
     return stats
+
+
+def streamed_logreg_fit(
+    source: ChunkSource,
+    mesh,
+    chunk_rows: int,
+    dtype,
+    *,
+    n_classes: int,
+    multinomial: bool,
+    fit_intercept: bool,
+    standardization: bool,
+    l1: float,
+    l2: float,
+    max_iter: int,
+    tol: float,
+    history: int = 10,
+) -> Dict[str, np.ndarray]:
+    """Out-of-core logistic regression: host-driven L-BFGS/OWL-QN where each
+    objective evaluation streams the dataset through the device in chunks.
+
+    Numerically mirrors the resident kernel (``ops/logreg_kernels.py``):
+    same standardization-as-reparametrization, Spark objective
+    (1/n)·Σ logloss + λ[(1−α)/2‖β‖₂² + α‖β‖₁] with the penalty on
+    standardized coefficients and never on intercepts, same multinomial
+    intercept centering. The O(m·p) quasi-Newton math runs on host in f64;
+    every line-search trial is one chunked data pass (exactly the
+    re-read-per-iteration cost cuML's out-of-core QN pays, reference
+    ``classification.py:955-1140``).
+    """
+    from .lbfgs import minimize_lbfgs_host
+
+    d = source.n_features
+    np_dtype = np.dtype(jnp.dtype(dtype).name)
+
+    # pass 1: n + feature means
+    acc1 = moments1_init(d, dtype, with_y=False)
+    for chunk in source.iter_chunks(chunk_rows, np_dtype):
+        dev = put_chunk(chunk, mesh, dtype)
+        acc1 = moments1_step(acc1, dev["X"], dev["mask"])
+    n = float(acc1["n"])
+    mean = acc1["sum_x"] / acc1["n"]
+
+    if standardization:
+        # pass 2: diagonal second moment -> unbiased variance (n-1), the
+        # reference's denominator (``classification.py:1024-1026``)
+        vacc = jnp.zeros((d,), dtype)
+        for chunk in source.iter_chunks(chunk_rows, np_dtype):
+            dev = put_chunk(chunk, mesh, dtype)
+            vacc = var_chunk_step(vacc, dev["X"], dev["mask"], mean)
+        var = vacc / max(n - 1.0, 1.0)
+        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        inv_std = jnp.where(std > 0, 1.0 / std, 1.0)
+    else:
+        inv_std = jnp.ones((d,), dtype)
+    use_center = standardization and fit_intercept
+    mean_dev = mean if use_center else jnp.zeros((d,), dtype)
+
+    K = n_classes if multinomial else 1
+    n_coef = K * d
+    p = n_coef + (K if fit_intercept else 0)
+    coef_mask = np.concatenate([np.ones(n_coef), np.zeros(p - n_coef)])
+
+    def value_grad(w_np):
+        wd = jnp.asarray(w_np, dtype)
+        acc = {"f": jnp.zeros((), dtype), "g": jnp.zeros((p,), dtype)}
+        for chunk in source.iter_chunks(chunk_rows, np_dtype):
+            dev = put_chunk(chunk, mesh, dtype)
+            acc = logreg_chunk_vg_step(
+                acc, dev["X"], dev["mask"], dev["y"], wd, mean_dev, inv_std,
+                n_classes=n_classes, multinomial=multinomial,
+                fit_intercept=fit_intercept, use_center=use_center,
+            )
+        coefs = w_np * coef_mask
+        f = float(acc["f"]) / n + 0.5 * l2 * float(coefs @ coefs)
+        g = np.asarray(acc["g"], np.float64) / n + l2 * coefs
+        return f, g
+
+    res = minimize_lbfgs_host(
+        value_grad,
+        np.zeros((p,)),
+        max_iter=max_iter,
+        tol=tol,
+        l1_weights=(l1 * coef_mask) if l1 > 0.0 else None,
+        history=history,
+    )
+
+    w = np.asarray(res.w)
+    A = w[:n_coef].reshape(K, d)
+    b = w[n_coef:] if fit_intercept else np.zeros((K,))
+    inv_std_h = np.asarray(inv_std, np.float64)
+    mean_h = np.asarray(mean, np.float64)
+    coef = A * inv_std_h[None, :]
+    intercept = b - (coef @ mean_h if use_center else 0.0)
+    if fit_intercept and K > 1:
+        intercept = intercept - intercept.mean()
+    return {
+        "coef_": coef.astype(np_dtype),
+        "intercept_": np.asarray(intercept, np_dtype),
+        "n_iter": int(res.n_iter),
+        "objective": float(res.f),
+    }
+
+
+def streamed_kmeans_lloyd(
+    source: ChunkSource,
+    mesh,
+    chunk_rows: int,
+    dtype,
+    centers0: np.ndarray,
+    *,
+    max_iter: int,
+    tol: float,
+):
+    """Out-of-core Lloyd: one chunked pass per iteration accumulates
+    (sums, counts, cost); centroid state stays tiny (k×d). Matches the
+    resident ``kmeans_kernels.kmeans_lloyd`` semantics: empty clusters keep
+    their previous center (Spark behavior), convergence on max center
+    shift² <= tol², plus a final cost pass at the converged centers.
+    Returns (centers, cost, n_iter) as host values.
+    """
+    np_dtype = np.dtype(jnp.dtype(dtype).name)
+    k, d = centers0.shape
+    centers = jnp.asarray(centers0, dtype)
+
+    def one_pass(cts):
+        acc = {
+            "sums": jnp.zeros((k, d), dtype),
+            "counts": jnp.zeros((k,), jnp.int32),
+            "cost": jnp.zeros((), dtype),
+        }
+        for chunk in source.iter_chunks(chunk_rows, np_dtype):
+            dev = put_chunk(chunk, mesh, dtype)
+            acc = kmeans_chunk_step(acc, dev["X"], dev["mask"], cts)
+        return acc
+
+    it = 0
+    prev_shift = np.inf
+    cost = 0.0
+    while it < max_iter and prev_shift > tol * tol:
+        acc = one_pass(centers)
+        sums = np.asarray(acc["sums"], np.float64)
+        counts = np.asarray(acc["counts"])
+        cost = float(acc["cost"])
+        safe = np.maximum(counts.astype(np.float64), 1.0)
+        new_centers = np.where(
+            counts[:, None] > 0, sums / safe[:, None], np.asarray(centers, np.float64)
+        )
+        prev_shift = float(
+            ((new_centers - np.asarray(centers, np.float64)) ** 2).sum(axis=1).max()
+        )
+        centers = jnp.asarray(new_centers, dtype)
+        it += 1
+
+    final = one_pass(centers)
+    return np.asarray(centers), float(final["cost"]), it
+
+
+def streamed_label_stats(
+    source: ChunkSource, chunk_rows: int
+) -> Dict[str, float]:
+    """One host pass over the label stream: max/min, integer check, and
+    whether all labels are identical — everything the fit needs to pick
+    ``n_classes`` (Spark: max(label)+1) without materializing the dataset."""
+    y_max = -np.inf
+    y_min = np.inf
+    all_int = True
+    first = None
+    all_same = True
+    for chunk in source.iter_chunks(chunk_rows):
+        yv = chunk.y[: chunk.n_valid]
+        if yv.size == 0:
+            continue
+        y_max = max(y_max, float(yv.max()))
+        y_min = min(y_min, float(yv.min()))
+        if not np.all(yv == np.floor(yv)):
+            all_int = False
+        if first is None:
+            first = float(yv[0])
+        if not np.all(yv == first):
+            all_same = False
+    if first is None:
+        raise ValueError("Labels column is empty")
+    return {
+        "y_max": y_max,
+        "y_min": y_min,
+        "all_int": all_int,
+        "all_same": all_same,
+        "first": first,
+    }
