@@ -216,6 +216,16 @@ class _TpuEstimator(Params, _TpuParams):
             n_features = len(input_cols)
         else:
             col = dataset.column(input_col)
+            if (
+                _is_sparse(col)
+                and self.hasParam("enable_sparse_data_optim")
+                and self.isDefined("enable_sparse_data_optim")
+                and self.getOrDefault("enable_sparse_data_optim") is True
+            ):
+                # explicit sparse opt-in (reference ``params.py:42-63``):
+                # chunked-CSR streaming is the sparse compute path — the
+                # matrix must never densify in full
+                return True
             n_features = int(col.shape[1]) if col.ndim == 2 or _is_sparse(col) else 1
         itemsize = 4 if self._float32_inputs else 8
         est_bytes = dataset.count() * n_features * itemsize

@@ -67,6 +67,18 @@ class ChunkSource:
     def iter_chunks(self, chunk_rows: int, dtype: Any = np.float32) -> Iterator[Chunk]:
         raise NotImplementedError
 
+    def iter_labels(self, chunk_rows: int) -> Iterator[np.ndarray]:
+        """Valid (unpadded) label values, one array per chunk.
+
+        Label-only scans (class counting) must not pay for features:
+        sources override this to skip reading/densifying the feature
+        matrix entirely; the base fallback goes through ``iter_chunks``.
+        """
+        for chunk in self.iter_chunks(chunk_rows, np.float32):
+            if chunk.y is None:
+                raise ValueError("Chunk source has no label column")
+            yield chunk.y[: chunk.n_valid]
+
     def num_chunks(self, chunk_rows: int) -> int:
         return max(1, -(-self.n_rows // chunk_rows))
 
@@ -89,6 +101,12 @@ class ArrayChunkSource(ChunkSource):
         self.n_rows, self.n_features = X.shape
         self.has_label = y is not None
         self.has_weight = w is not None
+
+    def iter_labels(self, chunk_rows: int) -> Iterator[np.ndarray]:
+        if self._y is None:
+            raise ValueError("Chunk source has no label column")
+        for lo in range(0, self.n_rows, chunk_rows):
+            yield np.asarray(self._y[lo : lo + chunk_rows])
 
     def iter_chunks(self, chunk_rows: int, dtype: Any = np.float32) -> Iterator[Chunk]:
         for lo in range(0, self.n_rows, chunk_rows):
@@ -121,6 +139,12 @@ class CSRChunkSource(ChunkSource):
         self.n_rows, self.n_features = self._X.shape
         self.has_label = y is not None
         self.has_weight = w is not None
+
+    def iter_labels(self, chunk_rows: int) -> Iterator[np.ndarray]:
+        if self._y is None:
+            raise ValueError("Chunk source has no label column")
+        for lo in range(0, self.n_rows, chunk_rows):
+            yield np.asarray(self._y[lo : lo + chunk_rows])
 
     def iter_chunks(self, chunk_rows: int, dtype: Any = np.float32) -> Iterator[Chunk]:
         for lo in range(0, self.n_rows, chunk_rows):
@@ -212,6 +236,15 @@ class ParquetChunkSource(ChunkSource):
         if self._weight_col:
             w = t.column(self._weight_col).to_numpy(zero_copy_only=False).astype(dtype)
         return X, y, w
+
+    def iter_labels(self, chunk_rows: int) -> Iterator[np.ndarray]:
+        import pyarrow.parquet as pq
+
+        if self._label_col is None:
+            raise ValueError("Chunk source has no label column")
+        for f in self._files:
+            t = pq.read_table(f, columns=[self._label_col])
+            yield t.column(self._label_col).to_numpy(zero_copy_only=False)
 
     def iter_chunks(self, chunk_rows: int, dtype: Any = np.float32) -> Iterator[Chunk]:
         bufX: List[np.ndarray] = []

@@ -247,6 +247,75 @@ class LogisticRegression(
 
         return _fit
 
+    def _get_tpu_streaming_fit_func(self, dataset: DataFrame):
+        """Out-of-core fit: host-driven L-BFGS/OWL-QN where every objective
+        evaluation is one chunked pass over the data (the re-read-per-
+        iteration cost cuML's out-of-core QN pays, reference
+        ``classification.py:955-1140``); label analysis is its own streaming
+        pass instead of a column materialization."""
+        from ..core import StreamInputs
+        from ..ops.streaming import streamed_label_stats, streamed_logreg_fit
+
+        label_cache: Dict[str, Any] = {}
+
+        def _fit(inputs: StreamInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            if not label_cache:
+                label_cache.update(
+                    streamed_label_stats(inputs.source, inputs.chunk_rows)
+                )
+            ls = label_cache
+            if ls["y_min"] < 0 or not ls["all_int"]:
+                raise RuntimeError(
+                    "Labels MUST be non-negative integers, got values outside that set"
+                )
+            # Spark semantics: numClasses = max(label) + 1
+            n_classes = max(int(ls["y_max"]) + 1, 2)
+            multinomial = n_classes > 2
+            fit_intercept = bool(params["fit_intercept"])
+
+            if ls["all_same"] and n_classes == 2 and fit_intercept:
+                # single-label degenerate case (reference
+                # ``classification.py:1119-1132``)
+                class_val = float(ls["first"])
+                return {
+                    "coef_": np.zeros((1, inputs.n_features)),
+                    "intercept_": np.asarray(
+                        [np.inf if class_val == 1.0 else -np.inf]
+                    ),
+                    "n_classes": n_classes,
+                    "multinomial": False,
+                    "n_iter": 0,
+                    "objective": 0.0,
+                }
+
+            c = float(params["C"])
+            reg = 1.0 / c if c > 0.0 else 0.0
+            l1_ratio = float(params["l1_ratio"])
+            out = streamed_logreg_fit(
+                inputs.source,
+                inputs.mesh,
+                inputs.chunk_rows,
+                inputs.dtype,
+                n_classes=n_classes,
+                multinomial=multinomial,
+                fit_intercept=fit_intercept,
+                standardization=bool(params["standardization"]),
+                l1=reg * l1_ratio,
+                l2=reg * (1.0 - l1_ratio),
+                max_iter=int(params["max_iter"]),
+                tol=float(params["tol"]),
+            )
+            return {
+                "coef_": np.asarray(out["coef_"]),
+                "intercept_": np.asarray(out["intercept_"]),
+                "n_classes": n_classes,
+                "multinomial": multinomial,
+                "n_iter": int(out["n_iter"]),
+                "objective": float(out["objective"]),
+            }
+
+        return _fit
+
     def _create_model(self, result: Dict[str, Any]) -> "LogisticRegressionModel":
         return LogisticRegressionModel(**result)
 

@@ -152,9 +152,85 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
         return -(-per_dev // n_chunks)
 
     # ---- seeding ---------------------------------------------------------
+    # ONE sampling implementation serves both the resident and streaming
+    # fits, parameterized over three data-access primitives — the rng
+    # consumption sequence is part of the contract (same seed => identical
+    # seeding on both paths), so the logic must not fork.
+
+    @staticmethod
+    def _seed_random(
+        n_rows: int, k: int, rng: np.random.Generator, gather: Callable
+    ) -> np.ndarray:
+        idx = rng.choice(n_rows, size=k, replace=n_rows < k)
+        return gather(np.sort(idx))
+
+    @staticmethod
+    def _seed_scalable_kmeanspp(
+        n_rows: int,
+        k: int,
+        steps: int,
+        oversample: float,
+        rng: np.random.Generator,
+        gather: Callable,          # sorted global row idx -> (m, d) host rows
+        min_d2_update: Callable,   # (new_cands, min_d2|None) -> (n_rows,) host
+        count_closest_fn: Callable,  # cands -> (m,) closest-row counts
+    ) -> np.ndarray:
+        """k-means|| (Bahmani et al.): sample ~l=oversample*k candidates per
+        round with prob l*d²/Σd², then reduce candidates to k centers with
+        weighted k-means++ on host (the candidate set is tiny)."""
+        l = max(int(oversample * k), 1)
+        first = int(rng.integers(0, n_rows))
+        cands = gather(np.asarray([first]))
+        min_d2 = min_d2_update(cands, None)
+        for _ in range(steps):
+            total = float(min_d2.sum())
+            if total <= 0:
+                break
+            probs = np.minimum(l * min_d2 / total, 1.0)
+            sel = np.nonzero(rng.random(n_rows) < probs)[0]
+            if len(sel) == 0:
+                continue
+            new = gather(sel)
+            cands = np.concatenate([cands, new], axis=0)
+            min_d2 = min_d2_update(new, min_d2)
+        if len(cands) < k:
+            # not enough candidates — top up with random rows
+            extra = KMeans._seed_random(n_rows, k - len(cands), rng, gather)
+            return np.concatenate([cands, extra], axis=0)
+        if len(cands) == k:
+            return cands
+        weights = np.asarray(count_closest_fn(cands), np.float64)
+        return _weighted_kmeanspp(cands.astype(np.float64), weights, k, rng)
+
+    def _resident_seed_prims(self, inputs: FitInputs):
+        n = inputs.n_rows
+
+        def gather(idx: np.ndarray) -> np.ndarray:
+            return np.asarray(inputs.X[idx])
+
+        def min_d2_update(new: np.ndarray, min_d2):
+            nd = np.asarray(
+                min_sq_dists(
+                    inputs.X, inputs.mask, jnp.asarray(new, inputs.dtype),
+                    mesh=inputs.mesh, csize=inputs.csize,
+                ),
+                np.float64,
+            )[:n]
+            return nd if min_d2 is None else np.minimum(min_d2, nd)
+
+        def count_closest_fn(cands: np.ndarray) -> np.ndarray:
+            return np.asarray(
+                count_closest(
+                    inputs.X, inputs.mask, jnp.asarray(cands, inputs.dtype),
+                    mesh=inputs.mesh, csize=inputs.csize,
+                )
+            )
+
+        return gather, min_d2_update, count_closest_fn
+
     def _init_random(self, inputs: FitInputs, k: int, rng: np.random.Generator) -> np.ndarray:
-        idx = rng.choice(inputs.n_rows, size=k, replace=inputs.n_rows < k)
-        return np.asarray(inputs.X[np.sort(idx)])
+        gather, _, _ = self._resident_seed_prims(inputs)
+        return self._seed_random(inputs.n_rows, k, rng, gather)
 
     def _init_scalable_kmeanspp(
         self,
@@ -164,44 +240,11 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
         oversample: float,
         rng: np.random.Generator,
     ) -> np.ndarray:
-        """k-means|| (Bahmani et al.): sample ~l=oversample*k candidates per
-        round with prob l*d²/Σd², then reduce candidates to k centers with
-        weighted k-means++ on host (the candidate set is tiny)."""
-        l = max(int(oversample * k), 1)
-        first = rng.integers(0, inputs.n_rows)
-        cands = np.asarray(inputs.X[first : first + 1])
-        min_d2 = np.asarray(
-            min_sq_dists(
-                inputs.X, inputs.mask, jnp.asarray(cands), mesh=inputs.mesh, csize=inputs.csize
-            )
+        gather, min_d2_update, count_closest_fn = self._resident_seed_prims(inputs)
+        return self._seed_scalable_kmeanspp(
+            inputs.n_rows, k, steps, oversample, rng,
+            gather, min_d2_update, count_closest_fn,
         )
-        for _ in range(steps):
-            total = float(min_d2.sum())
-            if total <= 0:
-                break
-            probs = np.minimum(l * min_d2 / total, 1.0)
-            sel = np.nonzero(rng.random(len(probs)) < probs)[0]
-            sel = sel[sel < inputs.n_rows]
-            if len(sel) == 0:
-                continue
-            new = np.asarray(inputs.X[sel])
-            cands = np.concatenate([cands, new], axis=0)
-            nd = np.asarray(
-                min_sq_dists(
-                    inputs.X, inputs.mask, jnp.asarray(new), mesh=inputs.mesh, csize=inputs.csize
-                )
-            )
-            min_d2 = np.minimum(min_d2, nd)
-        if len(cands) <= k:
-            # not enough candidates — top up with random rows
-            extra = self._init_random(inputs, k - len(cands), rng) if len(cands) < k else None
-            return np.concatenate([cands, extra], axis=0) if extra is not None else cands
-        weights = np.asarray(
-            count_closest(
-                inputs.X, inputs.mask, jnp.asarray(cands), mesh=inputs.mesh, csize=inputs.csize
-            )
-        ).astype(np.float64)
-        return _weighted_kmeanspp(cands.astype(np.float64), weights, k, rng)
 
     # ---- fit -------------------------------------------------------------
     def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
@@ -224,6 +267,70 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
                 centers0,
                 mesh=inputs.mesh,
                 csize=inputs.csize,
+                max_iter=int(params["max_iter"]),
+                tol=float(params["tol"]),
+            )
+            return {
+                "cluster_centers": np.asarray(centers),
+                "training_cost": float(cost),
+                "n_iter": int(n_iter),
+            }
+
+        return _fit
+
+    def _get_tpu_streaming_fit_func(self, dataset: DataFrame):
+        """Out-of-core fit: seeding and Lloyd each run as chunked passes —
+        device memory holds one chunk slab plus k×d centroid state; the only
+        O(n) host state is the 8-byte/row min-distance array k-means||
+        keeps (the dataset itself never materializes)."""
+        from ..core import StreamInputs
+        from ..ops.streaming import (
+            streamed_count_closest,
+            streamed_kmeans_lloyd,
+            streamed_min_sq_dists_update,
+            streamed_rows_at,
+        )
+
+        def _stream_seed_prims(inputs: StreamInputs):
+            def gather(idx: np.ndarray) -> np.ndarray:
+                return streamed_rows_at(
+                    inputs.source, inputs.chunk_rows, idx, inputs.dtype
+                )
+
+            def min_d2_update(new: np.ndarray, min_d2):
+                return streamed_min_sq_dists_update(
+                    inputs.source, inputs.mesh, inputs.chunk_rows, inputs.dtype,
+                    new, min_d2,
+                )
+
+            def count_closest_fn(cands: np.ndarray) -> np.ndarray:
+                return streamed_count_closest(
+                    inputs.source, inputs.mesh, inputs.chunk_rows, inputs.dtype,
+                    cands,
+                )
+
+            return gather, min_d2_update, count_closest_fn
+
+        def _fit(inputs: StreamInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            k = int(params["n_clusters"])
+            if k > inputs.n_rows:
+                raise ValueError(f"k={k} must be <= number of rows {inputs.n_rows}")
+            rng = np.random.default_rng(int(params.get("random_state") or 0))
+            gather, min_d2_update, count_closest_fn = _stream_seed_prims(inputs)
+            if params.get("init") == "random":
+                centers0 = self._seed_random(inputs.n_rows, k, rng, gather)
+            else:
+                centers0 = self._seed_scalable_kmeanspp(
+                    inputs.n_rows, k, int(params.get("init_steps", 2)),
+                    float(params.get("oversampling_factor", 2.0)), rng,
+                    gather, min_d2_update, count_closest_fn,
+                )
+            centers, cost, n_iter = streamed_kmeans_lloyd(
+                inputs.source,
+                inputs.mesh,
+                inputs.chunk_rows,
+                inputs.dtype,
+                np.asarray(centers0),
                 max_iter=int(params["max_iter"]),
                 tol=float(params["tol"]),
             )

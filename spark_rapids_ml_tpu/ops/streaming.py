@@ -157,13 +157,15 @@ def count_closest_chunk_step(
     counts: jax.Array, X: jax.Array, mask: jax.Array, cands: jax.Array
 ) -> jax.Array:
     """Fold one chunk into per-candidate closest-row counts (k-means||
-    candidate weighting)."""
+    candidate weighting).  ``counts`` is int32: a float32 accumulator would
+    silently drop small per-chunk increments past ~2²⁴ rows — the exact
+    regime the out-of-core path exists for."""
     from .kmeans_kernels import pairwise_sq_dists
 
     d2 = pairwise_sq_dists(X, cands)
     assign = jnp.argmin(d2, axis=1)
     onehot = jax.nn.one_hot(assign, cands.shape[0], dtype=X.dtype) * mask[:, None]
-    return counts + onehot.sum(axis=0)
+    return counts + onehot.sum(axis=0).astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -427,12 +429,10 @@ def streamed_kmeans_lloyd(
 
     it = 0
     prev_shift = np.inf
-    cost = 0.0
     while it < max_iter and prev_shift > tol * tol:
         acc = one_pass(centers)
         sums = np.asarray(acc["sums"], np.float64)
         counts = np.asarray(acc["counts"])
-        cost = float(acc["cost"])
         safe = np.maximum(counts.astype(np.float64), 1.0)
         new_centers = np.where(
             counts[:, None] > 0, sums / safe[:, None], np.asarray(centers, np.float64)
@@ -458,8 +458,7 @@ def streamed_label_stats(
     all_int = True
     first = None
     all_same = True
-    for chunk in source.iter_chunks(chunk_rows):
-        yv = chunk.y[: chunk.n_valid]
+    for yv in source.iter_labels(chunk_rows):
         if yv.size == 0:
             continue
         y_max = max(y_max, float(yv.max()))
@@ -479,3 +478,79 @@ def streamed_label_stats(
         "all_same": all_same,
         "first": first,
     }
+
+
+# ---------------------------------------------------------------------------
+# Streamed k-means|| seeding passes
+# ---------------------------------------------------------------------------
+
+
+def streamed_rows_at(
+    source: ChunkSource, chunk_rows: int, idx: np.ndarray, dtype
+) -> np.ndarray:
+    """Gather rows by global index in ONE sequential pass (host-side).
+
+    The out-of-core replacement for fancy-indexing the resident matrix:
+    chunks arrive in order, so each requested (sorted) index is sliced out
+    of the chunk that covers it.
+    """
+    idx = np.sort(np.asarray(idx, np.int64))
+    out = np.empty((len(idx), source.n_features), dtype=dtype)
+    pos = 0  # next unsatisfied request
+    offset = 0
+    for chunk in source.iter_chunks(chunk_rows, dtype):
+        hi = offset + chunk.n_valid
+        while pos < len(idx) and idx[pos] < hi:
+            out[pos] = chunk.X[idx[pos] - offset]
+            pos += 1
+        offset = hi
+        if pos == len(idx):
+            break
+    if pos != len(idx):
+        raise IndexError(f"row index {idx[pos]} out of range ({offset} rows)")
+    return out
+
+
+def streamed_min_sq_dists_update(
+    source: ChunkSource,
+    mesh,
+    chunk_rows: int,
+    dtype,
+    cands: np.ndarray,
+    min_d2: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """One chunked pass: per-row min squared distance to ``cands``, folded
+    into a host ``min_d2`` array (O(n) host floats — 4 bytes/row, the only
+    per-row state k-means|| needs; the dataset itself never materializes).
+    """
+    cands_dev = jnp.asarray(cands, dtype)
+    out = (
+        np.full((source.n_rows,), np.inf, np.float64)
+        if min_d2 is None
+        else min_d2
+    )
+    np_dtype = np.dtype(jnp.dtype(dtype).name)
+    offset = 0
+    for chunk in source.iter_chunks(chunk_rows, np_dtype):
+        dev = put_chunk(chunk, mesh, dtype)
+        d2 = np.asarray(
+            chunk_min_sq_dists(dev["X"], dev["mask"], cands_dev), np.float64
+        )
+        nv = chunk.n_valid
+        np.minimum(out[offset : offset + nv], d2[:nv], out=out[offset : offset + nv])
+        offset += nv
+    return out
+
+
+def streamed_count_closest(
+    source: ChunkSource, mesh, chunk_rows: int, dtype, cands: np.ndarray
+) -> np.ndarray:
+    """One chunked pass: for each candidate, how many rows are closest to it
+    (the k-means|| candidate weights)."""
+    cands_dev = jnp.asarray(cands, dtype)
+    counts = jnp.zeros((cands.shape[0],), jnp.int32)
+    np_dtype = np.dtype(jnp.dtype(dtype).name)
+    for chunk in source.iter_chunks(chunk_rows, np_dtype):
+        dev = put_chunk(chunk, mesh, dtype)
+        counts = count_closest_chunk_step(counts, dev["X"], dev["mask"], cands_dev)
+    return np.asarray(counts, np.float64)

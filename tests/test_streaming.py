@@ -213,3 +213,190 @@ def test_streaming_auto_threshold_env(tmp_path, rng, monkeypatch):
     scan = DataFrame.scan_parquet(str(tmp_path / "q"))
     PCA(k=2, num_workers=2, stream_chunk_rows=32).fit(scan)
     assert not scan.is_materialized()
+
+
+# ---------------------------------------------------------------------------
+# KMeans streaming
+# ---------------------------------------------------------------------------
+
+
+def _blob_data(rng, n=420, d=6, k=5):
+    centers = rng.normal(size=(k, d)) * 8.0
+    assign = rng.integers(0, k, size=n)
+    X = centers[assign] + rng.normal(size=(n, d))
+    return X.astype(np.float32)
+
+
+@pytest.mark.parametrize("init", ["random", "k-means||"])
+def test_kmeans_streaming_matches_resident(rng, init):
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    X = _blob_data(rng)
+    df = DataFrame({"features": X})
+    kw = dict(k=5, initMode=init, seed=7, maxIter=30, num_workers=4)
+    m_res = KMeans(streaming=False, **kw).fit(df)
+    m_str = KMeans(streaming=True, stream_chunk_rows=64, **kw).fit(df)
+    # same seed + same sampling scheme -> identical seeding -> same optimum;
+    # compare the sorted centers and the final cost
+    c_res = np.asarray(sorted(m_res.clusterCenters(), key=lambda c: tuple(c)))
+    c_str = np.asarray(sorted(m_str.clusterCenters(), key=lambda c: tuple(c)))
+    np.testing.assert_allclose(c_str, c_res, rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(
+        m_str.trainingCost, m_res.trainingCost, rtol=5e-3
+    )
+
+
+def test_kmeans_streaming_from_parquet_scan(tmp_path, rng):
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    X = _blob_data(rng, n=300)
+    DataFrame({"features": X}).write_parquet(str(tmp_path / "km"), rows_per_file=70)
+    scan = DataFrame.scan_parquet(str(tmp_path / "km"))
+    m = KMeans(k=4, seed=3, num_workers=2, stream_chunk_rows=64, streaming=True).fit(scan)
+    assert not scan.is_materialized()
+    # quality: streamed fit reaches the resident fit's cost ballpark
+    m_res = KMeans(k=4, seed=3, num_workers=2).fit(DataFrame({"features": X}))
+    assert m.trainingCost <= m_res.trainingCost * 1.05
+
+
+def test_kmeans_streaming_transform_assignments(rng):
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    X = _blob_data(rng, n=260, k=4)
+    df = DataFrame({"features": X})
+    m = KMeans(k=4, seed=1, num_workers=2, streaming=True, stream_chunk_rows=50).fit(df)
+    out = m.transform(df)
+    preds = np.asarray([r["prediction"] for r in out.collect()])
+    assert preds.shape == (260,)
+    assert set(np.unique(preds)) <= set(range(4))
+
+
+# ---------------------------------------------------------------------------
+# LogisticRegression streaming
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        dict(regParam=0.01),
+        dict(regParam=0.01, standardization=False),
+        dict(regParam=0.05, elasticNetParam=0.5),
+        dict(regParam=0.01, fitIntercept=False),
+    ],
+)
+def test_logreg_streaming_matches_resident(rng, kwargs):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    n, d = 400, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=(d,))
+    y = (X @ w_true + 0.3 > 0).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+    m_res = LogisticRegression(num_workers=4, streaming=False, maxIter=100, **kwargs).fit(df)
+    m_str = LogisticRegression(
+        num_workers=4, streaming=True, stream_chunk_rows=56, maxIter=100, **kwargs
+    ).fit(df)
+    np.testing.assert_allclose(
+        m_str.coefficientMatrix, m_res.coefficientMatrix, rtol=2e-2, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        m_str.interceptVector, m_res.interceptVector, rtol=2e-2, atol=2e-3
+    )
+
+
+def test_logreg_streaming_multinomial(rng):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    n, d, k = 450, 5, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = rng.normal(size=(k, d))
+    y = np.argmax(X @ W.T + 0.1 * rng.normal(size=(n, k)), axis=1).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+    m_res = LogisticRegression(num_workers=2, streaming=False, regParam=0.01).fit(df)
+    m_str = LogisticRegression(
+        num_workers=2, streaming=True, stream_chunk_rows=64, regParam=0.01
+    ).fit(df)
+    assert m_str.numClasses == 3
+    np.testing.assert_allclose(
+        m_str.coefficientMatrix, m_res.coefficientMatrix, rtol=3e-2, atol=3e-3
+    )
+    # prediction parity on the training set
+    p_res = np.asarray([r["prediction"] for r in m_res.transform(df).collect()])
+    p_str = np.asarray([r["prediction"] for r in m_str.transform(df).collect()])
+    assert (p_res == p_str).mean() > 0.99
+
+
+def test_logreg_streaming_from_parquet_scan(tmp_path, rng):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    n, d = 300, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=(d,)) > 0).astype(np.float32)
+    DataFrame({"features": X, "label": y}).write_parquet(
+        str(tmp_path / "lr"), rows_per_file=80
+    )
+    scan = DataFrame.scan_parquet(str(tmp_path / "lr"))
+    m = LogisticRegression(
+        num_workers=2, stream_chunk_rows=64, streaming=True, regParam=0.01
+    ).fit(scan)
+    assert not scan.is_materialized()
+    m_res = LogisticRegression(num_workers=2, regParam=0.01).fit(
+        DataFrame({"features": X, "label": y})
+    )
+    np.testing.assert_allclose(
+        m.coefficientMatrix, m_res.coefficientMatrix, rtol=2e-2, atol=2e-3
+    )
+
+
+def test_logreg_streaming_degenerate_single_label(rng):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    X = rng.normal(size=(80, 3)).astype(np.float32)
+    y = np.ones((80,), np.float32)
+    df = DataFrame({"features": X, "label": y})
+    m = LogisticRegression(num_workers=2, streaming=True, stream_chunk_rows=32).fit(df)
+    assert np.isposinf(m.interceptVector).all()
+    preds = np.asarray([r["prediction"] for r in m.transform(df).collect()])
+    assert (preds == 1.0).all()
+
+
+def test_logreg_streaming_sparse_csr(rng):
+    sp = pytest.importorskip("scipy.sparse")
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    n, d = 250, 8
+    Xs = sp.random(n, d, density=0.3, format="csr", random_state=2, dtype=np.float64)
+    y = (np.asarray(Xs @ rng.normal(size=(d,))).ravel() > 0).astype(np.float32)
+    df_sparse = DataFrame({"features": Xs, "label": y})
+    df_dense = DataFrame(
+        {"features": np.asarray(Xs.todense(), np.float32), "label": y}
+    )
+    m_str = LogisticRegression(
+        num_workers=2, streaming=True, stream_chunk_rows=48, regParam=0.01
+    ).fit(df_sparse)
+    m_res = LogisticRegression(num_workers=2, streaming=False, regParam=0.01).fit(df_dense)
+    np.testing.assert_allclose(
+        m_str.coefficientMatrix, m_res.coefficientMatrix, rtol=2e-2, atol=2e-3
+    )
+
+
+def test_logreg_sparse_optin_forces_streaming(rng):
+    """enable_sparse_data_optim=True must engage the chunked-CSR path even
+    below the auto-streaming size threshold (reference ``params.py:42-63``:
+    the opt-in selects the sparse compute path outright)."""
+    sp = pytest.importorskip("scipy.sparse")
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    Xs = sp.random(120, 6, density=0.3, format="csr", random_state=3, dtype=np.float64)
+    y = (np.asarray(Xs @ rng.normal(size=(6,))).ravel() > 0).astype(np.float32)
+    df = DataFrame({"features": Xs, "label": y})
+    est_opt = LogisticRegression(enable_sparse_data_optim=True, regParam=0.01)
+    est_auto = LogisticRegression(regParam=0.01)
+    assert est_opt._should_stream(df) is True
+    assert est_auto._should_stream(df) is False  # tiny dataset, no opt-in
+    m = est_opt.fit(df)
+    m_res = est_auto.fit(df)
+    np.testing.assert_allclose(
+        m.coefficientMatrix, m_res.coefficientMatrix, rtol=2e-2, atol=2e-3
+    )
