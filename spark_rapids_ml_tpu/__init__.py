@@ -16,6 +16,13 @@ Drop-in import layout mirrors the reference package::
 
 __version__ = "0.1.0"
 
+# Multi-process bootstrap must precede ANY backend touch
+# (jax.distributed.initialize refuses after the first jax.devices()/array
+# op). Env-gated no-op outside a launcher-provided multi-process world.
+from .parallel.context import ensure_distributed as _ensure_distributed
+
+_ensure_distributed()
+
 from .data.dataframe import DataFrame, Row
 
 __all__ = ["DataFrame", "Row", "__version__"]

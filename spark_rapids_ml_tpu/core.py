@@ -41,7 +41,13 @@ import jax.numpy as jnp
 
 from .data.dataframe import DataFrame, _is_sparse
 from .params import Params, _TpuParams, HasLabelCol, HasPredictionCol, HasWeightCol
-from .parallel.mesh import make_mesh, shard_rows, row_sharding
+from .parallel.mesh import (
+    global_row_count,
+    make_mesh,
+    row_sharding,
+    shard_aligned,
+    shard_rows,
+)
 from .utils.logging import get_logger
 
 
@@ -203,6 +209,30 @@ class _TpuEstimator(Params, _TpuParams):
 
     # ---- streaming decision / data plane --------------------------------
     def _should_stream(self, dataset: DataFrame) -> bool:
+        import jax as _jax
+
+        if _jax.process_count() > 1:
+            # multi-process fits use the resident row-sharded path: chunked
+            # streaming needs a cross-process chunk-count agreement protocol
+            # (unequal local partitions would deadlock the per-chunk psum)
+            if self._streaming:
+                raise NotImplementedError(
+                    "streaming fit is not supported in multi-process mode; "
+                    "use the resident path (streaming=False)"
+                )
+            if (
+                self.hasParam("enable_sparse_data_optim")
+                and self.isDefined("enable_sparse_data_optim")
+                and self.getOrDefault("enable_sparse_data_optim") is True
+            ):
+                # the sparse opt-in IS the chunked-CSR streaming path —
+                # silently densifying would OOM on exactly the inputs the
+                # opt-in exists for
+                raise NotImplementedError(
+                    "enable_sparse_data_optim requires the streaming path, "
+                    "which is not supported in multi-process mode"
+                )
+            return False
         if self._streaming is not None:
             return bool(self._streaming)
         from .data.dataframe import ParquetScanFrame
@@ -319,25 +349,27 @@ class _TpuEstimator(Params, _TpuParams):
             # stream it instead. Reference CSR ingestion: ``core.py:196-241``.
             n_rows, n_features = X_sparse.shape
             dtype = self._target_dtype(None)
-            csize = self._chunk_rows(n_rows, mesh.shape["dp"])
-            Xd, maskd = shard_rows(
-                np.asarray(X_sparse.todense(), dtype=dtype), mesh, csize
-            )
         else:
             dtype = self._target_dtype(X)
             X = np.ascontiguousarray(X, dtype=dtype)
             n_rows, n_features = X.shape
-            csize = self._chunk_rows(n_rows, mesh.shape["dp"])
+        # chunk size must be agreed across the process world (it shapes the
+        # compiled program and its collectives): derive it from the GLOBAL
+        # row count, never the local partition size
+        n_global = global_row_count(int(n_rows))
+        csize = self._chunk_rows(n_global, mesh.shape["dp"])
+        if X_sparse is not None:
+            Xd, maskd = shard_rows(
+                np.asarray(X_sparse.todense(), dtype=dtype), mesh, csize
+            )
+        else:
             Xd, maskd = shard_rows(X, mesh, csize)
 
         y = w = None
         if self._require_label():
             label_col = self.getOrDefault("labelCol")
             y_host = np.asarray(dataset.column(label_col), dtype=dtype)
-            n_pad = Xd.shape[0] - n_rows
-            if n_pad:
-                y_host = np.pad(y_host, (0, n_pad))
-            y = jax.device_put(y_host, row_sharding(mesh))
+            y = shard_aligned(y_host, mesh, Xd.shape[0])
         if (
             isinstance(self, HasWeightCol)
             and self.hasParam("weightCol")
@@ -350,16 +382,13 @@ class _TpuEstimator(Params, _TpuParams):
                     f"weightCol {wcol!r} not found in dataset columns {dataset.columns}"
                 )
             w_host = np.asarray(dataset.column(wcol), dtype=dtype)
-            n_pad = Xd.shape[0] - n_rows
-            if n_pad:
-                w_host = np.pad(w_host, (0, n_pad))
-            w = jax.device_put(w_host, row_sharding(mesh))
+            w = shard_aligned(w_host, mesh, Xd.shape[0])
 
         return FitInputs(
             X=Xd,
             mask=maskd,
             mesh=mesh,
-            n_rows=int(n_rows),
+            n_rows=n_global,
             n_features=int(n_features),
             y=y,
             weight=w,

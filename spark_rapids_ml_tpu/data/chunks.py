@@ -70,10 +70,18 @@ class ChunkSource:
     def iter_labels(self, chunk_rows: int) -> Iterator[np.ndarray]:
         """Valid (unpadded) label values, one array per chunk.
 
-        Label-only scans (class counting) must not pay for features:
-        sources override this to skip reading/densifying the feature
-        matrix entirely; the base fallback goes through ``iter_chunks``.
+        Label-only scans (class counting) must not pay for features: any
+        source holding labels as a host array (``self._y``) slices it
+        directly; others override (ParquetChunkSource reads only the label
+        column) or fall through to full chunks.
         """
+        y = getattr(self, "_y", None)
+        if y is not None:
+            for lo in range(0, self.n_rows, chunk_rows):
+                yield np.asarray(y[lo : lo + chunk_rows])
+            return
+        if not self.has_label:
+            raise ValueError("Chunk source has no label column")
         for chunk in self.iter_chunks(chunk_rows, np.float32):
             if chunk.y is None:
                 raise ValueError("Chunk source has no label column")
@@ -101,12 +109,6 @@ class ArrayChunkSource(ChunkSource):
         self.n_rows, self.n_features = X.shape
         self.has_label = y is not None
         self.has_weight = w is not None
-
-    def iter_labels(self, chunk_rows: int) -> Iterator[np.ndarray]:
-        if self._y is None:
-            raise ValueError("Chunk source has no label column")
-        for lo in range(0, self.n_rows, chunk_rows):
-            yield np.asarray(self._y[lo : lo + chunk_rows])
 
     def iter_chunks(self, chunk_rows: int, dtype: Any = np.float32) -> Iterator[Chunk]:
         for lo in range(0, self.n_rows, chunk_rows):
@@ -139,12 +141,6 @@ class CSRChunkSource(ChunkSource):
         self.n_rows, self.n_features = self._X.shape
         self.has_label = y is not None
         self.has_weight = w is not None
-
-    def iter_labels(self, chunk_rows: int) -> Iterator[np.ndarray]:
-        if self._y is None:
-            raise ValueError("Chunk source has no label column")
-        for lo in range(0, self.n_rows, chunk_rows):
-            yield np.asarray(self._y[lo : lo + chunk_rows])
 
     def iter_chunks(self, chunk_rows: int, dtype: Any = np.float32) -> Iterator[Chunk]:
         for lo in range(0, self.n_rows, chunk_rows):

@@ -186,27 +186,51 @@ class LogisticRegression(
 
     def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
         # label analysis happens on host, once, outside jit (the class count
-        # is a static shape parameter of the compiled program)
+        # is a static shape parameter of the compiled program). It must be
+        # GLOBAL: in a multi-process world each rank sees only its
+        # partition, and ranks disagreeing on n_classes (or on the
+        # degenerate single-label early-return) would compile different
+        # collectives and deadlock.
+        from ..parallel.mesh import allgather_host
+
         label_col = self.getOrDefault("labelCol")
         y_host = np.asarray(dataset.column(label_col))
-        if y_host.size == 0:
+        empty = y_host.size == 0
+        local = np.asarray(
+            [
+                1.0 if empty else 0.0,
+                -np.inf if empty else float(y_host.max()),
+                np.inf if empty else float(y_host.min()),
+                1.0 if empty or np.all(y_host == np.floor(y_host)) else 0.0,
+                0.0 if empty else float(y_host[0]),
+                1.0 if empty or np.all(y_host == y_host[0]) else 0.0,
+            ]
+        )
+        g = allgather_host(local)
+        non_empty = g[g[:, 0] == 0.0]
+        if len(non_empty) == 0:
             raise ValueError("Labels column is empty")
-        if np.any(y_host < 0) or np.any(y_host != np.floor(y_host)):
+        y_max, y_min = non_empty[:, 1].max(), non_empty[:, 2].min()
+        if y_min < 0 or not np.all(non_empty[:, 3] == 1.0):
             raise RuntimeError(
-                f"Labels MUST be non-negative integers, got values outside that set"
+                "Labels MUST be non-negative integers, got values outside that set"
             )
         # Spark semantics: numClasses = max(label) + 1
-        n_classes = max(int(y_host.max()) + 1, 2)
-        uniques = np.unique(y_host)
+        n_classes = max(int(y_max) + 1, 2)
+        single_label = bool(
+            np.all(non_empty[:, 5] == 1.0)
+            and np.all(non_empty[:, 4] == non_empty[0, 4])
+        )
+        single_label_val = float(non_empty[0, 4])
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
             multinomial = n_classes > 2
             fit_intercept = bool(params["fit_intercept"])
 
-            if len(uniques) == 1 and n_classes == 2:
+            if single_label and n_classes == 2:
                 # single-label degenerate case (reference
                 # ``classification.py:1119-1132``): all-0 or all-1 labels
-                class_val = float(uniques[0])
+                class_val = single_label_val
                 if fit_intercept:
                     return {
                         "coef_": np.zeros((1, inputs.n_features)),

@@ -203,27 +203,36 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
         return _weighted_kmeanspp(cands.astype(np.float64), weights, k, rng)
 
     def _resident_seed_prims(self, inputs: FitInputs):
-        n = inputs.n_rows
+        from ..parallel.mesh import fetch_global, gather_rows_global
+
+        # seeding addresses "logical valid rows 0..n_rows"; padded-array
+        # positions of those rows come from the mask (padding is at the
+        # end single-process but interleaved per-process block multi-host)
+        valid_pos = np.nonzero(fetch_global(inputs.mask, inputs.mesh) > 0)[0]
 
         def gather(idx: np.ndarray) -> np.ndarray:
-            return np.asarray(inputs.X[idx])
+            return gather_rows_global(inputs.X, valid_pos[idx], inputs.mesh)
 
         def min_d2_update(new: np.ndarray, min_d2):
             nd = np.asarray(
-                min_sq_dists(
-                    inputs.X, inputs.mask, jnp.asarray(new, inputs.dtype),
-                    mesh=inputs.mesh, csize=inputs.csize,
+                fetch_global(
+                    min_sq_dists(
+                        inputs.X, inputs.mask, jnp.asarray(new, inputs.dtype),
+                        mesh=inputs.mesh, csize=inputs.csize,
+                    ),
+                    inputs.mesh,
                 ),
                 np.float64,
-            )[:n]
+            )[valid_pos]
             return nd if min_d2 is None else np.minimum(min_d2, nd)
 
         def count_closest_fn(cands: np.ndarray) -> np.ndarray:
-            return np.asarray(
+            return fetch_global(
                 count_closest(
                     inputs.X, inputs.mask, jnp.asarray(cands, inputs.dtype),
                     mesh=inputs.mesh, csize=inputs.csize,
-                )
+                ),
+                inputs.mesh,
             )
 
         return gather, min_d2_update, count_closest_fn

@@ -2,22 +2,28 @@ from .mesh import (
     DP_AXIS,
     MP_AXIS,
     default_device_count,
+    global_row_count,
     make_mesh,
     pad_rows,
     replicated,
     row_sharding,
+    shard_aligned,
     shard_rows,
 )
-from .context import TpuDistContext
+from .context import TpuDistContext, distributed_env_configured, ensure_distributed
 
 __all__ = [
     "DP_AXIS",
     "MP_AXIS",
     "default_device_count",
+    "distributed_env_configured",
+    "ensure_distributed",
+    "global_row_count",
     "make_mesh",
     "pad_rows",
     "replicated",
     "row_sharding",
+    "shard_aligned",
     "shard_rows",
     "TpuDistContext",
 ]

@@ -29,6 +29,34 @@ from ..utils.logging import get_logger
 logger = get_logger("TpuDistContext")
 
 
+_process_initialized = False
+
+
+def distributed_env_configured() -> bool:
+    """True when the launcher provided multi-process rendezvous info."""
+    return (
+        bool(os.environ.get("TPUML_COORDINATOR"))
+        and int(os.environ.get("TPUML_NUM_PROCS", "1")) > 1
+    )
+
+
+def ensure_distributed() -> None:
+    """Idempotent env-driven multi-process bootstrap.
+
+    Called from ``make_mesh`` — every estimator's first mesh touch — so any
+    fit in a launcher-provided multi-process environment joins the global
+    device world before sharding anything (the reference injects its
+    communicator into every fit the same way, ``core.py:749-755``).
+    ``jax.distributed`` is process-global, so unlike the reference's
+    per-stage NCCL communicator it is formed once and reused by every
+    subsequent fit in the process.
+    """
+    global _process_initialized
+    if _process_initialized or not distributed_env_configured():
+        return
+    TpuDistContext().__enter__()
+
+
 class TpuDistContext:
     """rank/nranks multi-process bootstrap for multi-host TPU pods.
 
@@ -39,7 +67,11 @@ class TpuDistContext:
       TPUML_NUM_PROCS    total process count
       TPUML_PROC_ID      this process's rank
 
-    With no env set, runs single-process (all local devices).
+    With no env set, runs single-process (all local devices).  Entering is
+    idempotent across instances (first enter in the process initializes).
+    On exception, exit shuts the distributed runtime down so surviving
+    ranks fail fast instead of hanging in a collective — the analog of the
+    reference's ``nccl.abort()`` (``cuml_context.py:155-160``).
     """
 
     def __init__(
@@ -64,7 +96,12 @@ class TpuDistContext:
         return self.num_processes
 
     def __enter__(self) -> "TpuDistContext":
-        if self.num_processes > 1 and self.coordinator:
+        global _process_initialized
+        if (
+            self.num_processes > 1
+            and self.coordinator
+            and not _process_initialized
+        ):
             logger.info(
                 "jax.distributed.initialize(coordinator=%s, nprocs=%d, pid=%d)",
                 self.coordinator, self.num_processes, self.process_id,
@@ -75,14 +112,18 @@ class TpuDistContext:
                 process_id=self.process_id,
             )
             self._initialized_here = True
+            _process_initialized = True
         return self
 
     def __exit__(self, exc_type, exc_val, exc_tb) -> None:
-        if self._initialized_here:
-            try:
-                jax.distributed.shutdown()
-            except Exception:  # pragma: no cover - teardown best effort
-                if exc_type is None:
-                    raise
+        global _process_initialized
         if exc_type is not None:
             logger.error("distributed stage failed: %s", exc_val)
+            if self._initialized_here:
+                # abort semantics: tear the runtime down so peers blocked in
+                # a collective error out instead of hanging
+                try:
+                    jax.distributed.shutdown()
+                except Exception:  # pragma: no cover - teardown best effort
+                    pass
+                _process_initialized = False

