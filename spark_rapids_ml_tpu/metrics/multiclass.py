@@ -1,30 +1,32 @@
 """Multiclass classification metrics from confusion sufficient statistics.
 
-Port of the reference's ``MulticlassMetrics``
-(``/root/reference/python/src/spark_rapids_ml/metrics/MulticlassMetrics.py``),
-itself aligned with Spark's Scala ``MulticlassMetrics``. The sufficient
-statistics are per-class true-positive / false-positive / label counts plus
-an accumulated log-loss sum — tiny, mergeable across shards, and enough for
-every metric ``MulticlassClassificationEvaluator`` supports.
+Computes everything ``MulticlassClassificationEvaluator`` supports from
+per-class true-positive / false-positive / label counts plus an accumulated
+log-loss sum — tiny, mergeable across shards (semantics follow Spark's
+Scala ``MulticlassMetrics``; reference analog:
+``/root/reference/python/src/spark_rapids_ml/metrics/MulticlassMetrics.py``).
+
+The statistics live in aligned numpy arrays keyed by a sorted class vector
+(not per-class dicts): ``from_predictions`` is one ``np.unique`` + three
+``bincount`` calls over the shard, and every aggregate is a vectorized
+reduction.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Optional
 
 import numpy as np
 
 
 def log_loss(labels: np.ndarray, probs: np.ndarray, eps: float) -> float:
-    """Sum of -log(p[label]) with probabilities clamped at ``eps``
-    (reference ``MulticlassMetrics.py:24-31``)."""
+    """Sum of -log(p[label]) with probabilities clamped at ``eps``."""
     if np.any(labels < 0) or np.any(labels > probs.shape[1] - 1):
         raise ValueError(f"labels must be in the range [0,{probs.shape[1] - 1}]")
     if np.any(probs < 0) or np.any(probs > 1.0):
         raise ValueError("probs must be in the range [0.0, 1.0]")
-    probs_for_labels = probs[np.arange(probs.shape[0]), labels.astype(np.int32)]
-    probs_for_labels = np.maximum(probs_for_labels, eps)
-    return float(np.sum(-np.log(probs_for_labels)))
+    p = probs[np.arange(probs.shape[0]), labels.astype(np.int32)]
+    return float(-np.log(np.maximum(p, eps)).sum())
 
 
 class MulticlassMetrics:
@@ -49,17 +51,24 @@ class MulticlassMetrics:
 
     def __init__(
         self,
-        tp: Optional[Dict[float, float]] = None,
-        fp: Optional[Dict[float, float]] = None,
-        label: Optional[Dict[float, float]] = None,
-        label_count: int = 0,
-        log_loss: float = -1,
+        classes: Optional[np.ndarray] = None,
+        tp: Optional[np.ndarray] = None,
+        fp: Optional[np.ndarray] = None,
+        label_counts: Optional[np.ndarray] = None,
+        n_rows: int = 0,
+        log_loss_sum: float = -1.0,
     ) -> None:
-        self._tp_by_class = tp or {}
-        self._fp_by_class = fp or {}
-        self._label_count_by_class = label or {}
-        self._label_count = label_count
-        self._log_loss = log_loss
+        self._classes = (
+            np.asarray(classes, np.float64) if classes is not None else np.empty(0)
+        )
+        z = np.zeros_like(self._classes)
+        self._tp = np.asarray(tp, np.float64) if tp is not None else z.copy()
+        self._fp = np.asarray(fp, np.float64) if fp is not None else z.copy()
+        self._label_counts = (
+            np.asarray(label_counts, np.float64) if label_counts is not None else z.copy()
+        )
+        self._n_rows = int(n_rows)
+        self._log_loss_sum = float(log_loss_sum)
 
     @classmethod
     def from_predictions(
@@ -69,140 +78,127 @@ class MulticlassMetrics:
         probs: Optional[np.ndarray] = None,
         eps: float = 1.0e-15,
     ) -> "MulticlassMetrics":
-        """Build the sufficient statistics from a (shard of) predictions."""
-        labels = np.asarray(labels, dtype=np.float64)
-        predictions = np.asarray(predictions, dtype=np.float64)
-        tp: Dict[float, float] = {}
-        fp: Dict[float, float] = {}
-        cnt: Dict[float, float] = {}
-        # tp/fp are tracked for every class that appears anywhere; label
-        # counts only for classes present in labels (a prediction-only class
-        # must not create a zero-count label entry — recall would be 0/0)
-        for c in np.unique(np.concatenate([labels, predictions])):
-            is_label = labels == c
-            is_pred = predictions == c
-            tp[float(c)] = float(np.sum(is_label & is_pred))
-            fp[float(c)] = float(np.sum(~is_label & is_pred))
-            n_label = float(np.sum(is_label))
-            if n_label > 0:
-                cnt[float(c)] = n_label
+        """Build the sufficient statistics from a (shard of) predictions —
+        fully vectorized: one unique-encode plus three bincounts."""
+        labels = np.asarray(labels, np.float64)
+        predictions = np.asarray(predictions, np.float64)
+        n = labels.shape[0]
+        classes, codes = np.unique(
+            np.concatenate([labels, predictions]), return_inverse=True
+        )
+        lab_c, pred_c = codes[:n], codes[n:]
+        k = len(classes)
+        hit = lab_c == pred_c
+        tp = np.bincount(lab_c[hit], minlength=k).astype(np.float64)
+        fp = np.bincount(pred_c[~hit], minlength=k).astype(np.float64)
+        label_counts = np.bincount(lab_c, minlength=k).astype(np.float64)
         ll = log_loss(labels, probs, eps) if probs is not None else -1.0
-        return cls(tp, fp, cnt, int(labels.shape[0]), ll)
+        return cls(classes, tp, fp, label_counts, n, ll)
 
     def merge(self, other: "MulticlassMetrics") -> "MulticlassMetrics":
-        """Merge two shards' sufficient statistics."""
+        """Merge two shards' sufficient statistics (class-vector union)."""
+        classes = np.union1d(self._classes, other._classes)
 
-        def _madd(a: Dict[float, float], b: Dict[float, float]) -> Dict[float, float]:
-            out = dict(a)
-            for k, v in b.items():
-                out[k] = out.get(k, 0.0) + v
+        def _scatter(m: "MulticlassMetrics", arr: np.ndarray) -> np.ndarray:
+            out = np.zeros(len(classes))
+            out[np.searchsorted(classes, m._classes)] = arr
             return out
 
         ll = (
-            self._log_loss + other._log_loss
-            if self._log_loss >= 0 and other._log_loss >= 0
+            self._log_loss_sum + other._log_loss_sum
+            if self._log_loss_sum >= 0 and other._log_loss_sum >= 0
             else -1.0
         )
         return MulticlassMetrics(
-            _madd(self._tp_by_class, other._tp_by_class),
-            _madd(self._fp_by_class, other._fp_by_class),
-            _madd(self._label_count_by_class, other._label_count_by_class),
-            self._label_count + other._label_count,
+            classes,
+            _scatter(self, self._tp) + _scatter(other, other._tp),
+            _scatter(self, self._fp) + _scatter(other, other._fp),
+            _scatter(self, self._label_counts) + _scatter(other, other._label_counts),
+            self._n_rows + other._n_rows,
             ll,
         )
 
-    # -- per-label pieces (reference ``MulticlassMetrics.py:70-143``) -------
-    def _precision(self, label: float) -> float:
-        tp = self._tp_by_class.get(label, 0.0)
-        fp = self._fp_by_class.get(label, 0.0)
-        return 0.0 if (tp + fp == 0) else tp / (tp + fp)
+    # -- vectorized per-class pieces ---------------------------------------
+    @staticmethod
+    def _safe_div(num: np.ndarray, den: np.ndarray) -> np.ndarray:
+        return np.divide(num, den, out=np.zeros_like(np.asarray(num, np.float64)),
+                         where=np.asarray(den) != 0)
 
-    def _recall(self, label: float) -> float:
-        n = self._label_count_by_class.get(label, 0.0)
-        return 0.0 if n == 0 else self._tp_by_class.get(label, 0.0) / n
+    def _precision_vec(self) -> np.ndarray:
+        return self._safe_div(self._tp, self._tp + self._fp)
 
-    def _f_measure(self, label: float, beta: float = 1.0) -> float:
-        p = self._precision(label)
-        r = self._recall(label)
-        beta_sqrd = beta * beta
-        return 0.0 if (p + r == 0) else (1 + beta_sqrd) * p * r / (beta_sqrd * p + r)
+    def _recall_vec(self) -> np.ndarray:
+        return self._safe_div(self._tp, self._label_counts)
 
-    def false_positive_rate(self, label: float) -> float:
-        fp = self._fp_by_class.get(label, 0.0)
-        denom = self._label_count - self._label_count_by_class.get(label, 0.0)
-        return 0.0 if denom == 0 else fp / denom
+    def _fmeasure_vec(self, beta: float = 1.0) -> np.ndarray:
+        p, r = self._precision_vec(), self._recall_vec()
+        b2 = beta * beta
+        return self._safe_div((1 + b2) * p * r, b2 * p + r)
 
-    # -- aggregates --------------------------------------------------------
-    def weighted_fmeasure(self, beta: float = 1.0) -> float:
-        return sum(
-            self._f_measure(k, beta) * v / self._label_count
-            for k, v in self._label_count_by_class.items()
-        )
+    def _fpr_vec(self) -> np.ndarray:
+        return self._safe_div(self._fp, self._n_rows - self._label_counts)
 
+    def _at(self, vec: np.ndarray, label: float) -> float:
+        i = np.searchsorted(self._classes, float(label))
+        if i < len(self._classes) and self._classes[i] == float(label):
+            return float(vec[i])
+        return 0.0
+
+    def _weighted(self, vec: np.ndarray) -> float:
+        return float((vec * self._label_counts).sum() / self._n_rows)
+
+    # -- aggregates ---------------------------------------------------------
     def accuracy(self) -> float:
-        return sum(self._tp_by_class.values()) / self._label_count
-
-    def weighted_precision(self) -> float:
-        return sum(
-            self._precision(c) * n / self._label_count
-            for c, n in self._label_count_by_class.items()
-        )
-
-    def weighted_recall(self) -> float:
-        return sum(
-            self._recall(c) * n / self._label_count
-            for c, n in self._label_count_by_class.items()
-        )
-
-    def weighted_true_positive_rate(self) -> float:
-        return self.weighted_recall()
-
-    def weighted_false_positive_rate(self) -> float:
-        return sum(
-            self.false_positive_rate(c) * n / self._label_count
-            for c, n in self._label_count_by_class.items()
-        )
-
-    def true_positive_rate_by_label(self, label: float) -> float:
-        return self._recall(label)
+        return float(self._tp.sum() / self._n_rows)
 
     def hamming_loss(self) -> float:
-        return sum(self._fp_by_class.values()) / self._label_count
+        return float(self._fp.sum() / self._n_rows)
+
+    def weighted_fmeasure(self, beta: float = 1.0) -> float:
+        return self._weighted(self._fmeasure_vec(beta))
+
+    def weighted_precision(self) -> float:
+        return self._weighted(self._precision_vec())
+
+    def weighted_recall(self) -> float:
+        return self._weighted(self._recall_vec())
+
+    def weighted_false_positive_rate(self) -> float:
+        return self._weighted(self._fpr_vec())
+
+    def false_positive_rate(self, label: float) -> float:
+        return self._at(self._fpr_vec(), label)
 
     def log_loss(self) -> float:
-        return self._log_loss / self._label_count
+        return self._log_loss_sum / self._n_rows
 
     def evaluate(self, evaluator: Any) -> float:
-        """Compute the metric an evaluator asks for (reference
-        ``MulticlassMetrics.py:148-180``)."""
-        metric_name = evaluator.getMetricName()
-        if metric_name == "f1":
+        """Compute the metric an evaluator asks for."""
+        name = evaluator.getMetricName()
+        if name == "f1":
             return self.weighted_fmeasure()
-        elif metric_name == "accuracy":
+        if name == "accuracy":
             return self.accuracy()
-        elif metric_name == "weightedPrecision":
+        if name == "weightedPrecision":
             return self.weighted_precision()
-        elif metric_name == "weightedRecall":
+        if name in ("weightedRecall", "weightedTruePositiveRate"):
             return self.weighted_recall()
-        elif metric_name == "weightedTruePositiveRate":
-            return self.weighted_true_positive_rate()
-        elif metric_name == "weightedFalsePositiveRate":
+        if name == "weightedFalsePositiveRate":
             return self.weighted_false_positive_rate()
-        elif metric_name == "weightedFMeasure":
+        if name == "weightedFMeasure":
             return self.weighted_fmeasure(evaluator.getBeta())
-        elif metric_name == "truePositiveRateByLabel":
-            return self.true_positive_rate_by_label(evaluator.getMetricLabel())
-        elif metric_name == "falsePositiveRateByLabel":
+        if name in ("truePositiveRateByLabel", "recallByLabel"):
+            return self._at(self._recall_vec(), evaluator.getMetricLabel())
+        if name == "falsePositiveRateByLabel":
             return self.false_positive_rate(evaluator.getMetricLabel())
-        elif metric_name == "precisionByLabel":
-            return self._precision(evaluator.getMetricLabel())
-        elif metric_name == "recallByLabel":
-            return self._recall(evaluator.getMetricLabel())
-        elif metric_name == "fMeasureByLabel":
-            return self._f_measure(evaluator.getMetricLabel(), evaluator.getBeta())
-        elif metric_name == "hammingLoss":
+        if name == "precisionByLabel":
+            return self._at(self._precision_vec(), evaluator.getMetricLabel())
+        if name == "fMeasureByLabel":
+            return self._at(
+                self._fmeasure_vec(evaluator.getBeta()), evaluator.getMetricLabel()
+            )
+        if name == "hammingLoss":
             return self.hamming_loss()
-        elif metric_name == "logLoss":
+        if name == "logLoss":
             return self.log_loss()
-        else:
-            raise ValueError(f"Unsupported metric name, found {metric_name}")
+        raise ValueError(f"Unsupported metric name, found {name}")

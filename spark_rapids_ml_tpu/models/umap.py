@@ -39,6 +39,7 @@ from ..params import (
 )
 from ..ops.kmeans_kernels import pairwise_sq_dists
 from ..ops.umap_kernels import (
+    categorical_simplicial_set_intersection,
     default_n_epochs,
     find_ab_params,
     fuzzy_simplicial_set,
@@ -215,13 +216,21 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
             self._copy_tpu_params(est)
             est._set_params(**{p.name if hasattr(p, "name") else p: v for p, v in params.items()})
             return est.fit(dataset)
-        if self.isDefined("labelCol") and self.isSet("labelCol"):
-            self.logger.warning("supervised UMAP (labelCol) is not supported; ignoring")
-
         seed = int(self._tpu_params.get("random_state") or 0)
         frac = float(self.getSampleFraction())
         df = dataset if frac >= 1.0 else dataset.sample(frac, seed=seed)
         X = self._resolve_features(df)
+        y_labels: Optional[np.ndarray] = None
+        if self.isDefined("labelCol") and self.isSet("labelCol"):
+            # supervised fit (reference delegates to cuML fit(X, y=labels),
+            # ``umap.py:941-947``): labels sharpen the fuzzy set below
+            label_col = self.getOrDefault("labelCol")
+            if label_col not in df:
+                raise ValueError(
+                    f"labelCol {label_col!r} not found in dataset columns "
+                    f"{df.columns}"
+                )
+            y_labels = np.asarray(df.column(label_col)).astype(np.int64)
         n = X.shape[0]
         k = int(self._tpu_params.get("n_neighbors", 15))
         if k >= n:
@@ -243,13 +252,18 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         knn_i = idx_np[keep].reshape(n, k)
         knn_d = dists_np[keep].reshape(n, k)
 
-        # 2) fuzzy simplicial set
+        # 2) fuzzy simplicial set (+ categorical label intersection when
+        # supervised)
         heads, tails, weights = fuzzy_simplicial_set(
             knn_i,
             knn_d,
             float(self._tpu_params.get("local_connectivity", 1.0)),
             float(self._tpu_params.get("set_op_mix_ratio", 1.0)),
         )
+        if y_labels is not None:
+            heads, tails, weights = categorical_simplicial_set_intersection(
+                heads, tails, weights, y_labels, n
+            )
 
         # 3) curve params + init
         a = self._tpu_params.get("a")

@@ -117,6 +117,13 @@ def fuzzy_simplicial_set(
     rows = np.repeat(np.arange(n), k)
     cols = knn_indices.reshape(-1)
     A = sp.coo_matrix((w.reshape(-1), (rows, cols)), shape=(n, n)).tocsr()
+    return _fuzzy_union_edges(A, set_op_mix_ratio)
+
+
+def _fuzzy_union_edges(A, set_op_mix_ratio: float = 1.0):
+    """Symmetrize a directed membership CSR via the probabilistic t-conorm
+    (mixed with the intersection per ``set_op_mix_ratio``) and extract the
+    positive-weight edge list."""
     T = A.T.tocsr()
     prod = A.multiply(T)
     sym = (
@@ -128,6 +135,42 @@ def fuzzy_simplicial_set(
         sym.col[mask].astype(np.int32),
         sym.data[mask].astype(np.float32),
     )
+
+
+def categorical_simplicial_set_intersection(
+    heads: np.ndarray,
+    tails: np.ndarray,
+    weights: np.ndarray,
+    labels: np.ndarray,
+    n: int,
+    far_dist: float = 5.0,
+    unknown_dist: float = 1.0,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Supervised (categorical) intersection of the fuzzy simplicial set
+    with a label-induced set — the standard UMAP supervision the reference
+    gets from cuML's ``fit(X, y=labels)`` (``umap.py:941-947``; cuML
+    default ``target_weight=0.5`` ⇒ ``far_dist = 2.5/(1-0.5) = 5``).
+
+    Edges joining different labels are scaled by exp(-far_dist), edges
+    with an unknown (< 0) endpoint by exp(-unknown_dist); local
+    connectivity is then reset (per-row max normalization + fuzzy union),
+    restoring each point's strongest link to weight ~1.
+    """
+    import scipy.sparse as sp
+
+    li = labels[heads]
+    lj = labels[tails]
+    unknown = (li < 0) | (lj < 0)
+    diff = (li != lj) & ~unknown
+    scale = np.where(
+        unknown, np.exp(-unknown_dist), np.where(diff, np.exp(-far_dist), 1.0)
+    )
+    w = weights * scale
+
+    A = sp.coo_matrix((w, (heads, tails)), shape=(n, n)).tocsr()
+    rowmax = np.asarray(A.max(axis=1).todense()).ravel()
+    A = sp.diags(1.0 / np.maximum(rowmax, 1e-12)) @ A
+    return _fuzzy_union_edges(A)
 
 
 def spectral_init(

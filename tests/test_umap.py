@@ -134,3 +134,49 @@ def test_umap_handles_duplicate_rows():
     )
     t = _trust(X, model.embedding_, n_neighbors=8)
     assert t > 0.8
+
+
+def _neighbor_purity(emb, labels, k=10):
+    """Fraction of each point's k embedding-space neighbors sharing its
+    label."""
+    d2 = ((emb[:, None, :] - emb[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d2, np.inf)
+    nn = np.argsort(d2, axis=1)[:, :k]
+    return (labels[nn] == labels[:, None]).mean()
+
+
+def test_umap_supervised_changes_embedding_and_separates_classes():
+    """labelCol engages the categorical simplicial-set intersection
+    (reference supervised fit: ``umap.py:721-722``, ``umap.py:941-947``) —
+    the embedding changes and same-label points pull together."""
+    # heavily overlapping clusters: supervision has signal to add
+    X, labels = _blobs(n=400, d=8, k=3, spread=3.5, seed=4)
+    df = DataFrame({"features": X, "label": labels.astype(np.float64)})
+    unsup = UMAP(n_neighbors=12, random_state=0).fit(df)
+    sup = UMAP(n_neighbors=12, random_state=0, labelCol="label").fit(df)
+    assert not np.allclose(unsup.embedding_, sup.embedding_)
+    pu = _neighbor_purity(unsup.embedding_, labels)
+    ps = _neighbor_purity(sup.embedding_, labels)
+    assert ps > pu, (ps, pu)
+    assert ps > 0.85
+    # embedding remains trustworthy w.r.t. the input space
+    assert _trust(X, sup.embedding_, n_neighbors=12) > 0.5
+
+
+def test_umap_supervised_unknown_labels_ignored():
+    """Negative labels mean 'unknown' (semi-supervised): they must not be
+    forced apart from any class."""
+    X, labels = _blobs(n=300, d=6, k=2, spread=1.0, seed=8)
+    y = labels.astype(np.float64).copy()
+    y[::3] = -1.0
+    df = DataFrame({"features": X, "label": y})
+    m = UMAP(n_neighbors=10, random_state=1, labelCol="label").fit(df)
+    known = y >= 0
+    ps = _neighbor_purity(m.embedding_[known], labels[known])
+    assert ps > 0.85
+
+
+def test_umap_supervised_missing_label_col_raises():
+    X, _ = _blobs(n=60, d=4, k=2)
+    with pytest.raises(ValueError, match="labelCol"):
+        UMAP(n_neighbors=5, labelCol="nope").fit(DataFrame({"features": X}))
