@@ -23,39 +23,109 @@
 #include <cstring>
 #include <vector>
 
+#include <dlfcn.h>
+
 #if defined(_OPENMP)
 #include <omp.h>
 #endif
 
-extern "C" {
+// ---------------------------------------------------------------------------
+// Optional BLAS backend (dlopen'd at runtime — the role cuBLAS played for
+// the reference's dgemmCov/dgemm). The Python facade points us at the
+// OpenBLAS shipped inside the numpy/scipy wheels (no system BLAS needed);
+// without one, the portable blocked kernels below serve as fallback.
+// CBLAS row-major conventions; both 32- and 64-bit-int ABIs supported.
+// ---------------------------------------------------------------------------
+namespace {
 
-// ---------------------------------------------------------------------------
-// Gram matrix: out(d,d) += X^T X for a row-major (n,d) batch.
-// Blocked over rows for cache locality; parallel over column tiles.
-// ---------------------------------------------------------------------------
-void tpuml_gram_f32(const float* X, int64_t n, int64_t d, double* out) {
-  const int64_t RB = 256;
-#if defined(_OPENMP)
-#pragma omp parallel for schedule(dynamic)
-#endif
-  for (int64_t i = 0; i < d; ++i) {
-    for (int64_t r0 = 0; r0 < n; r0 += RB) {
-      const int64_t r1 = r0 + RB < n ? r0 + RB : n;
-      for (int64_t r = r0; r < r1; ++r) {
-        const float xi = X[r * d + i];
-        if (xi == 0.0f) continue;
-        const float* row = X + r * d;
-        double* o = out + i * d;
-        for (int64_t j = i; j < d; ++j) o[j] += (double)xi * (double)row[j];
-      }
-    }
-  }
-  // mirror the upper triangle
+enum { kRowMajor = 101, kUpper = 121, kTrans = 112, kNoTrans = 111 };
+
+typedef void (*dsyrk32_t)(int, int, int, int, int, double, const double*, int,
+                          double, double*, int);
+typedef void (*dgemm32_t)(int, int, int, int, int, int, double, const double*,
+                          int, const double*, int, double, double*, int);
+typedef void (*dsyrk64_t)(int64_t, int64_t, int64_t, int64_t, int64_t, double,
+                          const double*, int64_t, double, double*, int64_t);
+typedef void (*dgemm64_t)(int64_t, int64_t, int64_t, int64_t, int64_t, int64_t,
+                          double, const double*, int64_t, const double*,
+                          int64_t, double, double*, int64_t);
+
+void* g_blas_handle = nullptr;
+int g_blas_bits = 0;  // 0 = none, 32 / 64 = int width of the cblas ABI
+dsyrk32_t g_dsyrk32 = nullptr;
+dgemm32_t g_dgemm32 = nullptr;
+dsyrk64_t g_dsyrk64 = nullptr;
+dgemm64_t g_dgemm64 = nullptr;
+
+void blas_dsyrk_upper(int64_t d, int64_t n, const double* X, double* out) {
+  // out(d,d) += X^T X, upper triangle (X row-major (n,d))
+  if (g_blas_bits == 32)
+    g_dsyrk32(kRowMajor, kUpper, kTrans, (int)d, (int)n, 1.0, X, (int)d, 1.0,
+              out, (int)d);
+  else
+    g_dsyrk64(kRowMajor, kUpper, kTrans, d, n, 1.0, X, d, 1.0, out, d);
+}
+
+void blas_dgemm_nt(int64_t m, int64_t n, int64_t k, const double* A,
+                   const double* B, double* C) {
+  // C(m,n) = A(m,k) @ B(n,k)^T, all row-major
+  if (g_blas_bits == 32)
+    g_dgemm32(kRowMajor, kNoTrans, kTrans, (int)m, (int)n, (int)k, 1.0, A,
+              (int)k, B, (int)k, 0.0, C, (int)n);
+  else
+    g_dgemm64(kRowMajor, kNoTrans, kTrans, m, n, k, 1.0, A, k, B, k, 0.0, C,
+              n);
+}
+
+void mirror_upper(double* out, int64_t d) {
   for (int64_t i = 0; i < d; ++i)
     for (int64_t j = 0; j < i; ++j) out[i * d + j] = out[j * d + i];
 }
 
+}  // namespace
+
+extern "C" {
+
+// Returns the int width of the adopted ABI (32/64), or < 0 on failure.
+int tpuml_set_blas(const char* path) {
+  if (g_blas_bits) return g_blas_bits;  // already bound
+  void* h = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+  if (!h) return -1;
+  auto sym = [&](const char* a, const char* b) -> void* {
+    void* p = dlsym(h, a);
+    return p ? p : dlsym(h, b);
+  };
+  g_dsyrk32 = (dsyrk32_t)sym("scipy_cblas_dsyrk", "cblas_dsyrk");
+  g_dgemm32 = (dgemm32_t)sym("scipy_cblas_dgemm", "cblas_dgemm");
+  if (g_dsyrk32 && g_dgemm32) {
+    g_blas_handle = h;
+    g_blas_bits = 32;
+    return 32;
+  }
+  g_dsyrk64 = (dsyrk64_t)sym("scipy_cblas_dsyrk64_", "cblas_dsyrk64_");
+  g_dgemm64 = (dgemm64_t)sym("scipy_cblas_dgemm64_", "cblas_dgemm64_");
+  if (g_dsyrk64 && g_dgemm64) {
+    g_blas_handle = h;
+    g_blas_bits = 64;
+    return 64;
+  }
+  dlclose(h);
+  return -2;
+}
+
+int tpuml_blas_bits() { return g_blas_bits; }
+
+// ---------------------------------------------------------------------------
+// Gram matrix: out(d,d) += X^T X for a row-major (n,d) batch.
+// BLAS dsyrk when bound (f32 widened to f64 first: the accumulation
+// contract is full f64 precision); blocked loops otherwise.
+// ---------------------------------------------------------------------------
 void tpuml_gram_f64(const double* X, int64_t n, int64_t d, double* out) {
+  if (g_blas_bits) {
+    blas_dsyrk_upper(d, n, X, out);
+    mirror_upper(out, d);
+    return;
+  }
   const int64_t RB = 256;
 #if defined(_OPENMP)
 #pragma omp parallel for schedule(dynamic)
@@ -72,8 +142,41 @@ void tpuml_gram_f64(const double* X, int64_t n, int64_t d, double* out) {
       }
     }
   }
-  for (int64_t i = 0; i < d; ++i)
-    for (int64_t j = 0; j < i; ++j) out[i * d + j] = out[j * d + i];
+  mirror_upper(out, d);
+}
+
+void tpuml_gram_f32(const float* X, int64_t n, int64_t d, double* out) {
+  if (g_blas_bits) {
+    // widen f32 -> f64 through a bounded row-block buffer (dsyrk beta=1
+    // accumulates), so peak memory stays O(block*d), not O(n*d)
+    const int64_t RB = d > 0 ? std::max<int64_t>(1, (1 << 22) / d) : 1;
+    std::vector<double> X64(RB * d);
+    for (int64_t r0 = 0; r0 < n; r0 += RB) {
+      const int64_t rows = std::min(RB, n - r0);
+      const float* src = X + r0 * d;
+      for (int64_t i = 0; i < rows * d; ++i) X64[i] = (double)src[i];
+      blas_dsyrk_upper(d, rows, X64.data(), out);
+    }
+    mirror_upper(out, d);
+    return;
+  }
+  const int64_t RB = 256;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic)
+#endif
+  for (int64_t i = 0; i < d; ++i) {
+    for (int64_t r0 = 0; r0 < n; r0 += RB) {
+      const int64_t r1 = r0 + RB < n ? r0 + RB : n;
+      for (int64_t r = r0; r < r1; ++r) {
+        const float xi = X[r * d + i];
+        if (xi == 0.0f) continue;
+        const float* row = X + r * d;
+        double* o = out + i * d;
+        for (int64_t j = i; j < d; ++j) o[j] += (double)xi * (double)row[j];
+      }
+    }
+  }
+  mirror_upper(out, d);
 }
 
 // column sums (for mean removal on the driver, like RapidsRowMatrix's
@@ -253,6 +356,21 @@ int tpuml_eig_cov(const double* cov, int64_t d, int64_t k, double scale,
 // ---------------------------------------------------------------------------
 void tpuml_gemm_transform_f32(const float* X, int64_t n, int64_t d,
                               const double* components, int64_t k, float* out) {
+  if (g_blas_bits) {
+    // bounded row-block widening, same rationale as tpuml_gram_f32
+    const int64_t RB = d > 0 ? std::max<int64_t>(1, (1 << 22) / d) : 1;
+    std::vector<double> X64(RB * d);
+    std::vector<double> out64(RB * k);
+    for (int64_t r0 = 0; r0 < n; r0 += RB) {
+      const int64_t rows = std::min(RB, n - r0);
+      const float* src = X + r0 * d;
+      for (int64_t i = 0; i < rows * d; ++i) X64[i] = (double)src[i];
+      blas_dgemm_nt(rows, k, d, X64.data(), components, out64.data());
+      float* dst = out + r0 * k;
+      for (int64_t i = 0; i < rows * k; ++i) dst[i] = (float)out64[i];
+    }
+    return;
+  }
 #if defined(_OPENMP)
 #pragma omp parallel for schedule(static)
 #endif
@@ -268,6 +386,6 @@ void tpuml_gemm_transform_f32(const float* X, int64_t n, int64_t d,
   }
 }
 
-int tpuml_version() { return 1; }
+int tpuml_version() { return 2; }
 
 }  // extern "C"

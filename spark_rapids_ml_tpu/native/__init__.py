@@ -23,6 +23,10 @@ _BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
 
 _lib: Optional[ctypes.CDLL] = None
 
+# bump together with tpuml_version() in native/src/tpuml.cpp; load() forces
+# a rebuild when the on-disk .so reports an older ABI
+_ABI_VERSION = 2
+
 
 def _lib_path() -> str:
     env = os.environ.get("TPUML_LIB")
@@ -56,8 +60,28 @@ def is_available() -> bool:
         return False
 
 
+def _candidate_blas_paths() -> list:
+    """OpenBLAS shared objects bundled inside the numpy/scipy wheels — a
+    real BLAS with zero extra dependencies (the role cuBLAS played for the
+    reference's JNI library). Scipy's lib (plain 32-bit-int cblas ABI)
+    first, then numpy's 64-bit-int build."""
+    import glob
+
+    env = os.environ.get("TPUML_BLAS_LIB")
+    if env:
+        return [env]
+    site = os.path.dirname(os.path.dirname(np.__file__))
+    out = []
+    for pkg in ("scipy", "numpy"):
+        out.extend(
+            sorted(glob.glob(os.path.join(site, f"{pkg}.libs", "libscipy_openblas*.so*")))
+        )
+    return out
+
+
 def load() -> ctypes.CDLL:
-    """Load (building on first use) and type the library."""
+    """Load (building on first use) and type the library; bind a BLAS
+    backend when one is available."""
     global _lib
     if _lib is not None:
         return _lib
@@ -65,12 +89,21 @@ def load() -> ctypes.CDLL:
     if not os.path.exists(path):
         build_native()
     lib = ctypes.CDLL(path)
+    lib.tpuml_version.restype = ctypes.c_int
+    if lib.tpuml_version() < _ABI_VERSION:
+        # stale build from an older source tree: rebuild and reload (the
+        # new file is a new inode, so dlopen maps it fresh)
+        build_native(force=True)
+        lib = ctypes.CDLL(_lib_path())
 
     dp = ctypes.POINTER(ctypes.c_double)
     fp = ctypes.POINTER(ctypes.c_float)
     i64 = ctypes.c_int64
 
     lib.tpuml_version.restype = ctypes.c_int
+    lib.tpuml_set_blas.argtypes = [ctypes.c_char_p]
+    lib.tpuml_set_blas.restype = ctypes.c_int
+    lib.tpuml_blas_bits.restype = ctypes.c_int
     lib.tpuml_gram_f32.argtypes = [fp, i64, i64, dp]
     lib.tpuml_gram_f64.argtypes = [dp, i64, i64, dp]
     lib.tpuml_colsum_f32.argtypes = [fp, i64, i64, dp]
@@ -78,8 +111,18 @@ def load() -> ctypes.CDLL:
     lib.tpuml_eig_cov.argtypes = [dp, i64, i64, ctypes.c_double, dp, dp, dp]
     lib.tpuml_eig_cov.restype = ctypes.c_int
     lib.tpuml_gemm_transform_f32.argtypes = [fp, i64, i64, dp, i64, fp]
+
+    for cand in _candidate_blas_paths():
+        if lib.tpuml_set_blas(cand.encode()) > 0:
+            break
     _lib = lib
     return lib
+
+
+def blas_bits() -> int:
+    """Int width of the bound BLAS ABI (32/64), or 0 when running on the
+    fallback blocked kernels."""
+    return int(load().tpuml_blas_bits())
 
 
 def _dptr(a: np.ndarray):
