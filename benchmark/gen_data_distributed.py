@@ -1,0 +1,254 @@
+"""Distributed-scale synthetic data generation.
+
+The reference generates benchmark datasets *in parallel executors with
+per-partition seeds* so any scale can be produced without materializing the
+dataset anywhere (``/root/reference/python/benchmark/gen_data_distributed.py``,
+1172 LoC, registry at :1164-1169). The analog here: a multiprocessing pool
+where each worker writes one parquet file, generating it row-group by
+row-group from seeds keyed by ``(seed, file_index, group_index)`` —
+
+  * output is deterministic and INDEPENDENT of the worker count;
+  * peak memory per worker is one row group (``--rows_per_group``), so a
+    100M x 256 dataset (~98 GB f32) generates with a few hundred MB of RAM;
+  * the files use the same schema ``DataFrame.write_parquet`` produces, so
+    ``DataFrame.scan_parquet`` + the streaming fit path consume them
+    directly.
+
+CLI (mirrors the reference's ``gen_data_distributed.py`` entry):
+
+  python -m benchmark.gen_data_distributed blobs \
+      --num_rows 100000000 --num_cols 256 --output_dir /data/blobs \
+      --output_num_files 50 --num_procs 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import multiprocessing as mp
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Generator = (structure, chunk) pair.
+#   structure(n_rows, n_cols, seed, **kw) -> dict      [computed once, shared]
+#   chunk(struct, count, rng)             -> (X, y|None)  [any slice, any size]
+# ---------------------------------------------------------------------------
+
+
+def _blobs_struct(n_rows: int, n_cols: int, seed: int, *, centers: int = 1000,
+                  cluster_std: float = 1.0) -> Dict[str, Any]:
+    rng = np.random.default_rng(seed)
+    return {
+        "C": (rng.normal(size=(centers, n_cols)) * 10).astype(np.float32),
+        "std": cluster_std,
+    }
+
+
+def _blobs_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
+    lab = rng.integers(0, len(s["C"]), count)
+    X = s["C"][lab] + s["std"] * rng.normal(size=(count, s["C"].shape[1]))
+    return X.astype(np.float32), lab.astype(np.float64)
+
+
+def _low_rank_struct(n_rows: int, n_cols: int, seed: int, *,
+                     effective_rank: int = 10, tail_strength: float = 0.5):
+    rng = np.random.default_rng(seed)
+    n = min(n_rows, n_cols)
+    sv = np.arange(n, dtype=np.float64) / effective_rank
+    s = (1 - tail_strength) * np.exp(-(sv**2)) + tail_strength * np.exp(-0.1 * sv)
+    V, _ = np.linalg.qr(rng.normal(size=(n_cols, n)))
+    return {"s": s, "V": V, "n": n, "n_rows": n_rows}
+
+
+def _low_rank_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
+    U = rng.normal(size=(count, s["n"])) / np.sqrt(s["n_rows"])
+    return ((U * s["s"]) @ s["V"].T).astype(np.float32), None
+
+
+def _regression_struct(n_rows: int, n_cols: int, seed: int, *,
+                       n_informative: Optional[int] = None, noise: float = 1.0,
+                       bias: float = 0.0):
+    rng = np.random.default_rng(seed)
+    n_informative = n_informative or max(1, n_cols // 10)
+    w = np.zeros((n_cols,), dtype=np.float64)
+    idx = rng.permutation(n_cols)[:n_informative]
+    w[idx] = 100.0 * rng.random(n_informative)
+    return {"w": w, "noise": noise, "bias": bias, "d": n_cols}
+
+
+def _regression_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
+    X = rng.normal(size=(count, s["d"]))
+    y = X @ s["w"] + s["bias"] + s["noise"] * rng.normal(size=count)
+    return X.astype(np.float32), y.astype(np.float64)
+
+
+def _classification_struct(n_rows: int, n_cols: int, seed: int, *,
+                           n_classes: int = 2,
+                           n_informative: Optional[int] = None,
+                           class_sep: float = 1.0):
+    rng = np.random.default_rng(seed)
+    n_informative = n_informative or max(2, n_cols // 10)
+    centers = (rng.normal(size=(n_classes, n_informative)) * 2 * class_sep).astype(
+        np.float32
+    )
+    return {"centers": centers, "ni": n_informative, "d": n_cols,
+            "k": n_classes}
+
+
+def _classification_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
+    lab = rng.integers(0, s["k"], count)
+    X = np.empty((count, s["d"]), dtype=np.float32)
+    X[:, : s["ni"]] = s["centers"][lab] + rng.normal(size=(count, s["ni"]))
+    if s["d"] > s["ni"]:
+        X[:, s["ni"]:] = rng.normal(size=(count, s["d"] - s["ni"]))
+    return X, lab.astype(np.float64)
+
+
+def _sparse_regression_struct(n_rows: int, n_cols: int, seed: int, *,
+                              density: float = 0.1, noise: float = 1.0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.normal(size=n_cols).astype(np.float64),
+        "density": density, "noise": noise, "d": n_cols,
+    }
+
+
+def _sparse_regression_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
+    # dense rows with Bernoulli sparsity: each file/group is independent,
+    # written densified exactly as DataFrame.write_parquet writes CSR
+    X = rng.normal(size=(count, s["d"])).astype(np.float32)
+    X *= rng.random(size=(count, s["d"])) < s["density"]
+    y = X @ s["w"] + s["noise"] * rng.normal(size=count)
+    return X, y.astype(np.float64)
+
+
+GENERATORS: Dict[str, Tuple[Any, Any]] = {
+    "blobs": (_blobs_struct, _blobs_chunk),
+    "low_rank_matrix": (_low_rank_struct, _low_rank_chunk),
+    "regression": (_regression_struct, _regression_chunk),
+    "classification": (_classification_struct, _classification_chunk),
+    "sparse_regression": (_sparse_regression_struct, _sparse_regression_chunk),
+}
+
+# ---------------------------------------------------------------------------
+# Parallel writer
+# ---------------------------------------------------------------------------
+
+_worker_state: Dict[str, Any] = {}
+
+
+def _init_worker(kind, struct, seed, n_cols, rows_per_group, out_dir):
+    _worker_state.update(
+        kind=kind, struct=struct, seed=seed, n_cols=n_cols,
+        rows_per_group=rows_per_group, out_dir=out_dir,
+    )
+
+
+def _write_file(task: Tuple[int, int]) -> str:
+    """Generate and write one parquet file, one bounded row group at a
+    time. Seeded by (seed, file_index, group_index): layout-independent."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    file_idx, n_file_rows = task
+    st = _worker_state
+    chunk_fn = GENERATORS[st["kind"]][1]
+    path = os.path.join(st["out_dir"], f"part-{file_idx:05d}.parquet")
+    writer = None
+    try:
+        lo = 0
+        g = 0
+        while lo < n_file_rows:
+            count = min(st["rows_per_group"], n_file_rows - lo)
+            rng = np.random.default_rng([st["seed"], file_idx, g])
+            X, y = chunk_fn(st["struct"], count, rng)
+            arrays = [
+                pa.FixedSizeListArray.from_arrays(pa.array(X.ravel()), X.shape[1])
+            ]
+            names = ["features"]
+            if y is not None:
+                arrays.append(pa.array(np.asarray(y, np.float64)))
+                names.append("label")
+            table = pa.Table.from_arrays(arrays, names=names)
+            if writer is None:
+                writer = pq.ParquetWriter(path, table.schema)
+            writer.write_table(table)
+            lo += count
+            g += 1
+    finally:
+        if writer is not None:
+            writer.close()
+    return path
+
+
+def generate(
+    kind: str,
+    n_rows: int,
+    n_cols: int,
+    output_dir: str,
+    *,
+    num_files: int = 50,
+    num_procs: Optional[int] = None,
+    rows_per_group: int = 262_144,
+    seed: int = 0,
+    **gen_kwargs: Any,
+) -> str:
+    """Generate ``n_rows x n_cols`` of ``kind`` as ``num_files`` parquet
+    files under ``output_dir``, in parallel, with bounded memory."""
+    if kind not in GENERATORS:
+        raise ValueError(f"unknown kind {kind!r}; choose from {sorted(GENERATORS)}")
+    os.makedirs(output_dir, exist_ok=True)
+    struct = GENERATORS[kind][0](n_rows, n_cols, seed, **gen_kwargs)
+
+    base = n_rows // num_files
+    rem = n_rows % num_files
+    tasks = [(i, base + (1 if i < rem else 0)) for i in range(num_files)]
+    tasks = [t for t in tasks if t[1] > 0]
+
+    init_args = (kind, struct, seed, n_cols, rows_per_group, output_dir)
+    num_procs = num_procs or min(len(tasks), os.cpu_count() or 1)
+    if num_procs <= 1:
+        _init_worker(*init_args)
+        for t in tasks:
+            _write_file(t)
+    else:
+        # spawn, not fork: the caller may be a multi-threaded JAX process
+        # (forked children can inherit held allocator locks and deadlock);
+        # workers only need numpy + pyarrow and all initargs pickle
+        ctx = mp.get_context("spawn")
+        with ctx.Pool(num_procs, initializer=_init_worker, initargs=init_args) as pool:
+            for _ in pool.imap_unordered(_write_file, tasks):
+                pass
+    return output_dir
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="Generate synthetic benchmark data at scale (parallel, "
+        "bounded memory)"
+    )
+    parser.add_argument("kind", choices=sorted(GENERATORS.keys()))
+    parser.add_argument("--num_rows", type=int, default=5000)
+    parser.add_argument("--num_cols", type=int, default=3000)
+    parser.add_argument("--output_dir", required=True)
+    parser.add_argument("--output_num_files", type=int, default=50)
+    parser.add_argument("--num_procs", type=int, default=None)
+    parser.add_argument("--rows_per_group", type=int, default=262_144)
+    parser.add_argument("--random_seed", type=int, default=0)
+    args = parser.parse_args()
+
+    generate(
+        args.kind, args.num_rows, args.num_cols, args.output_dir,
+        num_files=args.output_num_files, num_procs=args.num_procs,
+        rows_per_group=args.rows_per_group, seed=args.random_seed,
+    )
+    print(
+        f"wrote {args.num_rows}x{args.num_cols} {args.kind} -> "
+        f"{args.output_dir} ({args.output_num_files} files)"
+    )
+
+
+if __name__ == "__main__":
+    main()
