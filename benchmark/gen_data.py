@@ -2,9 +2,12 @@
 550 LoC, registry at ``gen_data_distributed.py:1164-1169``: blobs, low_rank,
 regression, classification, sparse_regression).
 
-Datasets are generated in per-partition chunks with independent seeds (the
-reference generates partitions in parallel executors with per-partition
-seeds) and written as multi-file parquet through ``DataFrame.write_parquet``.
+Each generator is a (structure, chunk) pair: the structure (centers, weight
+vectors, singular profiles) is computed once from ``seed``; chunks are
+generated from RNG streams keyed by ``(seed, file, group)``. The in-memory
+functions here materialize one "file" of groups; ``gen_data_distributed``
+maps the SAME pairs over a process pool for benchmark-scale datasets —
+one implementation, two scales.
 
 CLI: ``python -m benchmark.gen_data blobs --num_rows 100000 --num_cols 256
 --output_dir /tmp/blobs``
@@ -13,116 +16,173 @@ CLI: ``python -m benchmark.gen_data blobs --num_rows 100000 --num_cols 256
 from __future__ import annotations
 
 import argparse
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
 from spark_rapids_ml_tpu.data import DataFrame
 
 
-def _chunked(n_rows: int, chunk: int = 1_000_000):
-    lo = 0
-    while lo < n_rows:
-        yield lo, min(lo + chunk, n_rows)
-        lo = lo + chunk
-
-
-def gen_blobs(
-    n_rows: int, n_cols: int, *, centers: int = 1000, cluster_std: float = 1.0,
-    seed: int = 0,
-) -> Tuple[np.ndarray, np.ndarray]:
-    """KMeans benchmark data (reference default k=1000)."""
+def _blobs_struct(n_rows: int, n_cols: int, seed: int, *, centers: int = 1000,
+                  cluster_std: float = 1.0) -> Dict[str, Any]:
     rng = np.random.default_rng(seed)
-    C = (rng.normal(size=(centers, n_cols)) * 10).astype(np.float32)
-    X = np.empty((n_rows, n_cols), dtype=np.float32)
-    y = np.empty((n_rows,), dtype=np.int32)
-    for i, (lo, hi) in enumerate(_chunked(n_rows)):
-        r = np.random.default_rng(seed + 1 + i)
-        lab = r.integers(0, centers, hi - lo)
-        X[lo:hi] = C[lab] + cluster_std * r.normal(size=(hi - lo, n_cols))
-        y[lo:hi] = lab
-    return X, y
+    return {
+        "C": (rng.normal(size=(centers, n_cols)) * 10).astype(np.float32),
+        "std": cluster_std,
+    }
 
 
-def gen_low_rank_matrix(
-    n_rows: int, n_cols: int, *, effective_rank: int = 10, tail_strength: float = 0.5,
-    seed: int = 0,
-) -> Tuple[np.ndarray, None]:
-    """PCA benchmark data: bell-shaped singular-value profile (the sklearn
-    ``make_low_rank_matrix`` construction, computed chunk-wise)."""
+def _blobs_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
+    lab = rng.integers(0, len(s["C"]), count)
+    X = s["C"][lab] + s["std"] * rng.normal(size=(count, s["C"].shape[1]))
+    return X.astype(np.float32), lab.astype(np.float64)
+
+
+def _low_rank_struct(n_rows: int, n_cols: int, seed: int, *,
+                     effective_rank: int = 10, tail_strength: float = 0.5):
     rng = np.random.default_rng(seed)
     n = min(n_rows, n_cols)
     sv = np.arange(n, dtype=np.float64) / effective_rank
-    low_rank = (1 - tail_strength) * np.exp(-(sv**2))
-    tail = tail_strength * np.exp(-0.1 * sv)
-    s = low_rank + tail
+    s = (1 - tail_strength) * np.exp(-(sv**2)) + tail_strength * np.exp(-0.1 * sv)
     V, _ = np.linalg.qr(rng.normal(size=(n_cols, n)))
-    X = np.empty((n_rows, n_cols), dtype=np.float32)
-    for i, (lo, hi) in enumerate(_chunked(n_rows)):
-        r = np.random.default_rng(seed + 1 + i)
-        U = r.normal(size=(hi - lo, n)) / np.sqrt(n_rows)
-        X[lo:hi] = (U * s) @ V.T
-    return X, None
+    return {"s": s, "V": V, "n": n, "n_rows": n_rows}
 
 
-def gen_regression(
-    n_rows: int, n_cols: int, *, n_informative: Optional[int] = None,
-    noise: float = 1.0, bias: float = 0.0, seed: int = 0,
-) -> Tuple[np.ndarray, np.ndarray]:
+def _low_rank_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
+    U = rng.normal(size=(count, s["n"])) / np.sqrt(s["n_rows"])
+    return ((U * s["s"]) @ s["V"].T).astype(np.float32), None
+
+
+def _regression_struct(n_rows: int, n_cols: int, seed: int, *,
+                       n_informative: Optional[int] = None, noise: float = 1.0,
+                       bias: float = 0.0):
     rng = np.random.default_rng(seed)
     n_informative = n_informative or max(1, n_cols // 10)
     w = np.zeros((n_cols,), dtype=np.float64)
     idx = rng.permutation(n_cols)[:n_informative]
     w[idx] = 100.0 * rng.random(n_informative)
-    X = np.empty((n_rows, n_cols), dtype=np.float32)
-    y = np.empty((n_rows,), dtype=np.float32)
-    for i, (lo, hi) in enumerate(_chunked(n_rows)):
-        r = np.random.default_rng(seed + 1 + i)
-        Xc = r.normal(size=(hi - lo, n_cols))
-        X[lo:hi] = Xc
-        y[lo:hi] = Xc @ w + bias + noise * r.normal(size=hi - lo)
-    return X, y
+    return {"w": w, "noise": noise, "bias": bias, "d": n_cols}
 
 
-def gen_classification(
-    n_rows: int, n_cols: int, *, n_classes: int = 2,
-    n_informative: Optional[int] = None, class_sep: float = 1.0, seed: int = 0,
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Gaussian class clusters on informative dims + noise dims (the shape
-    sklearn's make_classification produces; chunk-parallel construction)."""
+def _regression_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
+    X = rng.normal(size=(count, s["d"]))
+    y = X @ s["w"] + s["bias"] + s["noise"] * rng.normal(size=count)
+    return X.astype(np.float32), y.astype(np.float64)
+
+
+def _classification_struct(n_rows: int, n_cols: int, seed: int, *,
+                           n_classes: int = 2,
+                           n_informative: Optional[int] = None,
+                           class_sep: float = 1.0):
     rng = np.random.default_rng(seed)
     n_informative = n_informative or max(2, n_cols // 10)
     centers = (rng.normal(size=(n_classes, n_informative)) * 2 * class_sep).astype(
         np.float32
     )
-    X = np.empty((n_rows, n_cols), dtype=np.float32)
-    y = np.empty((n_rows,), dtype=np.float32)
-    for i, (lo, hi) in enumerate(_chunked(n_rows)):
-        r = np.random.default_rng(seed + 1 + i)
-        lab = r.integers(0, n_classes, hi - lo)
-        X[lo:hi, :n_informative] = centers[lab] + r.normal(
-            size=(hi - lo, n_informative)
-        )
-        if n_cols > n_informative:
-            X[lo:hi, n_informative:] = r.normal(size=(hi - lo, n_cols - n_informative))
-        y[lo:hi] = lab
+    return {"centers": centers, "ni": n_informative, "d": n_cols,
+            "k": n_classes}
+
+
+def _classification_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
+    lab = rng.integers(0, s["k"], count)
+    X = np.empty((count, s["d"]), dtype=np.float32)
+    X[:, : s["ni"]] = s["centers"][lab] + rng.normal(size=(count, s["ni"]))
+    if s["d"] > s["ni"]:
+        X[:, s["ni"]:] = rng.normal(size=(count, s["d"] - s["ni"]))
+    return X, lab.astype(np.float64)
+
+
+def _sparse_regression_struct(n_rows: int, n_cols: int, seed: int, *,
+                              density: float = 0.1, noise: float = 1.0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.normal(size=n_cols).astype(np.float64),
+        "density": density, "noise": noise, "d": n_cols,
+    }
+
+
+def _sparse_regression_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
+    # dense rows with Bernoulli sparsity: each file/group is independent,
+    # written densified exactly as DataFrame.write_parquet writes CSR
+    X = rng.normal(size=(count, s["d"])).astype(np.float32)
+    X *= rng.random(size=(count, s["d"])) < s["density"]
+    y = X @ s["w"] + s["noise"] * rng.normal(size=count)
+    return X, y.astype(np.float64)
+
+
+GENERATOR_PAIRS: Dict[str, Tuple[Any, Any]] = {
+    "blobs": (_blobs_struct, _blobs_chunk),
+    "low_rank_matrix": (_low_rank_struct, _low_rank_chunk),
+    "regression": (_regression_struct, _regression_chunk),
+    "classification": (_classification_struct, _classification_chunk),
+    "sparse_regression": (_sparse_regression_struct, _sparse_regression_chunk),
+}
+
+_CHUNK_ROWS = 1_000_000
+
+
+def _assemble(kind: str, n_rows: int, n_cols: int, seed: int, **kw):
+    """Materialize in memory as file 0 of the distributed layout (identical
+    bytes to ``gen_data_distributed.generate(..., num_files=1,
+    rows_per_group=1_000_000)``)."""
+    struct_fn, chunk_fn = GENERATOR_PAIRS[kind]
+    struct = struct_fn(n_rows, n_cols, seed, **kw)
+    Xs, ys = [], []
+    g = 0
+    lo = 0
+    while lo < n_rows:
+        count = min(_CHUNK_ROWS, n_rows - lo)
+        rng = np.random.default_rng([seed, 0, g])
+        X, y = chunk_fn(struct, count, rng)
+        Xs.append(X)
+        if y is not None:
+            ys.append(y)
+        lo += count
+        g += 1
+    X = np.concatenate(Xs) if len(Xs) > 1 else Xs[0]
+    y = (np.concatenate(ys) if len(ys) > 1 else ys[0]) if ys else None
     return X, y
 
 
-def gen_sparse_regression(
-    n_rows: int, n_cols: int, *, density: float = 0.1, noise: float = 1.0,
-    seed: int = 0,
-):
+def gen_blobs(n_rows: int, n_cols: int, *, centers: int = 1000,
+              cluster_std: float = 1.0, seed: int = 0):
+    """KMeans benchmark data (reference default k=1000)."""
+    return _assemble("blobs", n_rows, n_cols, seed,
+                     centers=centers, cluster_std=cluster_std)
+
+
+def gen_low_rank_matrix(n_rows: int, n_cols: int, *, effective_rank: int = 10,
+                        tail_strength: float = 0.5, seed: int = 0):
+    """PCA benchmark data: bell-shaped singular-value profile (the sklearn
+    ``make_low_rank_matrix`` construction, computed chunk-wise)."""
+    return _assemble("low_rank_matrix", n_rows, n_cols, seed,
+                     effective_rank=effective_rank, tail_strength=tail_strength)
+
+
+def gen_regression(n_rows: int, n_cols: int, *,
+                   n_informative: Optional[int] = None, noise: float = 1.0,
+                   bias: float = 0.0, seed: int = 0):
+    return _assemble("regression", n_rows, n_cols, seed,
+                     n_informative=n_informative, noise=noise, bias=bias)
+
+
+def gen_classification(n_rows: int, n_cols: int, *, n_classes: int = 2,
+                       n_informative: Optional[int] = None,
+                       class_sep: float = 1.0, seed: int = 0):
+    """Gaussian class clusters on informative dims + noise dims (the shape
+    sklearn's make_classification produces; chunk-parallel construction)."""
+    return _assemble("classification", n_rows, n_cols, seed,
+                     n_classes=n_classes, n_informative=n_informative,
+                     class_sep=class_sep)
+
+
+def gen_sparse_regression(n_rows: int, n_cols: int, *, density: float = 0.1,
+                          noise: float = 1.0, seed: int = 0):
     import scipy.sparse as sp
 
-    rng = np.random.default_rng(seed)
-    X = sp.random(
-        n_rows, n_cols, density=density, format="csr", dtype=np.float32,
-        random_state=np.random.RandomState(seed),
-    )
-    w = rng.normal(size=n_cols).astype(np.float32)
-    y = np.asarray(X @ w).ravel() + noise * rng.normal(size=n_rows).astype(np.float32)
-    return X, y
+    X, y = _assemble("sparse_regression", n_rows, n_cols, seed,
+                     density=density, noise=noise)
+    return sp.csr_matrix(X), y
 
 
 GENERATORS: Dict[str, Dict] = {

@@ -30,107 +30,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-# ---------------------------------------------------------------------------
-# Generator = (structure, chunk) pair.
-#   structure(n_rows, n_cols, seed, **kw) -> dict      [computed once, shared]
-#   chunk(struct, count, rng)             -> (X, y|None)  [any slice, any size]
-# ---------------------------------------------------------------------------
-
-
-def _blobs_struct(n_rows: int, n_cols: int, seed: int, *, centers: int = 1000,
-                  cluster_std: float = 1.0) -> Dict[str, Any]:
-    rng = np.random.default_rng(seed)
-    return {
-        "C": (rng.normal(size=(centers, n_cols)) * 10).astype(np.float32),
-        "std": cluster_std,
-    }
-
-
-def _blobs_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
-    lab = rng.integers(0, len(s["C"]), count)
-    X = s["C"][lab] + s["std"] * rng.normal(size=(count, s["C"].shape[1]))
-    return X.astype(np.float32), lab.astype(np.float64)
-
-
-def _low_rank_struct(n_rows: int, n_cols: int, seed: int, *,
-                     effective_rank: int = 10, tail_strength: float = 0.5):
-    rng = np.random.default_rng(seed)
-    n = min(n_rows, n_cols)
-    sv = np.arange(n, dtype=np.float64) / effective_rank
-    s = (1 - tail_strength) * np.exp(-(sv**2)) + tail_strength * np.exp(-0.1 * sv)
-    V, _ = np.linalg.qr(rng.normal(size=(n_cols, n)))
-    return {"s": s, "V": V, "n": n, "n_rows": n_rows}
-
-
-def _low_rank_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
-    U = rng.normal(size=(count, s["n"])) / np.sqrt(s["n_rows"])
-    return ((U * s["s"]) @ s["V"].T).astype(np.float32), None
-
-
-def _regression_struct(n_rows: int, n_cols: int, seed: int, *,
-                       n_informative: Optional[int] = None, noise: float = 1.0,
-                       bias: float = 0.0):
-    rng = np.random.default_rng(seed)
-    n_informative = n_informative or max(1, n_cols // 10)
-    w = np.zeros((n_cols,), dtype=np.float64)
-    idx = rng.permutation(n_cols)[:n_informative]
-    w[idx] = 100.0 * rng.random(n_informative)
-    return {"w": w, "noise": noise, "bias": bias, "d": n_cols}
-
-
-def _regression_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
-    X = rng.normal(size=(count, s["d"]))
-    y = X @ s["w"] + s["bias"] + s["noise"] * rng.normal(size=count)
-    return X.astype(np.float32), y.astype(np.float64)
-
-
-def _classification_struct(n_rows: int, n_cols: int, seed: int, *,
-                           n_classes: int = 2,
-                           n_informative: Optional[int] = None,
-                           class_sep: float = 1.0):
-    rng = np.random.default_rng(seed)
-    n_informative = n_informative or max(2, n_cols // 10)
-    centers = (rng.normal(size=(n_classes, n_informative)) * 2 * class_sep).astype(
-        np.float32
-    )
-    return {"centers": centers, "ni": n_informative, "d": n_cols,
-            "k": n_classes}
-
-
-def _classification_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
-    lab = rng.integers(0, s["k"], count)
-    X = np.empty((count, s["d"]), dtype=np.float32)
-    X[:, : s["ni"]] = s["centers"][lab] + rng.normal(size=(count, s["ni"]))
-    if s["d"] > s["ni"]:
-        X[:, s["ni"]:] = rng.normal(size=(count, s["d"] - s["ni"]))
-    return X, lab.astype(np.float64)
-
-
-def _sparse_regression_struct(n_rows: int, n_cols: int, seed: int, *,
-                              density: float = 0.1, noise: float = 1.0):
-    rng = np.random.default_rng(seed)
-    return {
-        "w": rng.normal(size=n_cols).astype(np.float64),
-        "density": density, "noise": noise, "d": n_cols,
-    }
-
-
-def _sparse_regression_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
-    # dense rows with Bernoulli sparsity: each file/group is independent,
-    # written densified exactly as DataFrame.write_parquet writes CSR
-    X = rng.normal(size=(count, s["d"])).astype(np.float32)
-    X *= rng.random(size=(count, s["d"])) < s["density"]
-    y = X @ s["w"] + s["noise"] * rng.normal(size=count)
-    return X, y.astype(np.float64)
-
-
-GENERATORS: Dict[str, Tuple[Any, Any]] = {
-    "blobs": (_blobs_struct, _blobs_chunk),
-    "low_rank_matrix": (_low_rank_struct, _low_rank_chunk),
-    "regression": (_regression_struct, _regression_chunk),
-    "classification": (_classification_struct, _classification_chunk),
-    "sparse_regression": (_sparse_regression_struct, _sparse_regression_chunk),
-}
+from .gen_data import GENERATOR_PAIRS as GENERATORS
 
 # ---------------------------------------------------------------------------
 # Parallel writer
@@ -139,16 +39,20 @@ GENERATORS: Dict[str, Tuple[Any, Any]] = {
 _worker_state: Dict[str, Any] = {}
 
 
-def _init_worker(kind, struct, seed, n_cols, rows_per_group, out_dir):
+def _init_worker(kind, struct, seed, rows_per_group, out_dir):
     _worker_state.update(
-        kind=kind, struct=struct, seed=seed, n_cols=n_cols,
+        kind=kind, struct=struct, seed=seed,
         rows_per_group=rows_per_group, out_dir=out_dir,
     )
 
 
 def _write_file(task: Tuple[int, int]) -> str:
     """Generate and write one parquet file, one bounded row group at a
-    time. Seeded by (seed, file_index, group_index): layout-independent."""
+    time. RNG streams are keyed by (seed, file_index, group_index), so the
+    output is independent of the WORKER COUNT — but it does depend on the
+    file/row-group layout: regenerating with a different
+    ``--output_num_files`` or ``--rows_per_group`` produces a different
+    (same-distribution) dataset."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
@@ -200,6 +104,12 @@ def generate(
     if kind not in GENERATORS:
         raise ValueError(f"unknown kind {kind!r}; choose from {sorted(GENERATORS)}")
     os.makedirs(output_dir, exist_ok=True)
+    # a prior run's files would otherwise silently merge into the dataset
+    # (readers glob every *.parquet in the directory)
+    import glob as _glob
+
+    for stale in _glob.glob(os.path.join(output_dir, "part-*.parquet")):
+        os.remove(stale)
     struct = GENERATORS[kind][0](n_rows, n_cols, seed, **gen_kwargs)
 
     base = n_rows // num_files
@@ -207,7 +117,7 @@ def generate(
     tasks = [(i, base + (1 if i < rem else 0)) for i in range(num_files)]
     tasks = [t for t in tasks if t[1] > 0]
 
-    init_args = (kind, struct, seed, n_cols, rows_per_group, output_dir)
+    init_args = (kind, struct, seed, rows_per_group, output_dir)
     num_procs = num_procs or min(len(tasks), os.cpu_count() or 1)
     if num_procs <= 1:
         _init_worker(*init_args)

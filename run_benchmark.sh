@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Reference-style benchmark wrapper (the analog of
+# /root/reference/python/run_benchmark.sh and
+# databricks/run_benchmark.sh:44-135): run every workload through
+# benchmark_runner.py at a configurable scale.
+#
+#   ./run_benchmark.sh [cpu|tpu] [num_rows] [num_cols] [report.csv]
+#
+# Defaults mirror the reference's local smoke scale (5000 x 3000,
+# run_benchmark.sh:66-68); the full methodology scale is 1M x 3000.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+PLATFORM="${1:-cpu}"
+NUM_ROWS="${2:-5000}"
+NUM_COLS="${3:-3000}"
+REPORT="${4:-}"
+
+REPORT_ARGS=()
+if [ -n "$REPORT" ]; then
+    REPORT_ARGS=(--report_path "$REPORT")
+fi
+
+run() {
+    echo "== $1 =="
+    shift
+    python benchmark_runner.py "$@" "${REPORT_ARGS[@]}"
+}
+
+COMMON=(--platform "$PLATFORM" --num_rows "$NUM_ROWS" --num_cols "$NUM_COLS")
+
+# workload configs follow the reference methodology
+# (databricks/run_benchmark.sh:44-135)
+run kmeans   kmeans   "${COMMON[@]}" --k 1000 --max_iter 30 --tol 1e-20 --init random
+run pca      pca      "${COMMON[@]}" --k 3
+run linreg   linear_regression "${COMMON[@]}"
+run linreg-elastic linear_regression "${COMMON[@]}" --regParam 0.00001 --elasticNetParam 0.5
+run linreg-ridge   linear_regression "${COMMON[@]}" --regParam 0.00001
+run rf-cls   random_forest_classifier "${COMMON[@]}" --numTrees 50 --maxDepth 13 --maxBins 128
+run rf-reg   random_forest_regressor  "${COMMON[@]}" --numTrees 30 --maxDepth 6 --maxBins 128
+run logreg   logistic_regression "${COMMON[@]}" --maxIter 200 --tol 1e-30 --regParam 0.00001

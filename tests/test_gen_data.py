@@ -87,11 +87,11 @@ def test_chunked_generation_deterministic():
     "algo,extra",
     [
         ("pca", ["--k", "3"]),
-        ("kmeans", ["--k", "8", "--max_iter", "5"]),
-        ("linear_regression", []),
         ("logistic_regression", ["--maxIter", "20"]),
-        ("random_forest_classifier", ["--numTrees", "4", "--maxDepth", "4"]),
-        ("knn", ["--k", "5", "--num_queries", "50"]),
+        pytest.param("kmeans", ["--k", "8", "--max_iter", "5"], marks=pytest.mark.slow),
+        pytest.param("linear_regression", [], marks=pytest.mark.slow),
+        pytest.param("random_forest_classifier", ["--numTrees", "4", "--maxDepth", "4"], marks=pytest.mark.slow),
+        pytest.param("knn", ["--k", "5", "--num_queries", "50"], marks=pytest.mark.slow),
     ],
 )
 def test_benchmark_runner_smoke(algo, extra, tmp_path):
@@ -125,10 +125,10 @@ def test_gen_distributed_deterministic_across_worker_counts(tmp_path):
     size (the reference's per-partition-seed invariant)."""
     from benchmark.gen_data_distributed import generate
 
-    a = generate("blobs", 5000, 8, str(tmp_path / "a"), num_files=7,
+    a = generate("blobs", 2500, 8, str(tmp_path / "a"), num_files=7,
                  num_procs=1, rows_per_group=512, seed=3, centers=5)
-    b = generate("blobs", 5000, 8, str(tmp_path / "b"), num_files=7,
-                 num_procs=4, rows_per_group=512, seed=3, centers=5)
+    b = generate("blobs", 2500, 8, str(tmp_path / "b"), num_files=7,
+                 num_procs=2, rows_per_group=512, seed=3, centers=5)
     from spark_rapids_ml_tpu.data import DataFrame
 
     da = DataFrame.read_parquet(a)

@@ -57,6 +57,8 @@ def test_rfc_toy_separable():
 
 @pytest.mark.compat
 def test_rfc_matches_sklearn_accuracy(n_workers):
+    if n_workers == 2:
+        pytest.skip("covered by 1/4-worker runs; padding invariance tested separately")
     X, y = _blobs(n=900, d=10, k=3, spread=1.5)
     n_train = 700
     df = DataFrame({"features": X[:n_train], "label": y[:n_train]})
@@ -197,6 +199,8 @@ def test_rfr_toy_step_function():
 
 @pytest.mark.compat
 def test_rfr_matches_sklearn_r2(n_workers):
+    if n_workers == 2:
+        pytest.skip("covered by 1/4-worker runs; padding invariance tested separately")
     X, y = _regression_data(n=1000, d=6)
     n_train = 800
     df = DataFrame({"features": X[:n_train], "label": y[:n_train]})
@@ -270,19 +274,19 @@ def test_rf_cross_validator_single_pass():
     from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
     from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
 
-    X, y = _blobs(n=400, d=5, k=2, spread=2.0)
+    X, y = _blobs(n=300, d=5, k=2, spread=2.0)
     df = DataFrame({"features": X, "label": y})
     est = RandomForestClassifier(seed=1, num_workers=1)
     eva = MulticlassClassificationEvaluator(metricName="accuracy")
     assert est._supportsTransformEvaluate(eva)
     grid = (
         ParamGridBuilder()
-        .addGrid(est.getParam("maxDepth"), [2, 6])
+        .addGrid(est.getParam("maxDepth"), [2, 4])
         .addGrid(est.getParam("numTrees"), [5])
         .build()
     )
     cv_model = CrossValidator(
-        estimator=est, estimatorParamMaps=grid, evaluator=eva, numFolds=3, seed=2
+        estimator=est, estimatorParamMaps=grid, evaluator=eva, numFolds=2, seed=2
     ).fit(df)
     assert len(cv_model.avgMetrics) == 2
     assert max(cv_model.avgMetrics) > 0.7
