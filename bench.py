@@ -316,11 +316,18 @@ def main() -> None:
         "logreg": lambda: bench_logreg(X, mask, y, mesh, n_chips),
         "pca_stream": lambda: bench_pca_stream(mesh, n_chips),
     }
+    from spark_rapids_ml_tpu.utils.profiling import trace
+
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
     results = {}
     for name, fn in runs.items():
         for attempt in (0, 1):
             try:
-                res = fn()
+                # per-algo TensorBoard profile capture when requested
+                with trace(
+                    os.path.join(profile_dir, name) if profile_dir else None
+                ):
+                    res = fn()
                 res["mfu"] = res["flops_model"] / (
                     res["fit_seconds"] * peak * n_chips
                 )

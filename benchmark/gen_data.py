@@ -102,11 +102,19 @@ def _sparse_regression_struct(n_rows: int, n_cols: int, seed: int, *,
 
 
 def _sparse_regression_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
-    # dense rows with Bernoulli sparsity: each file/group is independent,
-    # written densified exactly as DataFrame.write_parquet writes CSR
-    X = rng.normal(size=(count, s["d"])).astype(np.float32)
-    X *= rng.random(size=(count, s["d"])) < s["density"]
-    y = X @ s["w"] + s["noise"] * rng.normal(size=count)
+    """Returns a scipy CSR chunk — memory is O(nnz), never O(count*d).
+    Sparsity pattern: ~density*count*d positions sampled with replacement
+    and deduplicated (shortfall ~nnz²/2/(count·d), negligible)."""
+    import scipy.sparse as sp
+
+    d = s["d"]
+    total = count * d
+    nnz = int(rng.binomial(total, s["density"])) if total else 0
+    flat = np.unique(rng.integers(0, total, size=nnz)) if nnz else np.empty(0, np.int64)
+    rows, cols = np.divmod(flat, d)
+    vals = rng.normal(size=flat.size).astype(np.float32)
+    X = sp.csr_matrix((vals, (rows, cols)), shape=(count, d))
+    y = np.asarray(X @ s["w"]).ravel() + s["noise"] * rng.normal(size=count)
     return X, y.astype(np.float64)
 
 
@@ -124,24 +132,39 @@ _CHUNK_ROWS = 1_000_000
 def _assemble(kind: str, n_rows: int, n_cols: int, seed: int, **kw):
     """Materialize in memory as file 0 of the distributed layout (identical
     bytes to ``gen_data_distributed.generate(..., num_files=1,
-    rows_per_group=1_000_000)``)."""
+    rows_per_group=1_000_000)``). Dense output is written into ONE
+    preallocated buffer (no concatenate doubling); sparse chunks stack as
+    CSR (O(nnz))."""
+    import scipy.sparse as sp
+
     struct_fn, chunk_fn = GENERATOR_PAIRS[kind]
     struct = struct_fn(n_rows, n_cols, seed, **kw)
-    Xs, ys = [], []
+    X_out = None
+    y_out = None
+    sparse_chunks = []
     g = 0
     lo = 0
     while lo < n_rows:
         count = min(_CHUNK_ROWS, n_rows - lo)
         rng = np.random.default_rng([seed, 0, g])
         X, y = chunk_fn(struct, count, rng)
-        Xs.append(X)
+        if sp.issparse(X):
+            sparse_chunks.append(X)
+        else:
+            if X_out is None:
+                X_out = np.empty((n_rows, n_cols), X.dtype)
+            X_out[lo : lo + count] = X
         if y is not None:
-            ys.append(y)
+            if y_out is None:
+                y_out = np.empty((n_rows,), y.dtype)
+            y_out[lo : lo + count] = y
         lo += count
         g += 1
-    X = np.concatenate(Xs) if len(Xs) > 1 else Xs[0]
-    y = (np.concatenate(ys) if len(ys) > 1 else ys[0]) if ys else None
-    return X, y
+    if sparse_chunks:
+        X_out = sparse_chunks[0] if len(sparse_chunks) == 1 else sp.vstack(
+            sparse_chunks, format="csr"
+        )
+    return X_out, y_out
 
 
 def gen_blobs(n_rows: int, n_cols: int, *, centers: int = 1000,
@@ -178,11 +201,8 @@ def gen_classification(n_rows: int, n_cols: int, *, n_classes: int = 2,
 
 def gen_sparse_regression(n_rows: int, n_cols: int, *, density: float = 0.1,
                           noise: float = 1.0, seed: int = 0):
-    import scipy.sparse as sp
-
-    X, y = _assemble("sparse_regression", n_rows, n_cols, seed,
+    return _assemble("sparse_regression", n_rows, n_cols, seed,
                      density=density, noise=noise)
-    return sp.csr_matrix(X), y
 
 
 GENERATORS: Dict[str, Dict] = {

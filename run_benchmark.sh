@@ -24,7 +24,7 @@ fi
 run() {
     echo "== $1 =="
     shift
-    python benchmark_runner.py "$@" "${REPORT_ARGS[@]}"
+    python benchmark_runner.py "$@" ${REPORT_ARGS[@]+"${REPORT_ARGS[@]}"}
 }
 
 COMMON=(--platform "$PLATFORM" --num_rows "$NUM_ROWS" --num_cols "$NUM_COLS")

@@ -429,15 +429,22 @@ class _TpuEstimator(Params, _TpuParams):
     def _fit_internal_x64scoped(
         self, dataset: DataFrame, paramMaps: Optional[List[Dict[Any, Any]]]
     ) -> List["_TpuModel"]:
+        # phase annotations land as named ranges on the profiler timeline
+        # (the reference's NVTX ranges, ``RapidsRowMatrix.scala:62,70``)
+        from .utils.profiling import annotate, timed
+
+        cls_name = type(self).__name__
         stream_func = self._get_tpu_streaming_fit_func(dataset)
         if stream_func is not None and self._should_stream(dataset):
             self.logger.info(
                 "Streaming fit engaged (out-of-core chunked ingestion)."
             )
-            inputs: Any = self._pre_process_stream(dataset)
+            with annotate(f"{cls_name}.preprocess"), timed(self.logger, "preprocess"):
+                inputs: Any = self._pre_process_stream(dataset)
             fit_func: Any = stream_func
         else:
-            inputs = self._pre_process_data(dataset)
+            with annotate(f"{cls_name}.preprocess"), timed(self.logger, "preprocess"):
+                inputs = self._pre_process_data(dataset)
             fit_func = self._get_tpu_fit_func(dataset)
         models: List[_TpuModel] = []
         param_sets: List[Dict[str, Any]]
@@ -455,7 +462,8 @@ class _TpuEstimator(Params, _TpuParams):
                 estimators.append(est)
                 param_sets.append(dict(est._tpu_params))
         for est, ps in zip(estimators, param_sets):
-            result = fit_func(inputs, ps)
+            with annotate(f"{cls_name}.fit"), timed(self.logger, "fit"):
+                result = fit_func(inputs, ps)
             model = est._create_model(result)
             est._copyValues(model)
             est._copy_tpu_params(model)
@@ -550,10 +558,15 @@ class _TpuModel(Params, _TpuParams):
         Embarrassingly parallel: rows are processed in device-sized batches;
         no collectives (matching the reference, which builds no communicator
         for transform)."""
+        from .utils.profiling import annotate, timed
+
         X = self._extract_features_for_transform(dataset)
         with _x64_ctx(X.dtype):
             fn = self._get_tpu_transform_func(dataset)
-            out_columns = self._apply_batched(fn, X)
+            with annotate(f"{type(self).__name__}.transform"), timed(
+                self.logger, "transform"
+            ):
+                out_columns = self._apply_batched(fn, X)
         out = dataset
         for name, col in out_columns.items():
             out = out.withColumn(name, col)

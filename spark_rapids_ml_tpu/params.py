@@ -570,6 +570,18 @@ class _TpuParams(_TpuClass):
             if name == "stream_chunk_rows":
                 self._stream_chunk_rows = None if value is None else int(value)
                 continue
+            if name == "verbose":
+                # framework kwarg like the reference's cuML verbosity
+                # forwarding (``core.py:385-408``): raise/lower this
+                # class's logger level (debug = phase timings etc.)
+                import logging as _logging
+
+                if value is not None:
+                    get_logger(
+                        type(self),
+                        _logging.DEBUG if value else _logging.INFO,
+                    )
+                continue
             if self.hasParam(name):
                 self._set(**{name: value})
                 if name in mapping:

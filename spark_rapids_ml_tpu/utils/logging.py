@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import logging
 import sys
-from typing import Any, Union
+from typing import Any, Optional, Union
 
 _FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 _configured = False
@@ -23,7 +23,13 @@ def _ensure_configured() -> None:
         _configured = True
 
 
-def get_logger(cls: Union[type, str, Any], level: int = logging.INFO) -> logging.Logger:
+def get_logger(
+    cls: Union[type, str, Any], level: Optional[int] = None
+) -> logging.Logger:
+    """Per-class logger. ``level`` is only applied when explicitly given —
+    a bare ``get_logger`` must never reset a level the user raised (e.g.
+    the ``verbose=True`` framework kwarg); unset loggers inherit INFO from
+    the package root."""
     _ensure_configured()
     if isinstance(cls, str):
         name = cls
@@ -32,5 +38,6 @@ def get_logger(cls: Union[type, str, Any], level: int = logging.INFO) -> logging
     else:
         name = type(cls).__name__
     logger = logging.getLogger(f"spark_rapids_ml_tpu.{name}")
-    logger.setLevel(level)
+    if level is not None:
+        logger.setLevel(level)
     return logger

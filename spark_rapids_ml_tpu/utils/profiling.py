@@ -1,0 +1,55 @@
+"""Tracing / profiling — the NVTX-range analog.
+
+The reference wraps its phases in NVTX ranges so nsys can attribute time
+(``/root/reference/jvm/src/main/scala/org/apache/spark/ml/linalg/distributed/RapidsRowMatrix.scala:62,70``)
+and the Python benchmarks do phase wall-clock timing
+(``python/benchmark/benchmark/utils.py:42``). The TPU-native equivalents:
+
+* :func:`annotate` — a ``jax.profiler.TraceAnnotation`` scope; shows up as
+  a named range on the TensorBoard trace timeline (and is a no-op when no
+  trace is being captured).
+* :func:`trace` — capture a TensorBoard profile of a code region into a
+  directory (``tensorboard --logdir <dir>`` → Profile tab). Used by
+  ``bench.py`` when ``BENCH_PROFILE_DIR`` is set.
+* :func:`timed` — phase wall-clock logging at debug level, the benchmark
+  harness's ``with_benchmark`` analog for library internals.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+def annotate(name: str):
+    """Named range on the profiler timeline (no-op outside a capture)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a TensorBoard profile of the region when ``log_dir`` is
+    set; transparent otherwise."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def timed(logger, phase: str) -> Iterator[None]:
+    """Debug-level phase timing (device work is NOT synchronized — pair
+    with ``block_until_ready`` at the call site when exact numbers
+    matter)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        logger.debug("%s took %.4fs", phase, time.perf_counter() - t0)

@@ -58,7 +58,7 @@ def test_rfc_toy_separable():
 @pytest.mark.compat
 def test_rfc_matches_sklearn_accuracy(n_workers):
     if n_workers == 2:
-        pytest.skip("covered by 1/4-worker runs; padding invariance tested separately")
+        pytest.skip("covered by 1/4-worker runs and test_rfc_padding_workers")
     X, y = _blobs(n=900, d=10, k=3, spread=1.5)
     n_train = 700
     df = DataFrame({"features": X[:n_train], "label": y[:n_train]})
@@ -115,6 +115,16 @@ def test_rfc_feature_importances_identify_signal():
     assert imp.shape == (6,)
     np.testing.assert_allclose(imp.sum(), 1.0, atol=1e-6)
     assert np.argmax(imp) == 2 and imp[2] > 0.8
+
+
+def test_rfc_padding_workers():
+    """Row counts not divisible by the worker count exercise the pad/mask
+    path of the per-worker tree builder; quality must not degrade."""
+    X, y = _blobs(n=151, d=5, k=2, spread=0.5)  # 151 % 2 == 1
+    df = DataFrame({"features": X, "label": y})
+    m = RandomForestClassifier(numTrees=4, maxDepth=4, seed=3, num_workers=2).fit(df)
+    acc = (m.transform(df)["prediction"] == y).mean()
+    assert acc > 0.95
 
 
 def test_rfc_labels_must_be_integers():
@@ -200,7 +210,7 @@ def test_rfr_toy_step_function():
 @pytest.mark.compat
 def test_rfr_matches_sklearn_r2(n_workers):
     if n_workers == 2:
-        pytest.skip("covered by 1/4-worker runs; padding invariance tested separately")
+        pytest.skip("covered by 1/4-worker runs and test_rfc_padding_workers")
     X, y = _regression_data(n=1000, d=6)
     n_train = 800
     df = DataFrame({"features": X[:n_train], "label": y[:n_train]})
