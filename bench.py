@@ -369,6 +369,9 @@ def main() -> None:
         "vs_baseline_geomean": round(geomean_vs, 3),
         "device": getattr(devices[0], "device_kind", "cpu"),
         "tpu_unreachable": not tpu_ok,
+        # timings taken inside an active trace carry profiler overhead —
+        # not comparable with unprofiled runs
+        "profiled": bool(profile_dir),
         "n_chips": n_chips,
         "n_rows": N_ROWS,
         "n_cols": N_COLS,

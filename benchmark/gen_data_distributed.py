@@ -68,15 +68,12 @@ def _write_file(task: Tuple[int, int]) -> str:
             count = min(st["rows_per_group"], n_file_rows - lo)
             rng = np.random.default_rng([st["seed"], file_idx, g])
             X, y = chunk_fn(st["struct"], count, rng)
-            try:
-                import scipy.sparse as sp
+            import scipy.sparse as sp
 
-                if sp.issparse(X):
-                    # densified on disk, one bounded group at a time —
-                    # exactly how DataFrame.write_parquet stores CSR
-                    X = np.asarray(X.todense(), np.float32)
-            except ImportError:  # pragma: no cover
-                pass
+            if sp.issparse(X):
+                # densified on disk, one bounded group at a time —
+                # exactly how DataFrame.write_parquet stores CSR
+                X = X.toarray()
             arrays = [
                 pa.FixedSizeListArray.from_arrays(pa.array(X.ravel()), X.shape[1])
             ]

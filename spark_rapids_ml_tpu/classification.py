@@ -3,10 +3,13 @@
 
 from .models.classification import LogisticRegression, LogisticRegressionModel
 from .models.tree import RandomForestClassificationModel, RandomForestClassifier
+from .pipeline import OneVsRest, OneVsRestModel  # pyspark.ml.classification layout
 
 __all__ = [
     "LogisticRegression",
     "LogisticRegressionModel",
+    "OneVsRest",
+    "OneVsRestModel",
     "RandomForestClassifier",
     "RandomForestClassificationModel",
 ]

@@ -433,6 +433,7 @@ class _TpuEstimator(Params, _TpuParams):
         # (the reference's NVTX ranges, ``RapidsRowMatrix.scala:62,70``)
         from .utils.profiling import annotate, timed
 
+        self._apply_verbosity()
         cls_name = type(self).__name__
         stream_func = self._get_tpu_streaming_fit_func(dataset)
         if stream_func is not None and self._should_stream(dataset):
@@ -560,6 +561,7 @@ class _TpuModel(Params, _TpuParams):
         for transform)."""
         from .utils.profiling import annotate, timed
 
+        self._apply_verbosity()
         X = self._extract_features_for_transform(dataset)
         with _x64_ctx(X.dtype):
             fn = self._get_tpu_transform_func(dataset)

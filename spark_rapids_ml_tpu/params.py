@@ -512,6 +512,21 @@ class _TpuParams(_TpuClass):
     # (engaged for lazy parquet scans or datasets above the device threshold)
     _streaming: Optional[bool] = None
     _stream_chunk_rows: Optional[int] = None
+    # verbosity is per-instance; the level is applied to the (shared
+    # per-class) logger at fit/transform time so instances don't clobber
+    # each other at construction
+    _verbose: Optional[bool] = None
+
+    def _apply_verbosity(self) -> None:
+        """Apply this instance's ``verbose`` setting to the shared
+        per-class logger for the duration of its operations."""
+        import logging as _logging
+
+        if self._verbose is not None:
+            get_logger(
+                type(self),
+                _logging.DEBUG if self._verbose else _logging.INFO,
+            )
 
     def _init_tpu_params(self) -> None:
         self._tpu_params = dict(self._get_tpu_params_default())
@@ -572,15 +587,9 @@ class _TpuParams(_TpuClass):
                 continue
             if name == "verbose":
                 # framework kwarg like the reference's cuML verbosity
-                # forwarding (``core.py:385-408``): raise/lower this
-                # class's logger level (debug = phase timings etc.)
-                import logging as _logging
-
-                if value is not None:
-                    get_logger(
-                        type(self),
-                        _logging.DEBUG if value else _logging.INFO,
-                    )
+                # forwarding (``core.py:385-408``); applied at
+                # fit/transform time (debug = phase timings etc.)
+                self._verbose = None if value is None else bool(value)
                 continue
             if self.hasParam(name):
                 self._set(**{name: value})

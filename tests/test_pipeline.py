@@ -1,0 +1,93 @@
+"""Pipeline / OneVsRest meta-algorithm tests (the reference composes with
+pyspark's versions — ``classification.py:318-321`` — so the framework
+ships drop-ins with the same semantics)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.pipeline import (
+    OneVsRest,
+    OneVsRestModel,
+    Pipeline,
+    PipelineModel,
+)
+
+
+def _multiclass(n=450, d=8, k=3, seed=0, spread=1.0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)) * 4
+    y = rng.integers(0, k, size=n)
+    X = centers[y] + spread * rng.normal(size=(n, d))
+    return X.astype(np.float32), y.astype(np.float64)
+
+
+def test_pipeline_pca_then_logreg(tmp_path):
+    X, y = _multiclass()
+    df = DataFrame({"features": X, "label": y})
+    pipe = Pipeline(stages=[
+        PCA(k=4, inputCol="features", outputCol="pca_out"),
+        LogisticRegression(featuresCol="pca_out", regParam=0.01, num_workers=2),
+    ])
+    model = pipe.fit(df)
+    out = model.transform(df)
+    preds = np.asarray(out.column("prediction"))
+    assert (preds == y).mean() > 0.9
+
+    # persistence round-trip: chained transform must match
+    path = str(tmp_path / "pipe")
+    model.write().overwrite().save(path)
+    loaded = PipelineModel.load(path)
+    preds2 = np.asarray(loaded.transform(df).column("prediction"))
+    np.testing.assert_array_equal(preds, preds2)
+
+
+def test_pipeline_transformer_stage_passthrough():
+    X, y = _multiclass(n=200)
+    df = DataFrame({"features": X, "label": y})
+    pca_model = PCA(k=3, inputCol="features", outputCol="p").fit(df)
+    pipe = Pipeline(stages=[
+        pca_model,  # already-fitted transformer stage
+        LogisticRegression(featuresCol="p", regParam=0.01),
+    ])
+    model = pipe.fit(df)
+    assert model.stages[0] is pca_model
+    out = model.transform(df)
+    assert "prediction" in out
+
+
+def test_one_vs_rest_matches_multinomial(tmp_path):
+    X, y = _multiclass(n=500, d=6, k=4, spread=1.5)
+    df = DataFrame({"features": X, "label": y})
+    ovr_model = OneVsRest(
+        classifier=LogisticRegression(regParam=0.01, num_workers=2)
+    ).fit(df)
+    assert ovr_model.numClasses == 4
+    out = ovr_model.transform(df)
+    preds = np.asarray(out.column("prediction"))
+    acc_ovr = (preds == y).mean()
+
+    direct = LogisticRegression(regParam=0.01, num_workers=2).fit(df)
+    acc_direct = (
+        np.asarray(direct.transform(df).column("prediction")) == y
+    ).mean()
+    assert acc_ovr > 0.9
+    assert acc_ovr >= acc_direct - 0.05
+
+    raw = np.asarray(out.column("rawPrediction"))
+    assert raw.shape == (500, 4)
+
+    path = str(tmp_path / "ovr")
+    ovr_model.save(path)
+    loaded = OneVsRestModel.load(path)
+    preds2 = np.asarray(loaded.transform(df).column("prediction"))
+    np.testing.assert_array_equal(preds, preds2)
+
+
+def test_one_vs_rest_rejects_bad_labels():
+    X, _ = _multiclass(n=60)
+    df = DataFrame({"features": X, "label": np.linspace(0, 1, 60)})
+    with pytest.raises(RuntimeError, match="non-negative integers"):
+        OneVsRest(classifier=LogisticRegression()).fit(df)

@@ -210,7 +210,7 @@ def test_rfr_toy_step_function():
 @pytest.mark.compat
 def test_rfr_matches_sklearn_r2(n_workers):
     if n_workers == 2:
-        pytest.skip("covered by 1/4-worker runs and test_rfc_padding_workers")
+        pytest.skip("covered by 1/4-worker runs and test_rfr_padding_workers")
     X, y = _regression_data(n=1000, d=6)
     n_train = 800
     df = DataFrame({"features": X[:n_train], "label": y[:n_train]})
@@ -227,6 +227,19 @@ def test_rfr_matches_sklearn_r2(n_workers):
     sk = SkRF(n_estimators=30, max_depth=8, random_state=0).fit(X[:n_train], y[:n_train])
     sk_r2 = sk.score(X[n_train:], yt)
     assert r2 >= sk_r2 - 0.1, f"r2 {r2} vs sklearn {sk_r2}"
+
+
+def test_rfr_padding_workers():
+    """Regressor analog of test_rfc_padding_workers: odd row count over 2
+    workers exercises the pad/mask path of the leaf-statistics builder."""
+    X, y = _regression_data(n=151, d=4)
+    df = DataFrame({"features": X, "label": y})
+    m = RandomForestRegressor(numTrees=4, maxDepth=5, seed=3, num_workers=2,
+                              featureSubsetStrategy="all").fit(df)
+    pred = m.transform(df)["prediction"]
+    ss_res = ((pred - y) ** 2).sum()
+    ss_tot = ((y - y.mean()) ** 2).sum()
+    assert 1 - ss_res / ss_tot > 0.8
 
 
 def test_rfr_min_instances_per_node():
