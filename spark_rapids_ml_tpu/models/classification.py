@@ -191,37 +191,20 @@ class LogisticRegression(
         # partition, and ranks disagreeing on n_classes (or on the
         # degenerate single-label early-return) would compile different
         # collectives and deadlock.
-        from ..parallel.mesh import allgather_host
+        from ..parallel.mesh import global_label_summary
 
         label_col = self.getOrDefault("labelCol")
-        y_host = np.asarray(dataset.column(label_col))
-        empty = y_host.size == 0
-        local = np.asarray(
-            [
-                1.0 if empty else 0.0,
-                -np.inf if empty else float(y_host.max()),
-                np.inf if empty else float(y_host.min()),
-                1.0 if empty or np.all(y_host == np.floor(y_host)) else 0.0,
-                0.0 if empty else float(y_host[0]),
-                1.0 if empty or np.all(y_host == y_host[0]) else 0.0,
-            ]
-        )
-        g = allgather_host(local)
-        non_empty = g[g[:, 0] == 0.0]
-        if len(non_empty) == 0:
+        ls = global_label_summary(np.asarray(dataset.column(label_col)))
+        if ls["total"] == 0:
             raise ValueError("Labels column is empty")
-        y_max, y_min = non_empty[:, 1].max(), non_empty[:, 2].min()
-        if y_min < 0 or not np.all(non_empty[:, 3] == 1.0):
+        if ls["y_min"] < 0 or not ls["all_int"]:
             raise RuntimeError(
                 "Labels MUST be non-negative integers, got values outside that set"
             )
         # Spark semantics: numClasses = max(label) + 1
-        n_classes = max(int(y_max) + 1, 2)
-        single_label = bool(
-            np.all(non_empty[:, 5] == 1.0)
-            and np.all(non_empty[:, 4] == non_empty[0, 4])
-        )
-        single_label_val = float(non_empty[0, 4])
+        n_classes = max(int(ls["y_max"]) + 1, 2)
+        single_label = ls["all_same"]
+        single_label_val = ls["first"]
 
         def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
             multinomial = n_classes > 2

@@ -171,6 +171,18 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
     def kneighbors(
         self, query_df: DataFrame
     ) -> Tuple[DataFrame, DataFrame, DataFrame]:
+        from ..parallel.context import ensure_distributed
+
+        ensure_distributed()  # idempotent (package import already ran it)
+        if jax.process_count() > 1:
+            # the ppermute ring + per-query top-k result distribution is
+            # not yet wired for cross-process row ownership; fail clearly
+            # instead of miscomputing on local shards
+            raise NotImplementedError(
+                "NearestNeighbors.kneighbors is not supported in "
+                "multi-process mode yet; run single-process (all chips of "
+                "one host) for kNN"
+            )
         k = self.getK()
         item_df = self._item_df_withid
         n_items = item_df.count()

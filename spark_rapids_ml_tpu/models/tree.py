@@ -301,12 +301,15 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
             seed = int(params.get("random_state") or 0)
 
             # 1) quantize features (host quantile sketch -> device binize).
-            # Strided row sample: unbiased under any dataset sort order
-            # (a prefix sample would skew edges on label/feature-sorted data)
+            # Strided VALID-row sample: unbiased under any dataset sort
+            # order (a prefix sample would skew edges on sorted data), and
+            # mask-aware so per-process padding rows never enter the sketch
+            from ..parallel.mesh import fetch_global, gather_rows_global
+
             step = max(1, inputs.n_rows // 131072)
-            edges_np = make_bin_edges(
-                np.asarray(inputs.X[: inputs.n_rows : step]), n_bins, seed=seed
-            )
+            valid_pos = np.nonzero(fetch_global(inputs.mask, inputs.mesh) > 0)[0]
+            sample = gather_rows_global(inputs.X, valid_pos[::step], inputs.mesh)
+            edges_np = make_bin_edges(sample, n_bins, seed=seed)
             bins = binize(inputs.X, jnp.asarray(edges_np), d_pad=d_pad)
 
             # 2) per-row sufficient stats
@@ -315,11 +318,16 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
             # 3) per-device tree split (reference ``tree.py:256-267``)
             n_dp = inputs.mesh.shape[DP_AXIS]
             t_local = -(-n_trees // n_dp)
-            keys = jax.random.split(
-                jax.random.PRNGKey(seed), n_dp * t_local
+            keys_np = np.asarray(
+                jax.random.split(jax.random.PRNGKey(seed), n_dp * t_local)
             ).reshape(n_dp, t_local, 2)
-            keys = jax.device_put(
-                np.asarray(keys), NamedSharding(inputs.mesh, P(DP_AXIS))
+            # make_array_from_callback: each process materializes only its
+            # addressable shards (device_put of a multi-host-sharded host
+            # array is not possible)
+            keys = jax.make_array_from_callback(
+                keys_np.shape,
+                NamedSharding(inputs.mesh, P(DP_AXIS)),
+                lambda idx: keys_np[idx],
             )
 
             cfg = ForestConfig(
@@ -341,7 +349,7 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
             # interleave device-major -> tree-major so the slice to n_trees
             # takes trees evenly from every device
             def _gather(a: jax.Array) -> np.ndarray:
-                a = np.asarray(a)
+                a = fetch_global(a, inputs.mesh)
                 shaped = a.reshape(n_dp, t_local, *a.shape[1:])
                 return np.swapaxes(shaped, 0, 1).reshape(-1, *a.shape[1:])[:n_trees]
 
@@ -513,11 +521,14 @@ class RandomForestClassifier(_RandomForestEstimator, HasProbabilityCol, HasRawPr
         return m
 
     def _process_labels(self, y_host: np.ndarray) -> int:
-        if y_host.size == 0:
+        from ..parallel.mesh import global_label_summary
+
+        ls = global_label_summary(y_host)
+        if ls["total"] == 0:
             raise ValueError("Labels column is empty")
-        if np.any(y_host < 0) or np.any(y_host != np.floor(y_host)):
+        if ls["y_min"] < 0 or not ls["all_int"]:
             raise RuntimeError("Labels MUST be non-negative integers")
-        return max(int(y_host.max()) + 1, 2)
+        return max(int(ls["y_max"]) + 1, 2)
 
     def _label_stats(self, y: jax.Array, n_stats: int) -> jax.Array:
         return jax.nn.one_hot(y.astype(jnp.int32), n_stats, dtype=jnp.float32)
@@ -645,7 +656,9 @@ class RandomForestRegressor(_RandomForestEstimator):
         return m
 
     def _process_labels(self, y_host: np.ndarray) -> int:
-        if y_host.size == 0:
+        from ..parallel.mesh import global_label_summary
+
+        if global_label_summary(y_host)["total"] == 0:
             raise ValueError("Labels column is empty")
         return 3  # (weight, w*y, w*y^2)
 

@@ -15,7 +15,7 @@ Axis naming convention used across the framework:
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -236,6 +236,44 @@ def global_row_count(n_local: int) -> int:
     return int(
         np.asarray(multihost_utils.process_allgather(np.asarray([n_local]))).sum()
     )
+
+
+def global_label_summary(y_local: np.ndarray) -> Dict[str, Any]:
+    """World-wide label statistics from per-process label columns.
+
+    Every rank must agree on label-derived compile-time constants
+    (n_classes, degenerate single-label cases) or their collectives
+    diverge; empty local partitions are legitimate and excluded.
+    Returns ``{y_max, y_min, all_int, all_same, first, total}``.
+    """
+    y_local = np.asarray(y_local)
+    empty = y_local.size == 0
+    local = np.asarray(
+        [
+            1.0 if empty else 0.0,
+            -np.inf if empty else float(y_local.max()),
+            np.inf if empty else float(y_local.min()),
+            1.0 if empty or bool(np.all(y_local == np.floor(y_local))) else 0.0,
+            0.0 if empty else float(y_local[0]),
+            1.0 if empty or bool(np.all(y_local == y_local[0])) else 0.0,
+            float(y_local.size),
+        ]
+    )
+    g = allgather_host(local)
+    non_empty = g[g[:, 0] == 0.0]
+    if len(non_empty) == 0:
+        return {"total": 0}
+    return {
+        "y_max": float(non_empty[:, 1].max()),
+        "y_min": float(non_empty[:, 2].min()),
+        "all_int": bool(np.all(non_empty[:, 3] == 1.0)),
+        "all_same": bool(
+            np.all(non_empty[:, 5] == 1.0)
+            and np.all(non_empty[:, 4] == non_empty[0, 4])
+        ),
+        "first": float(non_empty[0, 4]),
+        "total": int(g[:, 6].sum()),
+    }
 
 
 def allgather_host(vals: np.ndarray) -> np.ndarray:

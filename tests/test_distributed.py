@@ -62,6 +62,18 @@ _WORKER = textwrap.dedent(
         DataFrame({{"features": X[sl], "label": y3[sl]}})
     )
     assert lr3.numClasses == 3, lr3.numClasses
+
+    # RF: trees are sharded across the global device mesh; the model must
+    # be identical to the single-process fit (same global layout + seeds)
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+    rf = RandomForestClassifier(numTrees=8, maxDepth=4, seed=5, num_workers=4).fit(df)
+
+    # UMAP: single-node fit gathers every process's partition, so all
+    # ranks embed the FULL dataset identically
+    from spark_rapids_ml_tpu.umap import UMAP
+    um = UMAP(n_neighbors=8, random_state=1, init="random").fit(df)
+    assert um.raw_data_.shape[0] == len(X), um.raw_data_.shape
+
     if pid == 0:
         np.savez(
             os.environ["TPUML_TEST_OUT"],
@@ -73,6 +85,9 @@ _WORKER = textwrap.dedent(
             centers=np.asarray(sorted(km.clusterCenters(), key=lambda c: tuple(c))),
             km_cost=km.trainingCost,
             coef3=lr3.coefficientMatrix,
+            rf_features=rf._features_arr,
+            rf_thresholds=rf._thresholds_arr,
+            umap_emb=um.embedding_,
         )
     """
 )
@@ -158,6 +173,16 @@ def test_two_process_fit_matches_single_process(tmp_path):
     np.testing.assert_allclose(
         res["coef3"], lr3.coefficientMatrix, rtol=5e-3, atol=5e-4
     )
+
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+    from spark_rapids_ml_tpu.umap import UMAP
+
+    rf = RandomForestClassifier(numTrees=8, maxDepth=4, seed=5, num_workers=4).fit(df)
+    np.testing.assert_array_equal(res["rf_features"], rf._features_arr)
+    np.testing.assert_allclose(res["rf_thresholds"], rf._thresholds_arr, rtol=1e-5)
+
+    um = UMAP(n_neighbors=8, random_state=1, init="random").fit(df)
+    np.testing.assert_allclose(res["umap_emb"], um.embedding_, rtol=1e-4, atol=1e-4)
 
 
 def test_dist_context_noop_single_process():
