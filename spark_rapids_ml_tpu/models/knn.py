@@ -94,7 +94,8 @@ class _NearestNeighborsParams(Params):
 
     def _ensureIdCol(self, df: DataFrame) -> DataFrame:
         """Add a monotonically-increasing id column when the user didn't set
-        one (reference ``knn.py:135-152``)."""
+        one (reference ``knn.py:135-152``). Multi-process: ids are offset by
+        the lower ranks' row counts so they are globally unique."""
         if self.isDefined("idCol"):
             id_col = self.getOrDefault("idCol")
             if id_col not in df:
@@ -102,7 +103,15 @@ class _NearestNeighborsParams(Params):
             return df
         if _DEFAULT_ID_COL in df:
             return df
-        return df.withColumn(_DEFAULT_ID_COL, np.arange(df.count(), dtype=np.int64))
+        offset = 0
+        if jax.process_count() > 1:
+            from ..parallel.mesh import allgather_host
+
+            counts = allgather_host(np.asarray([df.count()])).ravel().astype(np.int64)
+            offset = int(counts[: jax.process_index()].sum())
+        return df.withColumn(
+            _DEFAULT_ID_COL, np.arange(offset, offset + df.count(), dtype=np.int64)
+        )
 
     def _resolve_features(self, df: DataFrame) -> np.ndarray:
         from ..core import _resolve_features_f32
@@ -172,20 +181,18 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
         self, query_df: DataFrame
     ) -> Tuple[DataFrame, DataFrame, DataFrame]:
         from ..parallel.context import ensure_distributed
+        from ..parallel.mesh import (
+            allgather_host,
+            global_row_count,
+            local_row_block,
+            row_sharding,
+        )
 
         ensure_distributed()  # idempotent (package import already ran it)
-        if jax.process_count() > 1:
-            # the ppermute ring + per-query top-k result distribution is
-            # not yet wired for cross-process row ownership; fail clearly
-            # instead of miscomputing on local shards
-            raise NotImplementedError(
-                "NearestNeighbors.kneighbors is not supported in "
-                "multi-process mode yet; run single-process (all chips of "
-                "one host) for kNN"
-            )
+        nproc = jax.process_count()
         k = self.getK()
         item_df = self._item_df_withid
-        n_items = item_df.count()
+        n_items = global_row_count(item_df.count())
         if k > n_items:
             raise ValueError(f"k={k} must be <= number of item rows {n_items}")
         query_df_withid = self._ensureIdCol(query_df)
@@ -201,17 +208,40 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
         mesh = make_mesh(self.num_workers)
         Xi_d, mi_d = shard_rows(Xi, mesh)
         Xq_d, _ = shard_rows(Xq, mesh)
-        row_ids = np.arange(Xi_d.shape[0], dtype=np.int32)
-        ids_d, _ = shard_rows(row_ids, mesh)
+        n_item_rows = Xi_d.shape[0]  # global padded
+        if nproc > 1:
+            # each process provides its block of global padded positions —
+            # this is the UCX-partition-ownership analog (``knn.py:573-586``
+            # remaps cuML row numbers to user ids the same way)
+            local_rows = n_item_rows // nproc
+            p = jax.process_index()
+            ids_local = np.arange(
+                p * local_rows, (p + 1) * local_rows, dtype=np.int32
+            )
+            ids_d = jax.make_array_from_process_local_data(
+                row_sharding(mesh), ids_local, (n_item_rows,)
+            )
+        else:
+            ids_d, _ = shard_rows(np.arange(n_item_rows, dtype=np.int32), mesh)
 
         d2, idx = ring_knn(Xq_d, Xi_d, mi_d, ids_d, mesh=mesh, k=k)
         nq = Xq.shape[0]
-        d2 = np.asarray(d2)[:nq]
-        idx = np.asarray(idx)[:nq]
+        if nproc > 1:
+            # this rank's query rows live in its own addressable shards —
+            # no collective needed; map global padded item positions ->
+            # user ids via a host allgather of each rank's (padded) ids
+            d2 = local_row_block(d2)[:nq]
+            idx = local_row_block(idx)[:nq]
+            padded_ids = np.full((local_rows,), -1, np.int64)
+            padded_ids[: Xi.shape[0]] = np.asarray(item_df.column(id_col))
+            item_ids = allgather_host(padded_ids).reshape(-1)
+        else:
+            d2 = np.asarray(d2)[:nq]
+            idx = np.asarray(idx)[:nq]
+            item_ids = np.asarray(item_df.column(id_col))
 
         distances = np.sqrt(np.maximum(d2, 0.0)).astype(np.float32)
-        item_ids = np.asarray(item_df.column(id_col))
-        indices = item_ids[np.clip(idx, 0, n_items - 1)]
+        indices = item_ids[np.clip(idx, 0, len(item_ids) - 1)]
 
         query_ids = np.asarray(query_df_withid.column(id_col))
         order = np.argsort(query_ids, kind="stable")
@@ -227,6 +257,14 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
     def exactNearestNeighborsJoin(
         self, query_df: DataFrame, distCol: str = "distCol"
     ) -> DataFrame:
+        if jax.process_count() > 1:
+            # a query's neighbors may be items owned by other ranks; joining
+            # full item rows across processes needs a distributed shuffle —
+            # use kneighbors (ids + distances are fully supported) instead
+            raise NotImplementedError(
+                "exactNearestNeighborsJoin is not supported in multi-process "
+                "mode; use kneighbors and join on the returned ids"
+            )
         id_col = self.getIdCol()
         item_df_withid, query_df_withid, knn_df = self.kneighbors(query_df)
         k = self.getK()

@@ -50,19 +50,6 @@ from ..ops.umap_kernels import (
 )
 
 
-def _allgather_rows(a: np.ndarray) -> np.ndarray:
-    """Concatenate every process's rows (uneven partitions padded through
-    a host allgather) — the multi-host analog of coalescing to one node."""
-    from ..parallel.mesh import allgather_host
-
-    counts = allgather_host(np.asarray([a.shape[0]])).ravel().astype(int)
-    maxc = int(counts.max())
-    padded = np.zeros((maxc,) + a.shape[1:], a.dtype)
-    padded[: a.shape[0]] = a
-    gathered = allgather_host(padded)
-    return np.concatenate([gathered[p][: counts[p]] for p in range(len(counts))])
-
-
 @functools.partial(jax.jit, static_argnames=("k", "qchunk"))
 def knn_brute(X: jax.Array, Xq: jax.Array, *, k: int, qchunk: int = 4096):
     """Single-host brute-force kNN: (dists ascending, indices), (nq, k)."""
@@ -243,7 +230,9 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
             # (sampled) partition so all ranks fit the same model on the
             # full dataset — fitting each rank's local slice would silently
             # produce divergent models
-            X = _allgather_rows(X)
+            from ..parallel.mesh import allgather_ragged_rows
+
+            X = allgather_ragged_rows(X)
         if self.isDefined("labelCol") and self.isSet("labelCol"):
             # supervised fit (reference delegates to cuML fit(X, y=labels),
             # ``umap.py:941-947``): labels sharpen the fuzzy set below
@@ -255,7 +244,9 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
                 )
             y_labels = np.asarray(df.column(label_col)).astype(np.int64)
             if jax.process_count() > 1:
-                y_labels = _allgather_rows(y_labels[:, None]).ravel()
+                from ..parallel.mesh import allgather_ragged_rows
+
+                y_labels = allgather_ragged_rows(y_labels[:, None]).ravel()
         n = X.shape[0]
         k = int(self._tpu_params.get("n_neighbors", 15))
         if k >= n:

@@ -262,7 +262,10 @@ def global_label_summary(y_local: np.ndarray) -> Dict[str, Any]:
     g = allgather_host(local)
     non_empty = g[g[:, 0] == 0.0]
     if len(non_empty) == 0:
-        return {"total": 0}
+        return {
+            "y_max": -np.inf, "y_min": np.inf, "all_int": True,
+            "all_same": True, "first": 0.0, "total": 0,
+        }
     return {
         "y_max": float(non_empty[:, 1].max()),
         "y_min": float(non_empty[:, 2].min()),
@@ -287,3 +290,23 @@ def allgather_host(vals: np.ndarray) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(vals))
+
+
+def allgather_ragged_rows(a: np.ndarray) -> np.ndarray:
+    """Concatenate every process's rows in rank order (uneven partitions
+    padded through a host allgather, then trimmed) — the multi-host analog
+    of coalescing a dataset to one node."""
+    counts = allgather_host(np.asarray([a.shape[0]])).ravel().astype(int)
+    maxc = int(counts.max())
+    padded = np.zeros((maxc,) + a.shape[1:], a.dtype)
+    padded[: a.shape[0]] = a
+    gathered = allgather_host(padded)
+    return np.concatenate([gathered[p][: counts[p]] for p in range(len(counts))])
+
+
+def local_row_block(arr: jax.Array) -> np.ndarray:
+    """This process's rows of a row-sharded array, assembled from its
+    addressable shards in row order — no collective, and no assumption
+    that the dp device order is process-contiguous."""
+    shards = sorted(arr.addressable_shards, key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards])

@@ -206,3 +206,71 @@ def test_distributed_env_detection(monkeypatch):
     assert distributed_env_configured() is True
     monkeypatch.setenv("TPUML_NUM_PROCS", "1")
     assert distributed_env_configured() is False
+
+
+_KNN_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    import numpy as np
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    from spark_rapids_ml_tpu.data import DataFrame
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+
+    pid = int(os.environ["TPUML_PROC_ID"])
+    rng = np.random.default_rng(11)
+    Xi = rng.normal(size=(157, 6)).astype(np.float32)
+    Xq = rng.normal(size=(63, 6)).astype(np.float32)
+    isl = slice(0, 90) if pid == 0 else slice(90, None)
+    qsl = slice(0, 40) if pid == 0 else slice(40, None)
+    m = NearestNeighbors(k=4, num_workers=4).fit(DataFrame({{"features": Xi[isl]}}))
+    _, _, knn_df = m.kneighbors(DataFrame({{"features": Xq[qsl]}}))
+    idxs = np.asarray(knn_df.column("indices"))
+    dists = np.asarray(knn_df.column("distances"))
+
+    # oracle: brute force over the FULL item set for this rank's queries;
+    # auto-generated ids are globally offset, so they equal positions in Xi
+    qs = Xq[qsl]
+    d2 = ((qs[:, None, :] - Xi[None, :, :]) ** 2).sum(-1)
+    exp_idx = np.argsort(d2, axis=1)[:, :4]
+    exp_d = np.sqrt(np.take_along_axis(d2, exp_idx, 1))
+    assert np.allclose(np.sort(dists, 1), np.sort(exp_d, 1), atol=1e-4)
+    assert (np.sort(idxs, 1) == np.sort(exp_idx, 1)).all()
+    print(f"rank {{pid}} ok", flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_knn_exact(tmp_path):
+    """Cross-process kNN: each rank owns item and query partitions; results
+    must match a full-dataset brute-force oracle (the reference's UCX
+    partition exchange contract, ``knn.py:377-379``)."""
+    script = tmp_path / "knn_worker.py"
+    script.write_text(_KNN_WORKER.format(repo=REPO))
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            TPUML_COORDINATOR="127.0.0.1:18490",
+            TPUML_NUM_PROCS="2",
+            TPUML_PROC_ID=str(pid),
+            JAX_PLATFORMS="cpu",
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+        )
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"knn worker failed:\n{stdout[-3000:]}"
