@@ -232,8 +232,17 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
             # user ids via a host allgather of each rank's (padded) ids
             d2 = local_row_block(d2)[:nq]
             idx = local_row_block(idx)[:nq]
-            padded_ids = np.full((local_rows,), -1, np.int64)
-            padded_ids[: Xi.shape[0]] = np.asarray(item_df.column(id_col))
+            ids_arr = np.asarray(item_df.column(id_col))
+            if not np.issubdtype(ids_arr.dtype, np.number):
+                raise NotImplementedError(
+                    f"multi-process kneighbors requires a numeric idCol "
+                    f"(got dtype {ids_arr.dtype}); the id exchange rides a "
+                    "numeric allgather"
+                )
+            # padded layout preserves the user's id dtype (padding slots are
+            # never selected: masked rows carry +inf distance in the ring)
+            padded_ids = np.zeros((local_rows,), ids_arr.dtype)
+            padded_ids[: Xi.shape[0]] = ids_arr
             item_ids = allgather_host(padded_ids).reshape(-1)
         else:
             d2 = np.asarray(d2)[:nq]

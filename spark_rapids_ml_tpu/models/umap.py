@@ -38,6 +38,7 @@ from ..params import (
     _mk,
 )
 from ..ops.kmeans_kernels import pairwise_sq_dists
+from ..parallel.mesh import allgather_ragged_rows
 from ..ops.umap_kernels import (
     categorical_simplicial_set_intersection,
     default_n_epochs,
@@ -230,8 +231,6 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
             # (sampled) partition so all ranks fit the same model on the
             # full dataset — fitting each rank's local slice would silently
             # produce divergent models
-            from ..parallel.mesh import allgather_ragged_rows
-
             X = allgather_ragged_rows(X)
         if self.isDefined("labelCol") and self.isSet("labelCol"):
             # supervised fit (reference delegates to cuML fit(X, y=labels),
@@ -244,8 +243,6 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
                 )
             y_labels = np.asarray(df.column(label_col)).astype(np.int64)
             if jax.process_count() > 1:
-                from ..parallel.mesh import allgather_ragged_rows
-
                 y_labels = allgather_ragged_rows(y_labels[:, None]).ravel()
         n = X.shape[0]
         k = int(self._tpu_params.get("n_neighbors", 15))

@@ -24,6 +24,16 @@ import jax  # noqa: E402
 # sitecustomize AFTER env vars are read; explicitly pin CPU here.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the tree-builder programs dominate suite
+# wall-clock; caching compiled executables on disk makes repeat runs (CI
+# rounds on the same machine) start warm.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # older jax without the knobs
+    pass
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -19,6 +19,14 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
 _WORKER = textwrap.dedent(
     """
     import os, sys
@@ -99,11 +107,12 @@ def test_two_process_fit_matches_single_process(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(_WORKER.format(repo=REPO))
 
+    coord = f"127.0.0.1:{_free_port()}"
     procs = []
     for pid in range(2):
         env = dict(os.environ)
         env.update(
-            TPUML_COORDINATOR="127.0.0.1:18479",
+            TPUML_COORDINATOR=coord,
             TPUML_NUM_PROCS="2",
             TPUML_PROC_ID=str(pid),
             TPUML_TEST_OUT=out,
@@ -251,11 +260,12 @@ def test_two_process_knn_exact(tmp_path):
     partition exchange contract, ``knn.py:377-379``)."""
     script = tmp_path / "knn_worker.py"
     script.write_text(_KNN_WORKER.format(repo=REPO))
+    coord = f"127.0.0.1:{_free_port()}"
     procs = []
     for pid in range(2):
         env = dict(os.environ)
         env.update(
-            TPUML_COORDINATOR="127.0.0.1:18490",
+            TPUML_COORDINATOR=coord,
             TPUML_NUM_PROCS="2",
             TPUML_PROC_ID=str(pid),
             JAX_PLATFORMS="cpu",
