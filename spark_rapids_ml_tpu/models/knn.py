@@ -205,6 +205,15 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
                 f"item/query dims differ: {Xi.shape[1]} vs {Xq.shape[1]}"
             )
 
+        ids_arr = np.asarray(item_df.column(id_col))
+        if nproc > 1 and not np.issubdtype(ids_arr.dtype, np.number):
+            # fail fast, before any device work: the id exchange rides a
+            # numeric allgather
+            raise NotImplementedError(
+                f"multi-process kneighbors requires a numeric idCol "
+                f"(got dtype {ids_arr.dtype})"
+            )
+
         mesh = make_mesh(self.num_workers)
         Xi_d, mi_d = shard_rows(Xi, mesh)
         Xq_d, _ = shard_rows(Xq, mesh)
@@ -232,18 +241,14 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
             # user ids via a host allgather of each rank's (padded) ids
             d2 = local_row_block(d2)[:nq]
             idx = local_row_block(idx)[:nq]
-            ids_arr = np.asarray(item_df.column(id_col))
-            if not np.issubdtype(ids_arr.dtype, np.number):
-                raise NotImplementedError(
-                    f"multi-process kneighbors requires a numeric idCol "
-                    f"(got dtype {ids_arr.dtype}); the id exchange rides a "
-                    "numeric allgather"
-                )
-            # padded layout preserves the user's id dtype (padding slots are
-            # never selected: masked rows carry +inf distance in the ring)
+            # padded layout preserves the user's id dtype EXACTLY: the
+            # allgather moves raw bytes (jax would canonicalize int64 ->
+            # int32 without x64); padding slots are never selected (masked
+            # rows carry +inf distance in the ring)
             padded_ids = np.zeros((local_rows,), ids_arr.dtype)
             padded_ids[: Xi.shape[0]] = ids_arr
-            item_ids = allgather_host(padded_ids).reshape(-1)
+            gathered = allgather_host(np.ascontiguousarray(padded_ids).view(np.uint8))
+            item_ids = gathered.reshape(-1).view(ids_arr.dtype)
         else:
             d2 = np.asarray(d2)[:nq]
             idx = np.asarray(idx)[:nq]
