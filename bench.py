@@ -54,7 +54,11 @@ N_COLS = int(os.environ.get("BENCH_COLS", 256))
 KMEANS_K = int(os.environ.get("BENCH_KMEANS_K", 1024))
 KMEANS_ITERS = 10
 LOGREG_ITERS = 20
-CSIZE = min(16384, max(256, N_ROWS // 8))
+def _csize(n_rows: int) -> int:
+    return min(16384, max(256, n_rows // 8))
+
+
+CSIZE = _csize(N_ROWS)
 
 # bf16 peak FLOP/s per chip by device kind (MFU denominator).
 _PEAK_BY_KIND = [
@@ -248,7 +252,7 @@ def bench_pca_stream(mesh, n_chips):
     }
 
 
-def _probe_backend(attempts: int = 3, probe_timeout: int = 90, cooldown: int = 60) -> bool:
+def _probe_backend(attempts: int = 2, probe_timeout: int = 75, cooldown: int = 30) -> bool:
     """Fail fast if the backend hangs at init (round-1 failure mode).
 
     A wedged TPU tunnel blocks *inside* ``make_c_api_client`` — uninterruptible
@@ -287,6 +291,7 @@ def _probe_backend(attempts: int = 3, probe_timeout: int = 90, cooldown: int = 6
 
 
 def main() -> None:
+    global N_ROWS, CSIZE
     tpu_ok = _probe_backend()
     if not tpu_ok:
         pin_platform("cpu")
@@ -295,6 +300,21 @@ def main() -> None:
     devices = jax.devices()
     n_chips = len(devices)
     peak = _chip_peak_flops(devices[0])
+    if devices[0].platform == "cpu" and "BENCH_ROWS" not in os.environ:
+        # CPU fallback at the accelerator row count would blow any time
+        # budget (kmeans k=1024 over millions of rows); scale down unless
+        # the caller pinned a size explicitly
+        N_ROWS = min(N_ROWS, 50_000)
+        def _csize(n_rows: int) -> int:
+    return min(16384, max(256, n_rows // 8))
+
+
+CSIZE = _csize(N_ROWS)
+        print(
+            f"[bench] cpu device: reducing N_ROWS to {N_ROWS} "
+            "(set BENCH_ROWS to override)",
+            file=sys.stderr,
+        )
 
     from spark_rapids_ml_tpu.parallel.mesh import make_mesh, shard_rows
 

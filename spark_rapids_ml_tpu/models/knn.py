@@ -207,11 +207,11 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
 
         ids_arr = np.asarray(item_df.column(id_col))
         if nproc > 1 and not np.issubdtype(ids_arr.dtype, np.number):
-            # fail fast, before any device work: the id exchange rides a
-            # numeric allgather
+            # fail fast, before any device work: the byte-view id exchange
+            # needs a fixed-width viewable dtype (object/str arrays are not)
             raise NotImplementedError(
-                f"multi-process kneighbors requires a numeric idCol "
-                f"(got dtype {ids_arr.dtype})"
+                f"multi-process kneighbors requires a fixed-width numeric "
+                f"idCol (got dtype {ids_arr.dtype})"
             )
 
         mesh = make_mesh(self.num_workers)
@@ -252,7 +252,7 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
         else:
             d2 = np.asarray(d2)[:nq]
             idx = np.asarray(idx)[:nq]
-            item_ids = np.asarray(item_df.column(id_col))
+            item_ids = ids_arr
 
         distances = np.sqrt(np.maximum(d2, 0.0)).astype(np.float32)
         indices = item_ids[np.clip(idx, 0, len(item_ids) - 1)]
