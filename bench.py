@@ -305,11 +305,7 @@ def main() -> None:
         # budget (kmeans k=1024 over millions of rows); scale down unless
         # the caller pinned a size explicitly
         N_ROWS = min(N_ROWS, 50_000)
-        def _csize(n_rows: int) -> int:
-    return min(16384, max(256, n_rows // 8))
-
-
-CSIZE = _csize(N_ROWS)
+        CSIZE = _csize(N_ROWS)
         print(
             f"[bench] cpu device: reducing N_ROWS to {N_ROWS} "
             "(set BENCH_ROWS to override)",
