@@ -9,11 +9,10 @@ itself travels into ``model._transformEvaluate``.
 """
 
 from .multiclass import MulticlassMetrics, log_loss
-from .regression import RegressionMetrics, _SummarizerBuffer
+from .regression import RegressionMetrics
 
 __all__ = [
     "MulticlassMetrics",
     "RegressionMetrics",
-    "_SummarizerBuffer",
     "log_loss",
 ]

@@ -1,215 +1,134 @@
-"""Regression metrics from mergeable moment buffers.
+"""Regression metrics from mergeable moment vectors.
 
-Port of the reference's ``RegressionMetrics`` + ``_SummarizerBuffer``
-(``/root/reference/python/src/spark_rapids_ml/metrics/RegressionMetrics.py``),
-itself a port of Spark's Scala ``SummarizerBuffer``. The buffer tracks
-mean / m2n (centered second moment) / m2 (raw second moment) / l1 for the
-three series [label, label−prediction, prediction]; two buffers merge with
-the Chan et al. parallel-variance update, so per-shard statistics combine
-exactly.
+Everything ``RegressionEvaluator`` supports (rmse/mse/r2/mae/var, Spark
+semantics) computes from four length-3 moment vectors over the series
+``[label, residual, prediction]``:
+
+    mean = 1/N · Σ x        m2n = Σ (x − mean)²  (centered)
+    m2   = Σ x²             l1  = Σ |x|
+
+Two shards merge exactly with the Chan et al. parallel-variance update —
+the same sufficient-statistics contract as the reference's
+``RegressionMetrics``/``_SummarizerBuffer``
+(``/root/reference/python/src/spark_rapids_ml/metrics/RegressionMetrics.py``,
+itself a port of Spark's Scala ``SummarizerBuffer``), held here as
+vectorized numpy state rather than per-series Python lists.
 """
 
 from __future__ import annotations
 
 import math
-from collections import namedtuple
-from typing import Any, List
+from typing import Any
 
 import numpy as np
 
-RegMetrics = namedtuple("RegMetrics", ("m2n", "m2", "l1", "mean", "total_count"))
-reg_metrics = RegMetrics("m2n", "m2", "l1", "mean", "total_count")
 
-
-class _SummarizerBuffer:
-    """Mergeable moment buffer (reference ``RegressionMetrics.py:30-149``).
-
-    All of mean/m2n/m2/l1 have the same length (3 here), ordered
-    [label, label-prediction, prediction]::
-
-        mean = 1/N · Σ x_i
-        m2n  = Σ (x_i − mean)²   (variance · N)
-        m2   = Σ x_i²
-        l1   = Σ |x_i|
-    """
+class RegressionMetrics:
+    """Mergeable regression metrics over [label, residual, prediction]."""
 
     def __init__(
         self,
-        mean: List[float],
-        m2n: List[float],
-        m2: List[float],
-        l1: List[float],
-        total_cnt: int,
-    ):
-        self._curr_mean = list(mean)
-        self._curr_m2n = list(m2n)
-        self._curr_m2 = list(m2)
-        self._curr_l1 = list(l1)
-        self._num_cols = len(mean)
-        self._total_cnt = total_cnt
-        # weight col unsupported (parity with the reference): weight = 1/row
-        self._total_weight_sum = total_cnt
-        self._weight_square_sum = total_cnt
-        self._curr_weight_sum = [total_cnt] * self._num_cols
-
-    def merge(self, other: "_SummarizerBuffer") -> "_SummarizerBuffer":
-        """Merge the other into self and return a new buffer (Chan et al.)."""
-        self._total_cnt += other._total_cnt
-        self._total_weight_sum += other._total_weight_sum
-        self._weight_square_sum += other._weight_square_sum
-
-        for i in range(self._num_cols):
-            this_weight_sum = self._curr_weight_sum[i]
-            other_weight_sum = other._curr_weight_sum[i]
-            total_weight_sum = this_weight_sum + other_weight_sum
-            if total_weight_sum != 0.0:
-                delta_mean = other._curr_mean[i] - self._curr_mean[i]
-                self._curr_mean[i] += delta_mean * other_weight_sum / total_weight_sum
-                self._curr_m2n[i] += (
-                    other._curr_m2n[i]
-                    + delta_mean
-                    * delta_mean
-                    * this_weight_sum
-                    * other_weight_sum
-                    / total_weight_sum
-                )
-                self._curr_m2[i] += other._curr_m2[i]
-                self._curr_l1[i] += other._curr_l1[i]
-            self._curr_weight_sum[i] = total_weight_sum
-
-        return _SummarizerBuffer(
-            self._curr_mean,
-            self._curr_m2n,
-            self._curr_m2,
-            self._curr_l1,
-            self._total_cnt,
-        )
-
-    @property
-    def total_count(self) -> int:
-        return self._total_cnt
-
-    @property
-    def weight_sum(self) -> int:
-        return self._total_weight_sum
-
-    @property
-    def m2(self) -> List[float]:
-        return self._curr_m2
-
-    @property
-    def norm_l1(self) -> List[float]:
-        return self._curr_l1
-
-    @property
-    def mean(self) -> List[float]:
-        return self._curr_mean
-
-    @property
-    def variance(self) -> List[float]:
-        """Unbiased sample variance per series (Spark semantics)."""
-        denom = self._total_weight_sum - (
-            self._weight_square_sum / self._total_weight_sum
-        )
-        if denom > 0:
-            return [
-                max(m2n / denom, 0.0) for m2n in self._curr_m2n
-            ]
-        return [0.0] * self._num_cols
-
-
-class RegressionMetrics:
-    """Metrics for regression (reference ``RegressionMetrics.py:153-267``)."""
-
-    def __init__(self, summary: _SummarizerBuffer):
-        self._summary = summary
-
-    @staticmethod
-    def create(
-        mean: List[float],
-        m2n: List[float],
-        m2: List[float],
-        l1: List[float],
-        total_cnt: int,
-    ) -> "RegressionMetrics":
-        return RegressionMetrics(_SummarizerBuffer(mean, m2n, m2, l1, total_cnt))
+        n: int,
+        mean: np.ndarray,
+        m2n: np.ndarray,
+        m2: np.ndarray,
+        l1: np.ndarray,
+    ) -> None:
+        self._n = int(n)
+        self._mean = np.asarray(mean, np.float64)
+        self._m2n = np.asarray(m2n, np.float64)
+        self._m2 = np.asarray(m2, np.float64)
+        self._l1 = np.asarray(l1, np.float64)
 
     @classmethod
     def from_predictions(
         cls, labels: np.ndarray, predictions: np.ndarray
     ) -> "RegressionMetrics":
-        """Build the moment buffer from a (shard of) predictions."""
-        y = np.asarray(labels, dtype=np.float64)
-        p = np.asarray(predictions, dtype=np.float64)
-        series = [y, y - p, p]
-        mean = [float(s.mean()) for s in series]
-        m2n = [float(((s - s.mean()) ** 2).sum()) for s in series]
-        m2 = [float((s * s).sum()) for s in series]
-        l1 = [float(np.abs(s).sum()) for s in series]
-        return cls.create(mean, m2n, m2, l1, int(y.shape[0]))
-
-    def merge(self, other: "RegressionMetrics") -> "RegressionMetrics":
-        return RegressionMetrics(self._summary.merge(other._summary))
-
-    @property
-    def _ss_y(self) -> float:
-        """Sum of squares for label."""
-        return self._summary.m2[0]
-
-    @property
-    def _ss_err(self) -> float:
-        """Sum of squares for label−prediction."""
-        return self._summary.m2[1]
-
-    @property
-    def _ss_tot(self) -> float:
-        return self._summary.variance[0] * (self._summary.weight_sum - 1)
-
-    @property
-    def _ss_reg(self) -> float:
-        return (
-            self._summary.m2[2]
-            + math.pow(self._summary.mean[0], 2) * self._summary.weight_sum
-            - 2
-            * self._summary.mean[0]
-            * self._summary.mean[2]
-            * self._summary.weight_sum
+        """Build the moment vectors from a (shard of) predictions — one
+        stacked (3, n) pass."""
+        y = np.asarray(labels, np.float64)
+        p = np.asarray(predictions, np.float64)
+        s = np.stack([y, y - p, p])  # (3, n)
+        mean = s.mean(axis=1)
+        return cls(
+            n=y.shape[0],
+            mean=mean,
+            m2n=((s - mean[:, None]) ** 2).sum(axis=1),
+            m2=(s * s).sum(axis=1),
+            l1=np.abs(s).sum(axis=1),
         )
 
+    def merge(self, other: "RegressionMetrics") -> "RegressionMetrics":
+        """Exact shard merge (Chan et al. parallel variance, weights = 1)."""
+        na, nb = self._n, other._n
+        n = na + nb
+        if n == 0:
+            return RegressionMetrics(0, self._mean, self._m2n, self._m2, self._l1)
+        delta = other._mean - self._mean
+        return RegressionMetrics(
+            n=n,
+            mean=self._mean + delta * (nb / n),
+            m2n=self._m2n + other._m2n + delta * delta * (na * nb / n),
+            m2=self._m2 + other._m2,
+            l1=self._l1 + other._l1,
+        )
+
+    # series indices: 0 = label, 1 = residual, 2 = prediction
     @property
     def mean_squared_error(self) -> float:
-        return self._ss_err / self._summary.weight_sum
+        if self._n == 0:
+            raise ZeroDivisionError("metrics undefined on an empty dataset")
+        return float(self._m2[1] / self._n)
 
     @property
     def root_mean_squared_error(self) -> float:
         return math.sqrt(self.mean_squared_error)
 
-    def r2(self, through_origin: bool) -> float:
-        return (
-            (1 - self._ss_err / self._ss_y)
-            if through_origin
-            else (1 - self._ss_err / self._ss_tot)
-        )
-
     @property
     def mean_absolute_error(self) -> float:
-        return self._summary.norm_l1[1] / self._summary.weight_sum
+        return float(self._l1[1] / self._n)
+
+    def _variance(self) -> np.ndarray:
+        """Unbiased sample variance per series (Spark semantics; unit
+        weights make the correction denominator n − 1)."""
+        denom = self._n - 1
+        if denom > 0:
+            return np.maximum(self._m2n / denom, 0.0)
+        return np.zeros_like(self._m2n)
+
+    def r2(self, through_origin: bool) -> float:
+        # fail loudly on degenerate denominators (constant labels / n<=1):
+        # a silent nan would make every model-selection comparison False
+        ss_err = self._m2[1]
+        if through_origin:
+            if self._m2[0] == 0.0:
+                raise ZeroDivisionError("r2 undefined: sum of squared labels is 0")
+            return float(1 - ss_err / self._m2[0])
+        ss_tot = self._variance()[0] * (self._n - 1)
+        if ss_tot == 0.0:
+            raise ZeroDivisionError("r2 undefined: label variance is 0")
+        return float(1 - ss_err / ss_tot)
 
     @property
     def explained_variance(self) -> float:
-        return self._ss_reg / self._summary.weight_sum
+        # Spark's SS_reg / N with SS_reg = Σŷ² + ȳ²·N − 2·ȳ·mean(ŷ)·N
+        ss_reg = (
+            self._m2[2]
+            + self._mean[0] ** 2 * self._n
+            - 2 * self._mean[0] * self._mean[2] * self._n
+        )
+        return float(ss_reg / self._n)
 
     def evaluate(self, evaluator: Any) -> float:
-        metric_name = evaluator.getMetricName()
-        if metric_name == "rmse":
+        name = evaluator.getMetricName()
+        if name == "rmse":
             return self.root_mean_squared_error
-        elif metric_name == "mse":
+        if name == "mse":
             return self.mean_squared_error
-        elif metric_name == "r2":
+        if name == "r2":
             return self.r2(evaluator.getThroughOrigin())
-        elif metric_name == "mae":
+        if name == "mae":
             return self.mean_absolute_error
-        elif metric_name == "var":
+        if name == "var":
             return self.explained_variance
-        else:
-            raise ValueError(f"Unsupported metric name, found {metric_name}")
+        raise ValueError(f"Unsupported metric name, found {name}")
