@@ -209,30 +209,6 @@ class _TpuEstimator(Params, _TpuParams):
 
     # ---- streaming decision / data plane --------------------------------
     def _should_stream(self, dataset: DataFrame) -> bool:
-        import jax as _jax
-
-        if _jax.process_count() > 1:
-            # multi-process fits use the resident row-sharded path: chunked
-            # streaming needs a cross-process chunk-count agreement protocol
-            # (unequal local partitions would deadlock the per-chunk psum)
-            if self._streaming:
-                raise NotImplementedError(
-                    "streaming fit is not supported in multi-process mode; "
-                    "use the resident path (streaming=False)"
-                )
-            if (
-                self.hasParam("enable_sparse_data_optim")
-                and self.isDefined("enable_sparse_data_optim")
-                and self.getOrDefault("enable_sparse_data_optim") is True
-            ):
-                # the sparse opt-in IS the chunked-CSR streaming path —
-                # silently densifying would OOM on exactly the inputs the
-                # opt-in exists for
-                raise NotImplementedError(
-                    "enable_sparse_data_optim requires the streaming path, "
-                    "which is not supported in multi-process mode"
-                )
-            return False
         if self._streaming is not None:
             return bool(self._streaming)
         from .data.dataframe import ParquetScanFrame
@@ -258,18 +234,32 @@ class _TpuEstimator(Params, _TpuParams):
                 return True
             n_features = int(col.shape[1]) if col.ndim == 2 or _is_sparse(col) else 1
         itemsize = 4 if self._float32_inputs else 8
-        est_bytes = dataset.count() * n_features * itemsize
+        # GLOBAL row count: the stream-vs-resident decision is a
+        # compile-time constant all ranks must agree on (ranks deciding
+        # differently would issue mismatched collectives and deadlock)
+        est_bytes = global_row_count(dataset.count()) * n_features * itemsize
         return est_bytes > _default_stream_threshold_bytes()
 
     def _pre_process_stream(self, dataset: DataFrame) -> StreamInputs:
+        import jax as _jax
+
         from .data.chunks import (
             ArrayChunkSource,
             CSRChunkSource,
             auto_chunk_rows,
         )
         from .data.dataframe import ParquetScanFrame
+        from .parallel.mesh import local_mesh
 
-        mesh = make_mesh(self.num_workers)
+        if _jax.process_count() > 1:
+            # streaming is partition-local: each process streams its chunks
+            # through its OWN chips; cross-process combination happens at
+            # the sufficient-statistics level (ops/streaming.py allreduces
+            # partials — the reference's per-worker Arrow stream + NCCL
+            # allreduce architecture)
+            mesh = local_mesh()
+        else:
+            mesh = make_mesh(self.num_workers)
         label_col = (
             self.getOrDefault("labelCol") if self._require_label() else None
         )
@@ -320,7 +310,7 @@ class _TpuEstimator(Params, _TpuParams):
         return StreamInputs(
             source=source,
             mesh=mesh,
-            n_rows=int(source.n_rows),
+            n_rows=global_row_count(int(source.n_rows)),
             n_features=int(source.n_features),
             dtype=jnp.dtype(dtype),
             chunk_rows=int(chunk_rows),

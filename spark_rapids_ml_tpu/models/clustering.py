@@ -153,16 +153,52 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
 
     # ---- seeding ---------------------------------------------------------
     # ONE sampling implementation serves both the resident and streaming
-    # fits, parameterized over three data-access primitives — the rng
-    # consumption sequence is part of the contract (same seed => identical
-    # seeding on both paths), so the logic must not fork.
+    # fits, parameterized over a slice "owner" — each rank owns the global
+    # logical rows [offset, offset+n_local) and keeps only O(n_local) host
+    # state. The rng consumption sequence is part of the contract (same
+    # seed => identical seeding on every path and every rank), so the
+    # logic must not fork: uniform draws happen in rank-lockstep segments
+    # (segmented draws of one generator consume the identical stream as a
+    # single full-range draw).
+    #
+    # owner keys:
+    #   offset, n_local — this rank's slice of [0, n_rows)
+    #   gather_local(sorted_local_idx) -> rows of MY slice (host)
+    #   assemble(my_rows) -> all ranks' rows, rank-order (identity when
+    #                        the owner spans the full range)
+    #   min_d2_vs(cands) -> (n_local,) min sq dist of my slice to cands
+    #   reduce_sum(x) -> world sum (identity single-owner)
+    #   count_closest(cands) -> world closest-row counts per candidate
+
+    @staticmethod
+    def _rng_slice(
+        rng: np.random.Generator, n_rows: int, offset: int, n_local: int
+    ) -> np.ndarray:
+        """Lockstep uniforms for [0, n_rows), keeping only this rank's
+        slice."""
+        if offset:
+            rng.random(offset)
+        r = rng.random(n_local)
+        rest = n_rows - offset - n_local
+        if rest:
+            rng.random(rest)
+        return r
+
+    @staticmethod
+    def _gather_global(owner: Dict[str, Any], idx: np.ndarray) -> np.ndarray:
+        """Rows for sorted GLOBAL indices: each rank serves its own hits;
+        rank-order assembly reproduces the sorted order."""
+        idx = np.sort(np.asarray(idx, np.int64))
+        off, nl = owner["offset"], owner["n_local"]
+        mine = idx[(idx >= off) & (idx < off + nl)] - off
+        return owner["assemble"](owner["gather_local"](mine))
 
     @staticmethod
     def _seed_random(
-        n_rows: int, k: int, rng: np.random.Generator, gather: Callable
+        n_rows: int, k: int, rng: np.random.Generator, owner: Dict[str, Any]
     ) -> np.ndarray:
         idx = rng.choice(n_rows, size=k, replace=n_rows < k)
-        return gather(np.sort(idx))
+        return KMeans._gather_global(owner, idx)
 
     @staticmethod
     def _seed_scalable_kmeanspp(
@@ -171,38 +207,42 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
         steps: int,
         oversample: float,
         rng: np.random.Generator,
-        gather: Callable,          # sorted global row idx -> (m, d) host rows
-        min_d2_update: Callable,   # (new_cands, min_d2|None) -> (n_rows,) host
-        count_closest_fn: Callable,  # cands -> (m,) closest-row counts
+        owner: Dict[str, Any],
     ) -> np.ndarray:
         """k-means|| (Bahmani et al.): sample ~l=oversample*k candidates per
         round with prob l*d²/Σd², then reduce candidates to k centers with
         weighted k-means++ on host (the candidate set is tiny)."""
         l = max(int(oversample * k), 1)
+        off, nl = owner["offset"], owner["n_local"]
         first = int(rng.integers(0, n_rows))
-        cands = gather(np.asarray([first]))
-        min_d2 = min_d2_update(cands, None)
+        cands = KMeans._gather_global(owner, np.asarray([first]))
+        local_d2 = np.asarray(owner["min_d2_vs"](cands), np.float64)
         for _ in range(steps):
-            total = float(min_d2.sum())
+            total = float(owner["reduce_sum"](float(local_d2.sum())))
             if total <= 0:
                 break
-            probs = np.minimum(l * min_d2 / total, 1.0)
-            sel = np.nonzero(rng.random(n_rows) < probs)[0]
-            if len(sel) == 0:
+            r = KMeans._rng_slice(rng, n_rows, off, nl)
+            sel = np.nonzero(r < np.minimum(l * local_d2 / total, 1.0))[0]
+            new = owner["assemble"](owner["gather_local"](sel))
+            if len(new) == 0:
                 continue
-            new = gather(sel)
             cands = np.concatenate([cands, new], axis=0)
-            min_d2 = min_d2_update(new, min_d2)
+            local_d2 = np.minimum(
+                local_d2, np.asarray(owner["min_d2_vs"](new), np.float64)
+            )
         if len(cands) < k:
             # not enough candidates — top up with random rows
-            extra = KMeans._seed_random(n_rows, k - len(cands), rng, gather)
+            extra = KMeans._seed_random(n_rows, k - len(cands), rng, owner)
             return np.concatenate([cands, extra], axis=0)
         if len(cands) == k:
             return cands
-        weights = np.asarray(count_closest_fn(cands), np.float64)
+        weights = np.asarray(owner["count_closest"](cands), np.float64)
         return _weighted_kmeanspp(cands.astype(np.float64), weights, k, rng)
 
-    def _resident_seed_prims(self, inputs: FitInputs):
+    def _resident_owner(self, inputs: FitInputs) -> Dict[str, Any]:
+        """Full-range owner: every rank computes identical samples; the
+        device gathers are collective-safe because all ranks issue them
+        with identical arguments."""
         from ..parallel.mesh import fetch_global, gather_rows_global
 
         # seeding addresses "logical valid rows 0..n_rows"; padded-array
@@ -210,21 +250,22 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
         # end single-process but interleaved per-process block multi-host)
         valid_pos = np.nonzero(fetch_global(inputs.mask, inputs.mesh) > 0)[0]
 
-        def gather(idx: np.ndarray) -> np.ndarray:
+        def gather_local(idx: np.ndarray) -> np.ndarray:
+            if len(idx) == 0:
+                return np.empty((0, inputs.n_features), np.float32)
             return gather_rows_global(inputs.X, valid_pos[idx], inputs.mesh)
 
-        def min_d2_update(new: np.ndarray, min_d2):
-            nd = np.asarray(
+        def min_d2_vs(cands: np.ndarray) -> np.ndarray:
+            return np.asarray(
                 fetch_global(
                     min_sq_dists(
-                        inputs.X, inputs.mask, jnp.asarray(new, inputs.dtype),
+                        inputs.X, inputs.mask, jnp.asarray(cands, inputs.dtype),
                         mesh=inputs.mesh, csize=inputs.csize,
                     ),
                     inputs.mesh,
                 ),
                 np.float64,
             )[valid_pos]
-            return nd if min_d2 is None else np.minimum(min_d2, nd)
 
         def count_closest_fn(cands: np.ndarray) -> np.ndarray:
             return fetch_global(
@@ -235,11 +276,18 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
                 inputs.mesh,
             )
 
-        return gather, min_d2_update, count_closest_fn
+        return {
+            "offset": 0,
+            "n_local": inputs.n_rows,
+            "gather_local": gather_local,
+            "assemble": lambda rows: rows,
+            "min_d2_vs": min_d2_vs,
+            "reduce_sum": lambda x: x,
+            "count_closest": count_closest_fn,
+        }
 
     def _init_random(self, inputs: FitInputs, k: int, rng: np.random.Generator) -> np.ndarray:
-        gather, _, _ = self._resident_seed_prims(inputs)
-        return self._seed_random(inputs.n_rows, k, rng, gather)
+        return self._seed_random(inputs.n_rows, k, rng, self._resident_owner(inputs))
 
     def _init_scalable_kmeanspp(
         self,
@@ -249,10 +297,9 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
         oversample: float,
         rng: np.random.Generator,
     ) -> np.ndarray:
-        gather, min_d2_update, count_closest_fn = self._resident_seed_prims(inputs)
         return self._seed_scalable_kmeanspp(
             inputs.n_rows, k, steps, oversample, rng,
-            gather, min_d2_update, count_closest_fn,
+            self._resident_owner(inputs),
         )
 
     # ---- fit -------------------------------------------------------------
@@ -300,39 +347,72 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
             streamed_rows_at,
         )
 
-        def _stream_seed_prims(inputs: StreamInputs):
-            def gather(idx: np.ndarray) -> np.ndarray:
+        def _stream_owner(inputs: StreamInputs) -> Dict[str, Any]:
+            """Slice owner: each rank owns its partition's rows in the
+            process-major global order and keeps only O(local) host state."""
+            import jax as _jax
+
+            from ..parallel.mesh import (
+                allgather_host,
+                allgather_ragged_rows,
+                allreduce_sum_host,
+            )
+
+            nproc = _jax.process_count()
+            offset = 0
+            if nproc > 1:
+                counts = allgather_host(
+                    np.asarray([inputs.source.n_rows])
+                ).ravel().astype(np.int64)
+                offset = int(counts[: _jax.process_index()].sum())
+
+            def gather_local(idx: np.ndarray) -> np.ndarray:
                 return streamed_rows_at(
                     inputs.source, inputs.chunk_rows, idx, inputs.dtype
                 )
 
-            def min_d2_update(new: np.ndarray, min_d2):
+            def min_d2_vs(cands: np.ndarray) -> np.ndarray:
                 return streamed_min_sq_dists_update(
                     inputs.source, inputs.mesh, inputs.chunk_rows, inputs.dtype,
-                    new, min_d2,
+                    cands, None,
                 )
 
             def count_closest_fn(cands: np.ndarray) -> np.ndarray:
-                return streamed_count_closest(
+                local = streamed_count_closest(
                     inputs.source, inputs.mesh, inputs.chunk_rows, inputs.dtype,
                     cands,
                 )
+                (total,) = allreduce_sum_host(local)
+                return total
 
-            return gather, min_d2_update, count_closest_fn
+            return {
+                "offset": offset,
+                "n_local": int(inputs.source.n_rows),
+                "gather_local": gather_local,
+                "assemble": (
+                    allgather_ragged_rows if nproc > 1 else (lambda rows: rows)
+                ),
+                "min_d2_vs": min_d2_vs,
+                "reduce_sum": (
+                    (lambda x: float(allreduce_sum_host(np.asarray([x]))[0][0]))
+                    if nproc > 1
+                    else (lambda x: x)
+                ),
+                "count_closest": count_closest_fn,
+            }
 
         def _fit(inputs: StreamInputs, params: Dict[str, Any]) -> Dict[str, Any]:
             k = int(params["n_clusters"])
             if k > inputs.n_rows:
                 raise ValueError(f"k={k} must be <= number of rows {inputs.n_rows}")
             rng = np.random.default_rng(int(params.get("random_state") or 0))
-            gather, min_d2_update, count_closest_fn = _stream_seed_prims(inputs)
+            owner = _stream_owner(inputs)
             if params.get("init") == "random":
-                centers0 = self._seed_random(inputs.n_rows, k, rng, gather)
+                centers0 = self._seed_random(inputs.n_rows, k, rng, owner)
             else:
                 centers0 = self._seed_scalable_kmeanspp(
                     inputs.n_rows, k, int(params.get("init_steps", 2)),
-                    float(params.get("oversampling_factor", 2.0)), rng,
-                    gather, min_d2_update, count_closest_fn,
+                    float(params.get("oversampling_factor", 2.0)), rng, owner,
                 )
             centers, cost, n_iter = streamed_kmeans_lloyd(
                 inputs.source,

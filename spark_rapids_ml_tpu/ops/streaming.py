@@ -250,6 +250,8 @@ def streamed_suffstats(
     var) / the inputs of ``mean_and_cov`` — so every downstream solver
     (Cholesky OLS/ridge, FISTA elasticnet, eigh PCA) is reused unchanged.
     """
+    from ..parallel.mesh import allreduce_sum_host
+
     d = source.n_features
     np_dtype = np.dtype(jnp.dtype(dtype).name)
 
@@ -258,11 +260,18 @@ def streamed_suffstats(
         dev = put_chunk(chunk, mesh, dtype)
         rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
         acc1 = moments1_step(acc1, dev["X"], rw, dev["y"] if with_y else None)
-    n = acc1["n"]
-    mean_all = acc1["sum_x"] / n
+    # cross-process allreduce of the first-moment partials (the NCCL
+    # allreduce analog; identity single-process)
+    if with_y:
+        n_h, sx_h, sy_h = allreduce_sum_host(acc1["n"], acc1["sum_x"], acc1["sum_y"])
+    else:
+        n_h, sx_h = allreduce_sum_host(acc1["n"], acc1["sum_x"])
+        sy_h = None
+    n = jnp.asarray(n_h, dtype)
+    mean_all = jnp.asarray(sx_h, dtype) / n
     if fit_intercept:
         mean_x = mean_all
-        mean_y = (acc1["sum_y"] / n) if with_y else None
+        mean_y = (jnp.asarray(sy_h, dtype) / n) if with_y else None
     else:
         mean_x = jnp.zeros((d,), dtype)
         mean_y = jnp.zeros((), dtype) if with_y else None
@@ -275,21 +284,27 @@ def streamed_suffstats(
             acc2, dev["X"], rw, mean_x,
             dev["y"] if with_y else None, mean_y,
         )
+    if with_y:
+        G_h, Xy_h, yy_h = allreduce_sum_host(acc2["G"], acc2["Xy"], acc2["yy"])
+    else:
+        (G_h,) = allreduce_sum_host(acc2["G"])
+        Xy_h = yy_h = None
+    G = jnp.asarray(G_h, dtype)
 
-    var = jnp.diagonal(acc2["G"]) / n
+    var = jnp.diagonal(G) / n
     if not fit_intercept:
         var = var - mean_all * mean_all
     stats: Dict[str, jax.Array] = {
         "n": n,
         "mean_x": mean_x,
         "mean_all": mean_all,
-        "G": acc2["G"],
+        "G": G,
         "var": var,
     }
     if with_y:
         stats["mean_y"] = mean_y
-        stats["Xy"] = acc2["Xy"]
-        stats["yy"] = acc2["yy"]
+        stats["Xy"] = jnp.asarray(Xy_h, dtype)
+        stats["yy"] = jnp.asarray(yy_h, dtype)
     return stats
 
 
@@ -321,18 +336,21 @@ def streamed_logreg_fit(
     re-read-per-iteration cost cuML's out-of-core QN pays, reference
     ``classification.py:955-1140``).
     """
+    from ..parallel.mesh import allreduce_sum_host
+
     from .lbfgs import minimize_lbfgs_host
 
     d = source.n_features
     np_dtype = np.dtype(jnp.dtype(dtype).name)
 
-    # pass 1: n + feature means
+    # pass 1: n + feature means (partials allreduced across processes)
     acc1 = moments1_init(d, dtype, with_y=False)
     for chunk in source.iter_chunks(chunk_rows, np_dtype):
         dev = put_chunk(chunk, mesh, dtype)
         acc1 = moments1_step(acc1, dev["X"], dev["mask"])
-    n = float(acc1["n"])
-    mean = acc1["sum_x"] / acc1["n"]
+    n_h, sx_h = allreduce_sum_host(acc1["n"], acc1["sum_x"])
+    n = float(n_h)
+    mean = jnp.asarray(sx_h, dtype) / jnp.asarray(n, dtype)
 
     if standardization:
         # pass 2: diagonal second moment -> unbiased variance (n-1), the
@@ -341,7 +359,8 @@ def streamed_logreg_fit(
         for chunk in source.iter_chunks(chunk_rows, np_dtype):
             dev = put_chunk(chunk, mesh, dtype)
             vacc = var_chunk_step(vacc, dev["X"], dev["mask"], mean)
-        var = vacc / max(n - 1.0, 1.0)
+        (vacc_h,) = allreduce_sum_host(vacc)
+        var = jnp.asarray(vacc_h, dtype) / max(n - 1.0, 1.0)
         std = jnp.sqrt(jnp.maximum(var, 0.0))
         inv_std = jnp.where(std > 0, 1.0 / std, 1.0)
     else:
@@ -364,9 +383,13 @@ def streamed_logreg_fit(
                 n_classes=n_classes, multinomial=multinomial,
                 fit_intercept=fit_intercept, use_center=use_center,
             )
+        # per-evaluation allreduce of (loss, grad) partials — the QN-loop
+        # NCCL allreduce of the reference's distributed L-BFGS; every rank
+        # then takes identical optimizer steps
+        f_h, g_h = allreduce_sum_host(acc["f"], acc["g"])
         coefs = w_np * coef_mask
-        f = float(acc["f"]) / n + 0.5 * l2 * float(coefs @ coefs)
-        g = np.asarray(acc["g"], np.float64) / n + l2 * coefs
+        f = float(f_h) / n + 0.5 * l2 * float(coefs @ coefs)
+        g = np.asarray(g_h, np.float64) / n + l2 * coefs
         return f, g
 
     res = minimize_lbfgs_host(
@@ -412,6 +435,8 @@ def streamed_kmeans_lloyd(
     shift² <= tol², plus a final cost pass at the converged centers.
     Returns (centers, cost, n_iter) as host values.
     """
+    from ..parallel.mesh import allreduce_sum_host
+
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     k, d = centers0.shape
     centers = jnp.asarray(centers0, dtype)
@@ -425,7 +450,12 @@ def streamed_kmeans_lloyd(
         for chunk in source.iter_chunks(chunk_rows, np_dtype):
             dev = put_chunk(chunk, mesh, dtype)
             acc = kmeans_chunk_step(acc, dev["X"], dev["mask"], cts)
-        return acc
+        # per-iteration allreduce of (sums, counts, cost) partials — the
+        # Lloyd-loop NCCL allreduce; every rank then updates identically
+        s_h, c_h, cost_h = allreduce_sum_host(
+            acc["sums"], acc["counts"], acc["cost"]
+        )
+        return {"sums": s_h, "counts": c_h, "cost": cost_h}
 
     it = 0
     prev_shift = np.inf
@@ -452,15 +482,20 @@ def streamed_label_stats(
 ) -> Dict[str, float]:
     """One host pass over the label stream: max/min, integer check, and
     whether all labels are identical — everything the fit needs to pick
-    ``n_classes`` (Spark: max(label)+1) without materializing the dataset."""
+    ``n_classes`` (Spark: max(label)+1) without materializing the dataset.
+    Combined across the process world so every rank agrees."""
+    from ..parallel.mesh import combine_label_summaries
+
     y_max = -np.inf
     y_min = np.inf
     all_int = True
     first = None
     all_same = True
+    n_seen = 0
     for yv in source.iter_labels(chunk_rows):
         if yv.size == 0:
             continue
+        n_seen += yv.size
         y_max = max(y_max, float(yv.max()))
         y_min = min(y_min, float(yv.min()))
         if not np.all(yv == np.floor(yv)):
@@ -469,15 +504,22 @@ def streamed_label_stats(
             first = float(yv[0])
         if not np.all(yv == first):
             all_same = False
-    if first is None:
+
+    local = np.asarray(
+        [
+            0.0 if n_seen else 1.0,
+            y_max,
+            y_min,
+            1.0 if all_int else 0.0,
+            first if first is not None else 0.0,
+            1.0 if all_same else 0.0,
+            float(n_seen),
+        ]
+    )
+    out = combine_label_summaries(local)
+    if out["total"] == 0:
         raise ValueError("Labels column is empty")
-    return {
-        "y_max": y_max,
-        "y_min": y_min,
-        "all_int": all_int,
-        "all_same": all_same,
-        "first": first,
-    }
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -238,6 +238,34 @@ def global_row_count(n_local: int) -> int:
     )
 
 
+def combine_label_summaries(local: np.ndarray) -> Dict[str, Any]:
+    """Allgather + merge per-rank label-summary vectors.
+
+    ``local`` encodes ``[is_empty, max, min, all_int, first, all_same,
+    count]``; one wire format shared by the resident column scan
+    (:func:`global_label_summary`) and the streaming label pass
+    (``ops.streaming.streamed_label_stats``).
+    """
+    g = allgather_host(np.asarray(local))
+    non_empty = g[g[:, 0] == 0.0]
+    if len(non_empty) == 0:
+        return {
+            "y_max": -np.inf, "y_min": np.inf, "all_int": True,
+            "all_same": True, "first": 0.0, "total": 0,
+        }
+    return {
+        "y_max": float(non_empty[:, 1].max()),
+        "y_min": float(non_empty[:, 2].min()),
+        "all_int": bool(np.all(non_empty[:, 3] == 1.0)),
+        "all_same": bool(
+            np.all(non_empty[:, 5] == 1.0)
+            and np.all(non_empty[:, 4] == non_empty[0, 4])
+        ),
+        "first": float(non_empty[0, 4]),
+        "total": int(g[:, 6].sum()),
+    }
+
+
 def global_label_summary(y_local: np.ndarray) -> Dict[str, Any]:
     """World-wide label statistics from per-process label columns.
 
@@ -259,24 +287,7 @@ def global_label_summary(y_local: np.ndarray) -> Dict[str, Any]:
             float(y_local.size),
         ]
     )
-    g = allgather_host(local)
-    non_empty = g[g[:, 0] == 0.0]
-    if len(non_empty) == 0:
-        return {
-            "y_max": -np.inf, "y_min": np.inf, "all_int": True,
-            "all_same": True, "first": 0.0, "total": 0,
-        }
-    return {
-        "y_max": float(non_empty[:, 1].max()),
-        "y_min": float(non_empty[:, 2].min()),
-        "all_int": bool(np.all(non_empty[:, 3] == 1.0)),
-        "all_same": bool(
-            np.all(non_empty[:, 5] == 1.0)
-            and np.all(non_empty[:, 4] == non_empty[0, 4])
-        ),
-        "first": float(non_empty[0, 4]),
-        "total": int(g[:, 6].sum()),
-    }
+    return combine_label_summaries(local)
 
 
 def allgather_host(vals: np.ndarray) -> np.ndarray:
@@ -290,6 +301,41 @@ def allgather_host(vals: np.ndarray) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(vals))
+
+
+def local_mesh(mp: int = 1) -> Mesh:
+    """A mesh over THIS process's devices only.
+
+    The streaming data plane is partition-local (each worker streams its
+    chunks through its own chips, like each reference barrier task streams
+    its Arrow batches through its GPU); cross-process combination happens
+    at the sufficient-statistics level via :func:`allreduce_sum_host`.
+    """
+    devs = jax.local_devices()
+    n_dp = max(1, len(devs) // mp)
+    return Mesh(np.asarray(devs[: n_dp * mp]).reshape(n_dp, mp), (DP_AXIS, MP_AXIS))
+
+
+def allreduce_sum_host(*arrays: Any) -> Tuple[np.ndarray, ...]:
+    """Elementwise-sum each array across the process world (host path).
+
+    The explicit allreduce of per-partition partials — exactly the role
+    NCCL allreduce played inside cuML's MG fit. Single-process: identity.
+    Sums in float64 for exactness; returns each result in its input dtype.
+    """
+    if jax.process_count() <= 1:
+        return tuple(np.asarray(a) for a in arrays)
+    parts = [np.asarray(a) for a in arrays]
+    flat = np.concatenate([p.astype(np.float64).ravel() for p in parts])
+    total = allgather_host(flat).sum(axis=0)
+    out = []
+    off = 0
+    for p in parts:
+        out.append(
+            total[off : off + p.size].reshape(p.shape).astype(p.dtype)
+        )
+        off += p.size
+    return tuple(out)
 
 
 def allgather_ragged_rows(a: np.ndarray) -> np.ndarray:

@@ -284,3 +284,109 @@ def test_two_process_knn_exact(tmp_path):
                 q.kill()
             raise
         assert p.returncode == 0, f"knn worker failed:\n{stdout[-3000:]}"
+
+
+_STREAM_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    import numpy as np
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    from spark_rapids_ml_tpu.data import DataFrame
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.regression import LinearRegression
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    pid = int(os.environ["TPUML_PROC_ID"])
+    rng = np.random.default_rng(42)
+    X = (rng.normal(size=(357, 7)) + 2.0).astype(np.float32)
+    w = rng.normal(size=(7,))
+    yr = (X @ w + 0.5).astype(np.float32)
+    yc = (X @ w > 14.0).astype(np.float32)
+    sl = slice(0, 200) if pid == 0 else slice(200, None)
+
+    kw = dict(streaming=True, stream_chunk_rows=64)
+    pca = PCA(k=3, **kw).fit(DataFrame({{"features": X[sl]}}))
+    lin = LinearRegression(regParam=0.01, **kw).fit(
+        DataFrame({{"features": X[sl], "label": yr[sl]}}))
+    log = LogisticRegression(regParam=0.01, **kw).fit(
+        DataFrame({{"features": X[sl], "label": yc[sl]}}))
+    km = KMeans(k=3, seed=5, maxIter=25, **kw).fit(DataFrame({{"features": X[sl]}}))
+    if pid == 0:
+        np.savez(
+            os.environ["TPUML_TEST_OUT"],
+            pca=np.asarray(pca.components_),
+            lin=np.asarray(lin.coefficients),
+            log=np.asarray(log.coefficientMatrix),
+            km_cost=km.trainingCost,
+        )
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_streaming_matches_single_process(tmp_path):
+    """Out-of-core fits across processes: each rank streams ITS partition
+    through its own chips; sufficient-statistic partials allreduce — the
+    reference's per-worker Arrow stream + NCCL allreduce architecture."""
+    out = str(tmp_path / "stream.npz")
+    script = tmp_path / "stream_worker.py"
+    script.write_text(_STREAM_WORKER.format(repo=REPO))
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            TPUML_COORDINATOR=coord,
+            TPUML_NUM_PROCS="2",
+            TPUML_PROC_ID=str(pid),
+            TPUML_TEST_OUT=out,
+            JAX_PLATFORMS="cpu",
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+        )
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"stream worker failed:\n{stdout[-3000:]}"
+
+    res = np.load(out)
+    rng = np.random.default_rng(42)
+    X = (rng.normal(size=(357, 7)) + 2.0).astype(np.float32)
+    w = rng.normal(size=(7,))
+    yr = (X @ w + 0.5).astype(np.float32)
+    yc = (X @ w > 14.0).astype(np.float32)
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.data import DataFrame
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    kw = dict(streaming=True, stream_chunk_rows=64)
+    pca = PCA(k=3, **kw).fit(DataFrame({"features": X}))
+    lin = LinearRegression(regParam=0.01, **kw).fit(
+        DataFrame({"features": X, "label": yr}))
+    log = LogisticRegression(regParam=0.01, **kw).fit(
+        DataFrame({"features": X, "label": yc}))
+    km = KMeans(k=3, seed=5, maxIter=25, **kw).fit(DataFrame({"features": X}))
+
+    np.testing.assert_allclose(res["pca"], np.asarray(pca.components_), atol=2e-4)
+    np.testing.assert_allclose(
+        res["lin"], np.asarray(lin.coefficients), rtol=5e-3, atol=5e-4
+    )
+    np.testing.assert_allclose(
+        res["log"], np.asarray(log.coefficientMatrix), rtol=2e-2, atol=2e-3
+    )
+    np.testing.assert_allclose(float(res["km_cost"]), km.trainingCost, rtol=2e-2)
