@@ -271,16 +271,33 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
     def exactNearestNeighborsJoin(
         self, query_df: DataFrame, distCol: str = "distCol"
     ) -> DataFrame:
-        if jax.process_count() > 1:
-            # a query's neighbors may be items owned by other ranks; joining
-            # full item rows across processes needs a distributed shuffle —
-            # use kneighbors (ids + distances are fully supported) instead
-            raise NotImplementedError(
-                "exactNearestNeighborsJoin is not supported in multi-process "
-                "mode; use kneighbors and join on the returned ids"
-            )
         id_col = self.getIdCol()
+        if jax.process_count() > 1:
+            # fail fast, before the (expensive) distributed search: the
+            # item-table gather below needs fixed-width numeric columns
+            probe = self._ensureIdCol(self._item_df_withid)
+            for c in probe.columns:
+                if not np.issubdtype(np.asarray(probe.column(c)).dtype, np.number):
+                    raise NotImplementedError(
+                        f"multi-process exactNearestNeighborsJoin requires "
+                        f"numeric item columns (got non-numeric column {c!r})"
+                    )
         item_df_withid, query_df_withid, knn_df = self.kneighbors(query_df)
+        if jax.process_count() > 1:
+            # a query's neighbors may be items owned by other ranks: gather
+            # the item table so every rank can join its own queries' rows
+            # (host memory O(global items) — the reference pays a Spark
+            # shuffle here instead, ``knn.py:655-668``). Byte-exact gather:
+            # a jax-array gather would canonicalize int64/float64 to 32-bit
+            from ..parallel.mesh import allgather_ragged_rows_exact
+
+            gathered: Dict[str, Any] = {
+                c: allgather_ragged_rows_exact(
+                    np.asarray(item_df_withid.column(c))
+                )
+                for c in item_df_withid.columns
+            }
+            item_df_withid = DataFrame(gathered)
         k = self.getK()
 
         query_ids = np.asarray(knn_df.column(f"query_{id_col}"))

@@ -350,6 +350,20 @@ def allgather_ragged_rows(a: np.ndarray) -> np.ndarray:
     return np.concatenate([gathered[p][: counts[p]] for p in range(len(counts))])
 
 
+def allgather_ragged_rows_exact(a: np.ndarray) -> np.ndarray:
+    """Dtype-exact ragged row gather: moves raw bytes (the plain gather
+    rides jax arrays, which canonicalize int64/float64 to 32-bit when x64
+    is off) and views them back as the input dtype."""
+    a = np.ascontiguousarray(a)
+    row_shape = a.shape[1:]
+    flat = a.reshape(a.shape[0], -1)
+    as_bytes = flat.view(np.uint8).reshape(a.shape[0], -1)
+    g = allgather_ragged_rows(as_bytes)
+    return (
+        np.ascontiguousarray(g).view(a.dtype).reshape((len(g),) + row_shape)
+    )
+
+
 def local_row_block(arr: jax.Array) -> np.ndarray:
     """This process's rows of a row-sharded array, assembled from its
     addressable shards in row order — no collective, and no assumption

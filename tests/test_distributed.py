@@ -248,6 +248,14 @@ _KNN_WORKER = textwrap.dedent(
     exp_d = np.sqrt(np.take_along_axis(d2, exp_idx, 1))
     assert np.allclose(np.sort(dists, 1), np.sort(exp_d, 1), atol=1e-4)
     assert (np.sort(idxs, 1) == np.sort(exp_idx, 1)).all()
+
+    # exactNearestNeighborsJoin: every joined pair's distance must equal
+    # the true pair distance even when the item row lives on the other rank
+    out = m.exactNearestNeighborsJoin(DataFrame({{"features": Xq[qsl]}}), distCol="d")
+    dj = np.asarray(out.column("d"))
+    qf = np.asarray(out.column("query_features"))
+    itf = np.asarray(out.column("item_features"))
+    assert np.allclose(dj, np.sqrt(((qf - itf) ** 2).sum(1)), atol=1e-4)
     print(f"rank {{pid}} ok", flush=True)
     """
 )
