@@ -17,8 +17,25 @@ the reference's benchmark hardware (BASELINE.md: 2x g5.2xlarge, A10G 24 GB):
   iter at 600 GB/s A10G HBM -> 600e9 / (2*256*4) ~= 2.9e8
   sample-iters/sec/GPU.
 
+Measurement methodology (this environment reaches the chip through a remote
+tunnel with a ~65 ms per-dispatch round trip and ~30 MB/s host->device
+bandwidth — both properties of the tunnel, not the chip):
+
+* data is generated ON DEVICE with ``jax.random`` (a host-side 4 GB matrix
+  would take minutes just to ship through the tunnel);
+* every timed rep is exactly ONE jitted call returning ONE small array (a
+  scalar checksum over all output leaves + an aux counter), so per-rep
+  overhead is one round trip instead of one per output leaf;
+* per-rep input perturbations are materialized BEFORE the clock starts —
+  identical (executable, buffers) pairs may be memoized by a remote backend,
+  which would report physically impossible times (observed round 1);
+* the streaming (out-of-core) number necessarily measures host->device
+  ingest, i.e. the tunnel, so it is reported but EXCLUDED from the geomean
+  and flagged ``tunnel_bound``.
+
 Headline metric stays ``pca_fit_throughput`` (round-1 continuity); the same
-JSON line carries ``kmeans``/``logreg`` sub-objects and per-algo MFU.
+JSON line carries ``kmeans``/``logreg``/``pca_stream`` sub-objects and
+per-algo MFU.
 
 Robustness (round-1 postmortem): any algo failing with a transient
 ``UNAVAILABLE`` TPU backend error is retried once after a cooldown; partial
@@ -49,13 +66,18 @@ for _i, _a in enumerate(sys.argv[1:], start=1):
         _platform = _a.split("=", 1)[1]
 pin_platform(_platform)
 
-N_ROWS = int(os.environ.get("BENCH_ROWS", 4_000_000))
+N_ROWS = int(os.environ.get("BENCH_ROWS", 12_000_000))
 N_COLS = int(os.environ.get("BENCH_COLS", 256))
 KMEANS_K = int(os.environ.get("BENCH_KMEANS_K", 1024))
 KMEANS_ITERS = 10
 LOGREG_ITERS = 20
+
+
 def _csize(n_rows: int) -> int:
-    return min(16384, max(256, n_rows // 8))
+    # 64k rows/chunk keeps the (chunk, k) distance + one-hot tiles ~0.5 GB
+    # so a ~12 GB resident X still fits v5e HBM; tiles this tall keep the
+    # MXU contraction saturated
+    return min(65_536, max(256, n_rows // 8))
 
 
 CSIZE = _csize(N_ROWS)
@@ -82,46 +104,61 @@ def _chip_peak_flops(device) -> float:
     return _CPU_PEAK
 
 
-def _fetch(out) -> float:
-    """Force full materialization on the host.
+def _checksum(out, aux=None):
+    """Reduce an output pytree to ONE tiny array (inside jit).
 
-    ``block_until_ready`` alone is not trustworthy through a remote-tunnel
-    backend (observed: identical executions "complete" in 0.1 ms, implying
-    server-side memoization or lazy futures). Summing one leaf to a Python
-    float forces the computation and a device->host round trip.
+    Summing every leaf forces the whole computation; returning a single
+    2-vector makes the host fetch a single round trip (the tunnel charges
+    ~65 ms per fetched leaf otherwise).
     """
     import jax
     import jax.numpy as jnp
 
-    leaves = jax.tree_util.tree_leaves(out)
-    acc = 0.0
-    for leaf in leaves:
-        acc += float(jnp.sum(jnp.asarray(leaf).astype(jnp.float32)))
-    return acc
+    acc = jnp.float32(0.0)
+    for leaf in jax.tree_util.tree_leaves(out):
+        acc = acc + jnp.sum(jnp.asarray(leaf).astype(jnp.float32))
+    return jnp.stack([acc, jnp.float32(0.0 if aux is None else aux)])
 
 
-def _best_time(fn, reps: int = 3) -> float:
-    """min-of-reps wall time of ``fn(rep_index)``.
+def _best_time(make_args, run, reps: int = 3):
+    """(min wall time, aux from first rep) of ``run(*make_args(rep))``.
 
-    ``fn`` takes the rep index so callers can perturb inputs per rep —
-    identical (executable, buffers) pairs may be memoized by a remote
-    backend, which would report physically impossible times.
+    Per-rep argument sets are materialized and blocked on BEFORE timing so
+    the clock sees exactly one dispatch + one 2-scalar fetch per rep.
     """
-    times = []
-    for rep in range(reps):
+    import jax
+
+    argsets = [make_args(rep) for rep in range(reps)]
+    for a in argsets:
+        jax.block_until_ready(a)
+    times, aux = [], 0.0
+    for i, a in enumerate(argsets):
         t0 = time.perf_counter()
-        _fetch(fn(rep))
+        out = np.asarray(run(*a))
         times.append(time.perf_counter() - t0)
-    return min(times)
+        if i == 0:
+            aux = float(out[1])
+    return min(times), aux
 
 
 def bench_pca(X, mask, mesh, n_chips):
+    import jax
     import jax.numpy as jnp
 
     from spark_rapids_ml_tpu.models.feature import _pca_fit_kernel
 
-    # per-rep mask perturbation -> distinct input buffers (see _best_time)
-    t = _best_time(lambda rep: _pca_fit_kernel(X, mask * jnp.float32(1.0 + rep * 1e-6), 3))
+    timed = jax.jit(
+        lambda X, m: _checksum(
+            _pca_fit_kernel(X, m, 3, mesh=mesh, csize=CSIZE)
+        )
+    )
+    np.asarray(timed(X, mask))  # compile
+    # rep+1: never reuse the warmup's input values (memoizable on remote
+    # backends); each rep gets a distinct perturbed mask buffer
+    t, _ = _best_time(
+        lambda rep: (X, mask * jnp.float32(1.0 + (rep + 1) * 1e-6)),
+        timed,
+    )
     n = N_ROWS
     flops = 2.0 * n * N_COLS * N_COLS  # Gram dominates
     return {
@@ -138,22 +175,25 @@ def bench_kmeans(X, mask, mesh, n_chips):
 
     from spark_rapids_ml_tpu.ops.kmeans_kernels import kmeans_lloyd
 
-    rng = np.random.default_rng(1)
-    centers0 = jax.device_put(
-        rng.standard_normal((KMEANS_K, N_COLS), dtype=np.float32)
-    )
+    key = jax.random.key(1)
+    centers0 = jax.random.normal(key, (KMEANS_K, N_COLS), dtype=jnp.float32)
+    jax.block_until_ready(centers0)
     csize = CSIZE
 
-    def run(rep):
-        return kmeans_lloyd(
-            X, mask, centers0 + jnp.float32(rep * 1e-6), mesh=mesh, csize=csize,
-            max_iter=KMEANS_ITERS, tol=0.0,
+    def timed_fn(X, m, c):
+        out = kmeans_lloyd(
+            X, m, c, mesh=mesh, csize=csize, max_iter=KMEANS_ITERS, tol=0.0
         )
+        return _checksum(out, aux=out[2])
 
-    out = run(0)  # compile + read the actual iteration count
-    iters = int(np.asarray(out[2])) + 1  # +1 final cost pass
-    # rep+1: never reuse the warmup's inputs (memoizable on remote backends)
-    t = _best_time(lambda rep: run(rep + 1))
+    timed = jax.jit(timed_fn)
+    warm = np.asarray(timed(X, mask, centers0))  # compile + iteration count
+    iters = int(warm[1]) + 1  # +1 final cost pass
+    # rep-dependent center jitter -> distinct input buffers (see _best_time)
+    t, _ = _best_time(
+        lambda rep: (X, mask, centers0 + jnp.float32((rep + 1) * 1e-6)),
+        timed,
+    )
     # FLOPs are spent on padded rows; throughput counts real samples only
     flops = 2.0 * X.shape[0] * KMEANS_K * N_COLS * iters
     n = N_ROWS
@@ -167,24 +207,29 @@ def bench_kmeans(X, mask, mesh, n_chips):
 
 
 def bench_logreg(X, mask, y, mesh, n_chips):
+    import jax
     import jax.numpy as jnp
 
     from spark_rapids_ml_tpu.ops.logreg_kernels import logreg_fit
 
-    def run(rep):
-        # rep-dependent l2 -> distinct scalar input buffer (see _best_time)
-        return logreg_fit(
-            X, mask, y,
+    def timed_fn(X, m, y, l2):
+        out = logreg_fit(
+            X, m, y,
             n_classes=2, multinomial=False, fit_intercept=True,
             standardization=False,
-            l1=jnp.float32(0.0), l2=jnp.float32(1e-5 * (1.0 + rep * 1e-3)),
+            l1=jnp.float32(0.0), l2=l2,
             use_l1=False, max_iter=LOGREG_ITERS, tol=jnp.float32(0.0),
         )
+        return _checksum(out, aux=out["n_iter"])
 
-    out = run(0)  # compile + get n_iter
-    iters = max(int(out["n_iter"]), 1)
-    # rep+1: never reuse the warmup's inputs (memoizable on remote backends)
-    t = _best_time(lambda rep: run(rep + 1))
+    timed = jax.jit(timed_fn)
+    warm = np.asarray(timed(X, mask, y, jnp.float32(1e-5)))  # compile
+    iters = max(int(warm[1]), 1)
+    # rep-dependent l2 -> distinct scalar input buffer (see _best_time)
+    t, _ = _best_time(
+        lambda rep: (X, mask, y, jnp.float32(1e-5 * (1.0 + (rep + 1) * 1e-3))),
+        timed,
+    )
     n = N_ROWS
     # ~2 objective evals/iter (step + line search), fwd+grad = 4*n*d each
     flops = 8.0 * n * N_COLS * iters
@@ -202,7 +247,11 @@ def bench_pca_stream(mesh, n_chips):
     (``ops/streaming.py``), the path that handles beyond-HBM datasets
     (BASELINE.md 100M x 256 north-star). Self-calibrates the row count so a
     slow host->device link cannot blow the wall-clock budget; the reported
-    rate is per-pass ingest+accumulate throughput (2 passes per fit)."""
+    rate is per-pass ingest+accumulate throughput (2 passes per fit).
+
+    Through a remote tunnel this measures the TUNNEL's ~30 MB/s, not the
+    chip's PCIe/DMA ingest; callers should treat it as a correctness-at-
+    scale check there (it is excluded from the headline geomean)."""
     import jax
 
     from spark_rapids_ml_tpu.data.chunks import GeneratorChunkSource
@@ -224,7 +273,12 @@ def bench_pca_stream(mesh, n_chips):
         stats = streamed_suffstats(src, mesh, chunk_rows, np.float32, with_y=False)
         cov = stats["G"] / (stats["n"] - 1.0)
         out = _pca_from_cov(stats["mean_x"], cov, stats["n"], 3)
-        _fetch(out)
+        # force a device->host fetch of every (small) leaf: block_until_ready
+        # alone is not trustworthy through a remote tunnel (lazy futures
+        # observed round 1), and the calibration scales the real run's row
+        # count off this timer
+        for leaf in jax.tree_util.tree_leaves(out):
+            np.asarray(leaf)
         return out
 
     # calibrate: compile + measure a 4-chunk fit, then size the real run
@@ -233,7 +287,7 @@ def bench_pca_stream(mesh, n_chips):
     t0 = time.perf_counter()
     run(calib_rows)
     t_calib = time.perf_counter() - t0
-    budget_s = float(os.environ.get("BENCH_STREAM_SECONDS", 60))
+    budget_s = float(os.environ.get("BENCH_STREAM_SECONDS", 45))
     max_rows = int(os.environ.get("BENCH_STREAM_ROWS", 16_000_000))
     rows = int(min(max_rows, calib_rows * max(1.0, budget_s / max(t_calib, 1e-9))))
     rows = max(chunk_rows, (rows // chunk_rows) * chunk_rows)
@@ -249,6 +303,7 @@ def bench_pca_stream(mesh, n_chips):
         "stream_gb": round(rows * d * 4 * 2 / 1e9, 2),  # 2 passes
         "flops_model": flops,
         "baseline_samples_per_sec": 1.1e8,
+        "tunnel_bound": True,
     }
 
 
@@ -296,6 +351,8 @@ def main() -> None:
     if not tpu_ok:
         pin_platform("cpu")
     import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     devices = jax.devices()
     n_chips = len(devices)
@@ -312,19 +369,47 @@ def main() -> None:
             file=sys.stderr,
         )
 
-    from spark_rapids_ml_tpu.parallel.mesh import make_mesh, shard_rows
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(n_chips)
-    rng = np.random.default_rng(0)
-    Xh = rng.standard_normal((N_ROWS, N_COLS), dtype=np.float32)
-    w_true = rng.standard_normal((N_COLS,), dtype=np.float32)
-    yh = (Xh @ w_true > 0).astype(np.float32)
-
     csize = CSIZE
-    X, mask = shard_rows(Xh, mesh, row_multiple=csize)
-    y, _ = shard_rows(yh, mesh, row_multiple=csize)
+    n_dp = mesh.shape["dp"]
+    pad_unit = csize * n_dp
+    n_pad = ((N_ROWS + pad_unit - 1) // pad_unit) * pad_unit
+
+    # Generate the design matrix ON DEVICE (host gen + device_put would pay
+    # the tunnel's ~30 MB/s: minutes for gigabytes). Padded rows get random
+    # values and a zero mask — kernels mask them out.
+    row_sharding = NamedSharding(mesh, P("dp"))
+    w_true = jnp.asarray(
+        np.random.default_rng(0).standard_normal(N_COLS, dtype=np.float32)
+    )
+
+    # chunked generation: random.normal over the full matrix would hold the
+    # uint32 bit buffer AND the f32 output at once (2x matrix bytes — OOM
+    # for a ~12 GB X on a 16 GiB chip); a scan emits rows chunk-by-chunk
+    # directly into the stacked output so only one chunk of bits is live
+    n_gen_chunks = n_pad // pad_unit
+
+    def _gen(key, w):
+        from jax import lax
+
+        def body(_, k):
+            return None, jax.random.normal(
+                k, (pad_unit, N_COLS), dtype=jnp.float32
+            )
+
+        _, Xs = lax.scan(body, None, jax.random.split(key, n_gen_chunks))
+        X = Xs.reshape(n_pad, N_COLS)
+        mask = (jnp.arange(n_pad) < N_ROWS).astype(jnp.float32)
+        y = (X @ w > 0).astype(jnp.float32) * mask
+        return X, mask, y
+
+    gen = jax.jit(
+        _gen, out_shardings=(row_sharding, row_sharding, row_sharding)
+    )
+    X, mask, y = gen(jax.random.key(0), w_true)
     jax.block_until_ready(X)
-    del Xh, yh
 
     runs = {
         "pca": lambda: bench_pca(X, mask, mesh, n_chips),
@@ -374,8 +459,14 @@ def main() -> None:
         print("[bench] all algorithms failed; no metric to report", file=sys.stderr)
         sys.exit(1)
 
-    vs = [r["vs_baseline"] for r in results.values()]
-    geomean_vs = math.exp(sum(math.log(v) for v in vs) / len(vs))
+    # tunnel-bound entries (host->device ingest via the remote tunnel)
+    # measure the link, not the chip — keep them out of the geomean
+    vs = [
+        r["vs_baseline"]
+        for r in results.values()
+        if not r.get("tunnel_bound")
+    ] or [r["vs_baseline"] for r in results.values()]
+    geomean_vs = math.exp(sum(math.log(max(v, 1e-12)) for v in vs) / len(vs))
     headline = results.get("pca") or next(iter(results.values()))
     line = {
         "metric": "pca_fit_throughput",
@@ -399,6 +490,8 @@ def main() -> None:
             "mfu": round(r["mfu"], 4),
             "vs_baseline": round(r["vs_baseline"], 3),
         }
+        if r.get("tunnel_bound"):
+            line[name]["tunnel_bound"] = True
     print(json.dumps(line))
 
 

@@ -329,6 +329,14 @@ class _TpuEstimator(Params, _TpuParams):
         override (rows are padded so each shard is a multiple of this)."""
         return 1
 
+    @staticmethod
+    def _equal_chunk_rows(n_rows: int, n_dp: int, cap: int) -> int:
+        """Smallest chunk <= cap that divides each device's shard into equal
+        pieces: bounds padding to < n_chunks rows/device (vs up to cap-1)."""
+        per_dev = max(1, -(-n_rows // n_dp))
+        n_chunks = -(-per_dev // cap)
+        return -(-per_dev // n_chunks)
+
     def _pre_process_data(self, dataset: DataFrame) -> FitInputs:
         X, X_sparse = _resolve_feature_matrix(self, dataset)
         mesh = make_mesh(self.num_workers)

@@ -145,11 +145,7 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
         return self
 
     def _chunk_rows(self, n_rows: int, n_dp: int) -> int:
-        # smallest chunk <= _CHUNK that divides the shard into equal pieces:
-        # bounds padding to < n_chunks rows/device (vs up to csize-1)
-        per_dev = max(1, -(-n_rows // n_dp))
-        n_chunks = -(-per_dev // _CHUNK)
-        return -(-per_dev // n_chunks)
+        return self._equal_chunk_rows(n_rows, n_dp, _CHUNK)
 
     # ---- seeding ---------------------------------------------------------
     # ONE sampling implementation serves both the resident and streaming

@@ -39,7 +39,8 @@ from ..params import (
     TypeConverters,
     _mk,
 )
-from ..ops.linalg import mean_and_cov, topk_eigh
+from ..ops.linalg import mean_and_cov, mean_and_cov_chunked, topk_eigh
+from ..parallel.mesh import DP_AXIS
 
 
 class PCAClass:
@@ -86,9 +87,23 @@ def _pca_from_cov(mean: jax.Array, cov: jax.Array, n: jax.Array, k: int):
     }
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def _pca_fit_kernel(X: jax.Array, mask: jax.Array, k: int):
-    mean, cov, n = mean_and_cov(X, mask)
+@functools.partial(jax.jit, static_argnames=("k", "mesh", "csize"))
+def _pca_fit_kernel(X: jax.Array, mask: jax.Array, k: int, mesh=None, csize=None):
+    """Resident-fit kernel. With ``mesh``/``csize`` (rows dp-sharded, padded
+    to a per-device ``csize`` multiple) the covariance is accumulated in
+    row-chunk scans with O(csize·d) temporaries — at double-digit-GB row
+    counts the fused form can materialize the centered copy of X and OOM;
+    without them (e.g. 2-D (dp, mp)-sharded dry runs) the fused global-math
+    path is used."""
+    if (
+        mesh is not None
+        and csize
+        and csize > 1
+        and X.shape[0] % (csize * mesh.shape[DP_AXIS]) == 0
+    ):
+        mean, cov, n = mean_and_cov_chunked(X, mask, mesh, csize)
+    else:
+        mean, cov, n = mean_and_cov(X, mask)
     return _pca_from_cov(mean, cov, n, k)
 
 
@@ -112,6 +127,12 @@ class PCA(PCAClass, _TpuEstimator, _PCAParams):
         self._set_params(outputCol=value)
         return self
 
+    def _chunk_rows(self, n_rows: int, n_dp: int) -> int:
+        # route resident fits through the chunked covariance scan: 64k-row
+        # chunks keep temporaries O(chunk·d) so a near-HBM-sized X cannot
+        # OOM on the centered copy (see mean_and_cov_chunked)
+        return self._equal_chunk_rows(n_rows, n_dp, 65_536)
+
     def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
         def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
             k = int(params.get("n_components") or self.getK())
@@ -119,7 +140,9 @@ class PCA(PCAClass, _TpuEstimator, _PCAParams):
                 raise ValueError(
                     f"k={k} must be <= number of features {inputs.n_features}"
                 )
-            out = _pca_fit_kernel(inputs.X, inputs.mask, k)
+            out = _pca_fit_kernel(
+                inputs.X, inputs.mask, k, mesh=inputs.mesh, csize=inputs.csize
+            )
             return {key: np.asarray(v) for key, v in out.items()}
 
         return _fit

@@ -356,8 +356,11 @@ def allgather_ragged_rows_exact(a: np.ndarray) -> np.ndarray:
     is off) and views them back as the input dtype."""
     a = np.ascontiguousarray(a)
     row_shape = a.shape[1:]
-    flat = a.reshape(a.shape[0], -1)
-    as_bytes = flat.view(np.uint8).reshape(a.shape[0], -1)
+    # explicit widths, not -1: reshape(-1) is ambiguous for 0-row inputs
+    # (a rank with an empty partition must still join the collective)
+    row_elems = int(np.prod(row_shape, dtype=np.int64)) if row_shape else 1
+    flat = a.reshape(a.shape[0], row_elems)
+    as_bytes = flat.view(np.uint8).reshape(a.shape[0], row_elems * a.itemsize)
     g = allgather_ragged_rows(as_bytes)
     return (
         np.ascontiguousarray(g).view(a.dtype).reshape((len(g),) + row_shape)
