@@ -337,6 +337,20 @@ class _TpuEstimator(Params, _TpuParams):
         n_chunks = -(-per_dev // cap)
         return -(-per_dev // n_chunks)
 
+    @staticmethod
+    def rows_chunkable(n_padded_rows: int, mesh: Any, csize: int) -> bool:
+        """True when a row-sharded array of ``n_padded_rows`` can take a
+        chunked-scan kernel path: a real chunk size and per-device rows
+        divisible by it (the ``shard_rows`` padding invariant). Single
+        source of truth for the gate used by PCA/LinearRegression fits."""
+        from .parallel.mesh import DP_AXIS
+
+        return (
+            csize is not None
+            and csize > 1
+            and n_padded_rows % (csize * mesh.shape[DP_AXIS]) == 0
+        )
+
     def _pre_process_data(self, dataset: DataFrame) -> FitInputs:
         X, X_sparse = _resolve_feature_matrix(self, dataset)
         mesh = make_mesh(self.num_workers)

@@ -40,7 +40,6 @@ from ..params import (
     _mk,
 )
 from ..ops.linalg import mean_and_cov, mean_and_cov_chunked, topk_eigh
-from ..parallel.mesh import DP_AXIS
 
 
 class PCAClass:
@@ -95,11 +94,8 @@ def _pca_fit_kernel(X: jax.Array, mask: jax.Array, k: int, mesh=None, csize=None
     counts the fused form can materialize the centered copy of X and OOM;
     without them (e.g. 2-D (dp, mp)-sharded dry runs) the fused global-math
     path is used."""
-    if (
-        mesh is not None
-        and csize
-        and csize > 1
-        and X.shape[0] % (csize * mesh.shape[DP_AXIS]) == 0
+    if mesh is not None and _TpuEstimator.rows_chunkable(
+        X.shape[0], mesh, csize
     ):
         mean, cov, n = mean_and_cov_chunked(X, mask, mesh, csize)
     else:

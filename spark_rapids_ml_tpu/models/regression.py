@@ -44,7 +44,12 @@ from ..params import (
     TypeConverters,
     _mk,
 )
-from ..ops.linreg_kernels import linreg_suffstats, solve_elasticnet, solve_normal
+from ..ops.linreg_kernels import (
+    linreg_suffstats,
+    linreg_suffstats_chunked,
+    solve_elasticnet,
+    solve_normal,
+)
 
 
 class LinearRegressionClass:
@@ -197,6 +202,12 @@ class LinearRegression(
             "n_iter": n_iter,
         }
 
+    def _chunk_rows(self, n_rows: int, n_dp: int) -> int:
+        # route resident fits through the chunked suffstats scan: bounds
+        # temporaries to O(chunk·d) so a near-HBM-sized X cannot OOM on the
+        # centered √w-scaled copy (see linreg_suffstats_chunked)
+        return self._equal_chunk_rows(n_rows, n_dp, 65_536)
+
     def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
         stats_cache: Dict[bool, Dict[str, jax.Array]] = {}
 
@@ -204,10 +215,19 @@ class LinearRegression(
             fit_intercept = bool(params["fit_intercept"])
             if fit_intercept not in stats_cache:
                 # the single data pass — shared by every param map
-                stats_cache[fit_intercept] = linreg_suffstats(
-                    inputs.X, inputs.mask, inputs.y, inputs.weight,
-                    fit_intercept=fit_intercept,
-                )
+                csize = inputs.csize
+                if self.rows_chunkable(inputs.X.shape[0], inputs.mesh, csize):
+                    stats_cache[fit_intercept] = linreg_suffstats_chunked(
+                        inputs.X, inputs.mask, inputs.y, inputs.weight,
+                        mesh=inputs.mesh, csize=csize,
+                        fit_intercept=fit_intercept,
+                        weighted=inputs.weight is not None,
+                    )
+                else:
+                    stats_cache[fit_intercept] = linreg_suffstats(
+                        inputs.X, inputs.mask, inputs.y, inputs.weight,
+                        fit_intercept=fit_intercept,
+                    )
             return self._solve_from_stats(
                 stats_cache[fit_intercept], params, inputs.dtype
             )

@@ -70,6 +70,129 @@ def linreg_suffstats(
     }
 
 
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "csize", "fit_intercept", "weighted")
+)
+def linreg_suffstats_chunked(
+    X: jax.Array,
+    mask: jax.Array,
+    y: jax.Array,
+    row_w: Optional[jax.Array] = None,
+    *,
+    mesh,
+    csize: int,
+    fit_intercept: bool = True,
+    weighted: bool = False,
+) -> Dict[str, jax.Array]:
+    """:func:`linreg_suffstats` with O(csize·d) temporaries and one pass.
+
+    Same memory/stability design as ``ops.linalg.mean_and_cov_chunked``: the
+    fused form can materialize the centered √w-scaled copy of X at
+    double-digit-GB row counts and OOM; here each device scans fixed
+    ``csize`` row chunks, accumulating statistics shifted by a mean
+    *estimate* (from the device's leading rows, one cheap psum), and exact
+    rank-1 corrections re-center at the true weighted means. With
+    ``fit_intercept=False`` the solver statistics (G, Xy, yy) accumulate
+    uncentered for parity with the resident path, while the penalty
+    variance still uses the shifted accumulator — stable where the
+    resident ``E[x²] - mean²`` form cancels catastrophically for |μ| ≫ σ.
+
+    Requires per-device rows divisible by ``csize``; rows sharded over dp.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DP_AXIS
+
+    if not weighted:
+        row_w = None
+
+    def per_device(Xl, ml, yl, *rw):
+        d = Xl.shape[1]
+        wl = ml if not rw else ml * rw[0]
+
+        # mean estimate from each device's leading rows — shifts the
+        # sum/variance accumulators ALWAYS (stable var even in the
+        # uncentered fit), and the G/Xy/yy accumulators only when the fit
+        # centers (fit_intercept); uncentered solver statistics must stay
+        # uncentered for parity
+        e = min(csize, Xl.shape[0])
+        w0 = wl[:e]
+        sx0 = lax.psum((Xl[:e] * w0[:, None]).sum(axis=0), DP_AXIS)
+        sy0 = lax.psum((yl[:e] * w0).sum(), DP_AXIS)
+        c0 = jnp.maximum(lax.psum(w0.sum(), DP_AXIS), 1.0)
+        mu_x, mu_y = sx0 / c0, sy0 / c0
+
+        nc = Xl.shape[0] // csize
+        chunks = (
+            Xl.reshape(nc, csize, d),
+            wl.reshape(nc, csize),
+            yl.reshape(nc, csize),
+        )
+
+        def body(carry, chunk):
+            sx, sy, vs, W, G, Xy, yy = carry
+            x, w, yv = chunk
+            sqw = jnp.sqrt(w)
+            xd = x - mu_x[None, :]
+            xs = (xd if fit_intercept else x) * sqw[:, None]
+            ys = ((yv - mu_y) if fit_intercept else yv) * sqw
+            xdw = xd * sqw[:, None]
+            return (
+                sx + (xdw * sqw[:, None]).sum(axis=0),  # Σ w (x-μ̂x)
+                sy + ((yv - mu_y) * w).sum(),           # Σ w (y-μ̂y)
+                vs + (xdw * xdw).sum(axis=0),           # Σ w (x-μ̂x)²
+                W + w.sum(),
+                G + xs.T @ xs,
+                Xy + xs.T @ ys,
+                yy + (ys * ys).sum(),
+            ), None
+
+        zero = functools.partial(jnp.zeros, dtype=Xl.dtype)
+        (sx, sy, vs, W, G, Xy, yy), _ = lax.scan(
+            body,
+            (
+                zero((d,)), zero(()), zero((d,)), zero(()),
+                zero((d, d)), zero((d,)), zero(()),
+            ),
+            chunks,
+        )
+        sx = lax.psum(sx, DP_AXIS)
+        sy = lax.psum(sy, DP_AXIS)
+        vs = lax.psum(vs, DP_AXIS)
+        n = lax.psum(W, DP_AXIS)
+        G = lax.psum(G, DP_AXIS)
+        Xy = lax.psum(Xy, DP_AXIS)
+        yy = lax.psum(yy, DP_AXIS)
+
+        dx, dy = sx / n, sy / n
+        var = vs / n - dx * dx             # shifted: stable for any |μ|
+        if fit_intercept:
+            # re-center the shifted statistics at the true weighted means
+            G = G - n * jnp.outer(dx, dx)
+            Xy = Xy - n * dx * dy
+            yy = yy - n * dy * dy
+            mean_x, mean_y = mu_x + dx, mu_y + dy
+        else:
+            mean_x = jnp.zeros((d,), Xl.dtype)
+            mean_y = jnp.asarray(0.0, Xl.dtype)
+        return n, mean_x, mean_y, G, Xy, yy, var
+
+    args = (X, mask, y) + ((row_w,) if row_w is not None else ())
+    in_specs = (P(DP_AXIS),) * len(args)
+    n, mean_x, mean_y, G, Xy, yy, var = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(P(),) * 7,
+        check_vma=False,
+    )(*args)
+    return {
+        "n": n, "mean_x": mean_x, "mean_y": mean_y,
+        "G": G, "Xy": Xy, "yy": yy, "var": var,
+    }
+
+
 def _to_standardized(stats: Dict[str, jax.Array], standardization: bool):
     """Scale the quadratic system into standardized-coefficient space."""
     std = jnp.sqrt(jnp.maximum(stats["var"], 0.0))

@@ -244,3 +244,51 @@ def test_ridge_no_intercept_centered_std_scaling():
     A = X.T @ X / n + lam * np.diag(sd**2)
     beta = np.linalg.solve(A, X.T @ y / n)
     np.testing.assert_allclose(model.coefficients, beta, atol=1e-5)
+
+
+@pytest.mark.parametrize("fit_intercept", [True, False])
+@pytest.mark.parametrize("weighted", [True, False])
+def test_chunked_suffstats_match_f64_oracle(fit_intercept, weighted):
+    """The chunked (shifted, O(csize) memory) suffstats path must agree with
+    an f64 oracle — including the |mean| >> sigma regime where a naive
+    one-pass (and, for the variance, the uncentered E[x^2] - mean^2 form)
+    catastrophically cancels in f32."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_ml_tpu.ops.linreg_kernels import linreg_suffstats_chunked
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(0)
+    n, d, csize = 8 * 3 * 16, 5, 16
+    X = (rng.normal(size=(n, d)) + 1e4).astype(np.float32)
+    y = (X @ rng.normal(size=d) * 1e-4 + rng.normal(size=n)).astype(np.float32)
+    mask = (np.arange(n) < n - 29).astype(np.float32)
+    w = rng.uniform(0.5, 2.0, size=n).astype(np.float32) if weighted else None
+
+    put = lambda a: jax.device_put(a, NamedSharding(mesh, P("dp")))
+    stats = linreg_suffstats_chunked(
+        put(X), put(mask), put(y), put(w) if weighted else None,
+        mesh=mesh, csize=csize, fit_intercept=fit_intercept, weighted=weighted,
+    )
+
+    X64, y64 = X.astype(np.float64), y.astype(np.float64)
+    wv = mask.astype(np.float64) * (w if weighted else 1.0)
+    W = wv.sum()
+    mean_all = (X64 * wv[:, None]).sum(0) / W
+    mx = mean_all if fit_intercept else np.zeros(d)
+    my = (y64 * wv).sum() / W if fit_intercept else 0.0
+    Xc = (X64 - mx) * np.sqrt(wv)[:, None]
+    yc = (y64 - my) * np.sqrt(wv)
+    oracle = {
+        "n": W, "mean_x": mx, "mean_y": my,
+        "G": Xc.T @ Xc, "Xy": Xc.T @ yc, "yy": (yc * yc).sum(),
+        "var": ((X64 - mean_all) ** 2 * wv[:, None]).sum(0) / W,
+    }
+    for k, ref in oracle.items():
+        got = np.asarray(stats[k], np.float64)
+        scale = max(np.abs(np.asarray(ref)).max(), 1e-12)
+        # uncentered G/Xy/yy at mu=1e4 are inherently large-magnitude f32 sums
+        tol = 5e-5 if (fit_intercept or k in ("n", "mean_x", "mean_y", "var")) else 5e-4
+        assert np.abs(got - ref).max() / scale < tol, (k, fit_intercept, weighted)
