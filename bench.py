@@ -219,6 +219,7 @@ def bench_logreg(X, mask, y, mesh, n_chips):
             standardization=False,
             l1=jnp.float32(0.0), l2=l2,
             use_l1=False, max_iter=LOGREG_ITERS, tol=jnp.float32(0.0),
+            mesh=mesh,
         )
         return _checksum(out, aux=out["n_iter"])
 

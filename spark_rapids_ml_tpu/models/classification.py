@@ -242,6 +242,9 @@ class LogisticRegression(
                 use_l1=reg * l1_ratio > 0.0,
                 max_iter=int(params["max_iter"]),
                 tol=jnp.asarray(float(params["tol"]), inputs.dtype),
+                # rows are dp-sharded by _pre_process_data: lets the TPU
+                # path use the fused Pallas loss+grad pass
+                mesh=inputs.mesh,
             )
             return {
                 "coef_": np.asarray(out["coef_"]),
