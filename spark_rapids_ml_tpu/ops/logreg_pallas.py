@@ -39,9 +39,7 @@ _LANES = 128
 FORCE_INTERPRET = False
 
 
-def _row_tile(d: int) -> int:
-    """~8 MB f32 row tiles (double-buffered by the pipeline)."""
-    return max(256, (2_097_152 // d) // 8 * 8)
+from .linalg import _pallas_gram_tile as _row_tile  # same tile sizing
 
 
 def logreg_pallas_ok(d: int, n_classes: int, dtype) -> bool:
@@ -148,7 +146,9 @@ def _loss_grad_pallas(Xl, yl, ml, A, b_row, *, multinomial: bool,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
-            vmem_limit_bytes=64 * 1024 * 1024,
+            # 16 MB double-buffered row tiles + the lane-packed (tile, 128)
+            # loss/residual block push scoped VMEM to ~78 MB (v5e has 128)
+            vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
     )(Xl, yl, ml, A, b_row)

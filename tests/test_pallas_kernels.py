@@ -129,7 +129,15 @@ def test_logreg_fit_fused_branch_matches_xla(monkeypatch):
 
     monkeypatch.setattr(logreg_pallas, "FORCE_INTERPRET", True)
     assert logreg_pallas.logreg_pallas_ok(d, 1, jnp.float32)
-    fused = logreg_fit(Xd, md, yd, mesh=mesh, **kw)
+    # FORCE_INTERPRET is read at trace time but is not part of the jit
+    # cache key: drop cached executables so this call really traces (and
+    # runs) the fused branch, and again afterwards so no interpreted
+    # executable leaks into later same-signature calls
+    jax.clear_caches()
+    try:
+        fused = logreg_fit(Xd, md, yd, mesh=mesh, **kw)
+    finally:
+        jax.clear_caches()
 
     cr = np.asarray(ref["coef_"])
     cf = np.asarray(fused["coef_"])
