@@ -131,8 +131,8 @@ def _pallas_gram_ok(d: int, dtype) -> bool:
     """Trace-time gate for the Pallas gram path: TPU backend, lane-aligned
     feature width, f32 (the kernel accumulates in f32; f64 fits keep the
     scan path). d is capped so the d×d VMEM accumulator plus double-buffered
-    row blocks stay under the kernel's 64 MB VMEM budget — wider fits route
-    to the scan path, which handles any d."""
+    16 MB row blocks stay under the kernel's 100 MB VMEM budget — wider
+    fits route to the scan path, which handles any d."""
     return (
         jax.default_backend() == "tpu"
         and d % 128 == 0

@@ -39,7 +39,16 @@ _LANES = 128
 FORCE_INTERPRET = False
 
 
-from .linalg import _pallas_gram_tile as _row_tile  # same tile sizing
+from .linalg import _pallas_gram_tile
+
+
+def _row_tile(d: int, Kp: int) -> int:
+    """Row-tile size: the gram kernel's sizing, shrunk when the padded
+    class count is large — multinomial materializes several (tile, Kp)
+    intermediates (logits, softmax, residuals, one-hot, the packed
+    loss/residual block), which at small d and many classes would
+    otherwise dominate scoped VMEM."""
+    return _pallas_gram_tile(max(d, 6 * Kp))
 
 
 def logreg_pallas_ok(d: int, n_classes: int, dtype) -> bool:
@@ -168,7 +177,7 @@ def make_fused_data_loss(X, y, mask, mesh, K: int, multinomial: bool,
         interpret = FORCE_INTERPRET
     d = X.shape[1]
     Kp = max(8, -(-K // 8) * 8)
-    tile = _row_tile(d)
+    tile = _row_tile(d, Kp)
 
     def run(Aeff, beff):
         A = jnp.zeros((Kp, d), jnp.float32).at[:K].set(Aeff)

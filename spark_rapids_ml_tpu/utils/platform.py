@@ -60,6 +60,34 @@ def pin_platform(
 
     import jax
 
+    if platform == "tpu":
+        # TPU plugins register under varying platform names ("tpu" on Cloud
+        # TPU VMs, tunnel plugins under their own name, marked experimental
+        # and therefore excluded from automatic selection) — a literal
+        # jax_platforms="tpu" pin fails where the plugin's name differs.
+        # "Run on the accelerator" means: keep whatever non-cpu platform the
+        # environment names, priority-first; with none named, clear the pin
+        # and let jax's default pick the registered plugin.
+        if backend_initialized():
+            if jax.local_devices()[0].platform == "cpu":
+                raise RuntimeError(
+                    "pin_platform('tpu') called after the cpu backend was "
+                    "initialized; pin before the first jax.devices()/array op"
+                )
+            return
+        # Pin ONLY accelerator names — never append cpu. The environment
+        # pins JAX_PLATFORMS=<plugin> precisely so that a failed plugin
+        # init raises loudly instead of silently falling back to CPU and
+        # reporting CPU numbers as TPU results; preserve that property.
+        env = os.environ.get("JAX_PLATFORMS") or ""
+        accel = [
+            p for p in (s.strip() for s in env.split(",")) if p and p != "cpu"
+        ]
+        pin = ",".join(accel) if accel else "tpu"
+        os.environ["JAX_PLATFORMS"] = pin
+        jax.config.update("jax_platforms", pin)
+        return
+
     if backend_initialized():
         current = jax.local_devices()[0].platform
         if current != platform:
