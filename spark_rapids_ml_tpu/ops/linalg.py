@@ -45,6 +45,13 @@ def mean_and_cov(X: jax.Array, mask: jax.Array) -> Tuple[jax.Array, jax.Array, j
     cov = (Xc.T @ Xc) / (n - 1.0)
     return mean, cov, n
 
+# Test hook (mirrors ops.logreg_pallas.FORCE_INTERPRET): when True,
+# _pallas_gram_ok ignores the backend check and the kernels run through the
+# Pallas interpreter, letting CPU CI exercise the real kernel branches
+# inside the fit paths.
+FORCE_INTERPRET = False
+
+
 def _pallas_gram_tile(d: int) -> int:
     """Row-tile size for :func:`_shifted_gram_pallas`: ~16 MB of f32 per
     block (double-buffered by the pipeline) regardless of feature width,
@@ -59,7 +66,7 @@ def _shifted_gram_pallas(
     mean_hat: jax.Array,
     *,
     tile: int | None = None,
-    interpret: bool = False,
+    interpret: bool | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Pallas TPU kernel: one pass over local rows accumulating the shifted
     Gram ``Σ m·(x-μ̂)(x-μ̂)ᵀ`` and row-sum ``Σ m·(x-μ̂)``.
@@ -77,6 +84,8 @@ def _shifted_gram_pallas(
     n, d = Xl.shape
     if tile is None:
         tile = _pallas_gram_tile(d)
+    if interpret is None:
+        interpret = FORCE_INTERPRET
 
     def kern(x_ref, m_ref, mu_ref, G_ref, s_ref):
         i = pl.program_id(0)
@@ -134,7 +143,7 @@ def _pallas_gram_ok(d: int, dtype) -> bool:
     16 MB row blocks stay under the kernel's 100 MB VMEM budget — wider
     fits route to the scan path, which handles any d."""
     return (
-        jax.default_backend() == "tpu"
+        (jax.default_backend() == "tpu" or FORCE_INTERPRET)
         and d % 128 == 0
         and d <= 2048
         and dtype == jnp.float32

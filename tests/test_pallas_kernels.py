@@ -151,3 +151,36 @@ def test_logreg_pallas_gate_rejects_overwide_class_packing():
 
     assert not logreg_pallas_ok(256, 121, jnp.float32)
     assert not logreg_pallas_ok(256, 127, jnp.float32)
+
+
+def test_mean_and_cov_chunked_pallas_branch_matches_scan(monkeypatch):
+    """Run the REAL Pallas branch inside mean_and_cov_chunked (gate ->
+    shard_map -> kernel -> rank-1 correction) via the interpret override
+    and require parity with the scan branch."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_ml_tpu.ops import linalg
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(4)
+    n, d, csize = 8 * 3 * 16, 128, 16
+    X = (rng.normal(size=(n, d)) + 100.0).astype(np.float32)
+    mask = (np.arange(n) < n - 19).astype(np.float32)
+    put = lambda a: jax.device_put(a, NamedSharding(mesh, P("dp")))
+    Xd, md = put(X), put(mask)
+
+    m1, c1, n1 = linalg.mean_and_cov_chunked(Xd, md, mesh, csize)
+
+    monkeypatch.setattr(linalg, "FORCE_INTERPRET", True)
+    assert linalg._pallas_gram_ok(d, jnp.float32)
+    jax.clear_caches()  # FORCE_INTERPRET is read at trace time, not cached
+    try:
+        m2, c2, n2 = linalg.mean_and_cov_chunked(Xd, md, mesh, csize)
+    finally:
+        jax.clear_caches()
+
+    assert float(n1) == float(n2)
+    assert np.abs(np.asarray(m1) - np.asarray(m2)).max() < 1e-3
+    scale = np.abs(np.asarray(c1)).max()
+    assert np.abs(np.asarray(c1) - np.asarray(c2)).max() / scale < 1e-4
