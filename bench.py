@@ -388,20 +388,25 @@ def main() -> None:
 
     # chunked generation: random.normal over the full matrix would hold the
     # uint32 bit buffer AND the f32 output at once (2x matrix bytes — OOM
-    # for a ~12 GB X on a 16 GiB chip); a scan emits rows chunk-by-chunk
-    # directly into the stacked output so only one chunk of bits is live
+    # for a ~12 GB X on a 16 GiB chip). Generate chunk-by-chunk into a
+    # preallocated buffer via dynamic_update_slice (aliased in-place by
+    # XLA) — NOT by reshaping a lax.scan's stacked output, whose exotic
+    # layout forces downstream shard_map kernels to materialize a
+    # default-layout copy of the whole matrix (observed OOM at d=3000)
     n_gen_chunks = n_pad // pad_unit
 
     def _gen(key, w):
         from jax import lax
 
-        def body(_, k):
-            return None, jax.random.normal(
-                k, (pad_unit, N_COLS), dtype=jnp.float32
+        def body(i, X):
+            blk = jax.random.normal(
+                jax.random.fold_in(key, i), (pad_unit, N_COLS), jnp.float32
             )
+            return lax.dynamic_update_slice_in_dim(X, blk, i * pad_unit, 0)
 
-        _, Xs = lax.scan(body, None, jax.random.split(key, n_gen_chunks))
-        X = Xs.reshape(n_pad, N_COLS)
+        X = lax.fori_loop(
+            0, n_gen_chunks, body, jnp.zeros((n_pad, N_COLS), jnp.float32)
+        )
         mask = (jnp.arange(n_pad) < N_ROWS).astype(jnp.float32)
         y = (X @ w > 0).astype(jnp.float32) * mask
         return X, mask, y

@@ -4,9 +4,10 @@ TPU-native replacement for cuML's ``KMeansMG.fit`` (reference
 ``/root/reference/python/src/spark_rapids_ml/clustering.py:340-378``; cuML
 does NCCL allreduce of centroid partials per iteration). Here:
 
-* rows are dp-sharded; each device scans its rows in fixed-size chunks
-  (``lax.scan``) so the (chunk, k) distance tile and the one-hot
-  accumulation matmuls stay MXU-shaped and HBM-bounded regardless of n;
+* rows are dp-sharded; each device walks its rows in fixed-size chunks
+  (``fori_loop`` + in-place ``dynamic_slice`` — see ``ops.linalg.row_chunk``)
+  so the (chunk, k) distance tile and the one-hot accumulation matmuls stay
+  MXU-shaped and HBM-bounded regardless of n;
 * per-iteration partials (sums (k,d), counts (k,), cost) are combined with
   ``lax.psum`` over the dp axis — the explicit ICI collective;
 * the Lloyd loop is a ``lax.while_loop`` (movement < tol or maxIter), so
@@ -25,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..parallel.mesh import DP_AXIS
+from .linalg import check_row_chunking, row_chunk
 
 
 def pairwise_sq_dists(x: jax.Array, centers: jax.Array, c_sq: jax.Array | None = None) -> jax.Array:
@@ -41,17 +43,19 @@ def pairwise_sq_dists(x: jax.Array, centers: jax.Array, c_sq: jax.Array | None =
 
 
 def _chunk_stats(X_local, mask_local, centers, csize: int):
-    """Scan local rows in chunks; return (sums (k,d), counts int32 (k,), cost)."""
+    """Chunked pass over local rows; returns (sums (k,d), counts int32 (k,),
+    cost).
+
+    Chunks are read with :func:`ops.linalg.row_chunk` (NOT a lax.scan over
+    a reshaped X — see its docstring for the layout-repack hazard)."""
     k = centers.shape[0]
     d = X_local.shape[1]
-    n_chunks = X_local.shape[0] // csize
-    Xc = X_local.reshape(n_chunks, csize, d)
-    Mc = mask_local.reshape(n_chunks, csize)
+    n_chunks = check_row_chunking(X_local.shape[0], csize)
     c_sq = (centers * centers).sum(axis=1)  # (k,)
 
-    def body(carry, chunk):
+    def body(i, carry):
         sums, counts, cost = carry
-        x, m = chunk
+        x, m = row_chunk(i, csize, X_local, mask_local)
         d2 = pairwise_sq_dists(x, centers, c_sq)
         assign = jnp.argmin(d2, axis=1)
         onehot = jax.nn.one_hot(assign, k, dtype=x.dtype) * m[:, None]
@@ -60,15 +64,14 @@ def _chunk_stats(X_local, mask_local, centers, csize: int):
         # cluster's count passes 2^24 (realistic at ~1e8 rows/device)
         counts = counts + onehot.sum(axis=0).astype(jnp.int32)
         cost = cost + (jnp.min(d2, axis=1) * m).sum()
-        return (sums, counts, cost), None
+        return (sums, counts, cost)
 
     init = (
         jnp.zeros((k, d), dtype=X_local.dtype),
         jnp.zeros((k,), dtype=jnp.int32),
         jnp.zeros((), dtype=X_local.dtype),
     )
-    (sums, counts, cost), _ = lax.scan(body, init, (Xc, Mc))
-    return sums, counts, cost
+    return lax.fori_loop(0, n_chunks, body, init)
 
 
 @functools.partial(
@@ -87,30 +90,54 @@ def kmeans_lloyd(
     """Run Lloyd to convergence. Returns (centers, cost, n_iters)."""
 
     def per_device(X_local, mask_local, centers):
+        # The cost-at-final-centers pass is folded into the while loop as a
+        # terminal no-update iteration: if X were also read AFTER the loop,
+        # XLA's buffer analysis duplicates the full design matrix
+        # (copy(X) observed at 1M×3000 — 12 GB, an instant OOM); with all
+        # reads inside one loop the parameter buffer is shared.
+        # state: (centers, prev_shift, n_done_iters, cost, phase) with
+        # phase 0 = iterating, 1 = final cost-only pass pending, 2 = done.
         def cond(state):
-            centers, prev_shift, it, cost = state
-            return jnp.logical_and(it < max_iter, prev_shift > tol * tol)
+            _, _, _, _, phase = state
+            return phase < 2
 
         def body(state):
-            centers, _, it, _ = state
+            centers, prev_shift, it, _, phase = state
             sums, counts, cost = _chunk_stats(X_local, mask_local, centers, csize)
             sums = lax.psum(sums, DP_AXIS)
             counts = lax.psum(counts, DP_AXIS)
             cost = lax.psum(cost, DP_AXIS)
+            is_final = phase == 1
             # empty cluster keeps its previous center (Spark behavior)
             countsf = counts.astype(sums.dtype)
             safe = jnp.maximum(countsf, 1.0)
-            new_centers = jnp.where(
+            updated = jnp.where(
                 counts[:, None] > 0, sums / safe[:, None], centers
             )
-            shift = ((new_centers - centers) ** 2).sum(axis=1).max()
-            return (new_centers, shift, it + 1, cost)
+            new_centers = jnp.where(is_final, centers, updated)
+            shift = jnp.where(
+                is_final,
+                prev_shift,
+                ((updated - centers) ** 2).sum(axis=1).max(),
+            )
+            it_next = jnp.where(is_final, it, it + 1)
+            converged = jnp.logical_or(
+                it_next >= max_iter, shift <= tol * tol
+            )
+            phase_next = jnp.where(
+                is_final, 2, jnp.where(converged, 1, 0)
+            )
+            return (new_centers, shift, it_next, cost, phase_next)
 
-        state = (centers, jnp.asarray(jnp.inf, X_local.dtype), jnp.asarray(0), jnp.asarray(0.0, X_local.dtype))
-        centers, _, it, _ = lax.while_loop(cond, body, state)
-        # final pass: cost at converged centers
-        _, _, cost = _chunk_stats(X_local, mask_local, centers, csize)
-        cost = lax.psum(cost, DP_AXIS)
+        state = (
+            centers,
+            jnp.asarray(jnp.inf, X_local.dtype),
+            jnp.asarray(0),
+            jnp.asarray(0.0, X_local.dtype),
+            # max_iter == 0: no updates — go straight to the cost-only pass
+            jnp.asarray(0 if max_iter > 0 else 1),
+        )
+        centers, _, it, cost, _ = lax.while_loop(cond, body, state)
         return centers, cost, it
 
     return shard_map(
@@ -133,13 +160,13 @@ def min_sq_dists(
 
     def per_device(X_local, mask_local, centers):
         c_sq = (centers * centers).sum(axis=1)
-        n_chunks = X_local.shape[0] // csize
-        Xc = X_local.reshape(n_chunks, csize, X_local.shape[1])
+        n_chunks = check_row_chunking(X_local.shape[0], csize)
 
-        def body(_, x):
+        def body(_, i):
+            (x,) = row_chunk(i, csize, X_local)
             return None, pairwise_sq_dists(x, centers, c_sq).min(axis=1)
 
-        _, md = lax.scan(body, None, Xc)
+        _, md = lax.scan(body, None, jnp.arange(n_chunks))
         return md.reshape(-1) * mask_local
 
     return shard_map(

@@ -52,6 +52,36 @@ def mean_and_cov(X: jax.Array, mask: jax.Array) -> Tuple[jax.Array, jax.Array, j
 FORCE_INTERPRET = False
 
 
+def row_chunk(i, csize: int, *arrays):
+    """Rows ``[i*csize, (i+1)*csize)`` of each array, sliced along axis 0.
+
+    The canonical chunk access for every chunked-scan kernel. Slice with
+    ``dynamic_slice`` — do NOT ``lax.scan`` over a reshaped X: scan
+    materializes its xs operand in the layout the loop body's matmuls
+    prefer, which at lane-unaligned d (e.g. 3000) is a full transposed
+    copy of the design matrix — doubling memory and OOMing resident fits
+    that otherwise fit (observed at 1M×3000 on v5e). Slicing reads the
+    original buffer in place.
+
+    Use :func:`check_row_chunking` at kernel entry so a non-divisible row
+    count fails loudly at trace time instead of silently dropping the tail.
+    """
+    return tuple(
+        lax.dynamic_slice_in_dim(a, i * csize, csize, 0) for a in arrays
+    )
+
+
+def check_row_chunking(n_rows: int, csize: int) -> int:
+    """Trace-time guard: rows must split into whole ``csize`` chunks
+    (``shard_rows`` pads to this). Returns the chunk count."""
+    if n_rows % csize != 0:
+        raise ValueError(
+            f"chunked kernel requires rows ({n_rows}) divisible by the "
+            f"chunk size ({csize}); pad with shard_rows first"
+        )
+    return n_rows // csize
+
+
 def _pallas_gram_tile(d: int) -> int:
     """Row-tile size for :func:`_shifted_gram_pallas`: ~16 MB of f32 per
     block (double-buffered by the pipeline) regardless of feature width,
@@ -194,24 +224,23 @@ def mean_and_cov_chunked(
             G, s = _shifted_gram_pallas(Xl, ml, mean_hat)
             cnt = ml.sum()
         else:
-            nc = Xl.shape[0] // csize
-            Xc = Xl.reshape(nc, csize, d)
-            Mc = ml.reshape(nc, csize)
+            nc = check_row_chunking(Xl.shape[0], csize)
 
-            def body(carry, chunk):
+            def body(i, carry):
                 s, cnt, G = carry
-                x, m = chunk
+                x, m = row_chunk(i, csize, Xl, ml)
                 xs = (x - mean_hat[None, :]) * m[:, None]
-                return (s + xs.sum(axis=0), cnt + m.sum(), G + xs.T @ xs), None
+                return (s + xs.sum(axis=0), cnt + m.sum(), G + xs.T @ xs)
 
-            (s, cnt, G), _ = lax.scan(
+            s, cnt, G = lax.fori_loop(
+                0,
+                nc,
                 body,
                 (
                     jnp.zeros((d,), Xl.dtype),
                     jnp.zeros((), Xl.dtype),
                     jnp.zeros((d, d), Xl.dtype),
                 ),
-                (Xc, Mc),
             )
         n = lax.psum(cnt, DP_AXIS)
         s = lax.psum(s, DP_AXIS)

@@ -111,6 +111,7 @@ def linreg_suffstats_chunked(
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DP_AXIS
+    from .linalg import check_row_chunking, row_chunk
 
     if not weighted:
         row_w = None
@@ -131,16 +132,11 @@ def linreg_suffstats_chunked(
         c0 = jnp.maximum(lax.psum(w0.sum(), DP_AXIS), 1.0)
         mu_x, mu_y = sx0 / c0, sy0 / c0
 
-        nc = Xl.shape[0] // csize
-        chunks = (
-            Xl.reshape(nc, csize, d),
-            wl.reshape(nc, csize),
-            yl.reshape(nc, csize),
-        )
+        nc = check_row_chunking(Xl.shape[0], csize)
 
-        def body(carry, chunk):
+        def body(i, carry):
             sx, sy, vs, W, G, Xy, yy = carry
-            x, w, yv = chunk
+            x, w, yv = row_chunk(i, csize, Xl, wl, yl)
             sqw = jnp.sqrt(w)
             xd = x - mu_x[None, :]
             xs = (xd if fit_intercept else x) * sqw[:, None]
@@ -154,16 +150,17 @@ def linreg_suffstats_chunked(
                 G + xs.T @ xs,
                 Xy + xs.T @ ys,
                 yy + (ys * ys).sum(),
-            ), None
+            )
 
         zero = functools.partial(jnp.zeros, dtype=Xl.dtype)
-        (sx, sy, vs, W, G, Xy, yy), _ = lax.scan(
+        sx, sy, vs, W, G, Xy, yy = lax.fori_loop(
+            0,
+            nc,
             body,
             (
                 zero((d,)), zero(()), zero((d,)), zero(()),
                 zero((d, d)), zero((d,)), zero(()),
             ),
-            chunks,
         )
         sx = lax.psum(sx, DP_AXIS)
         sy = lax.psum(sy, DP_AXIS)
