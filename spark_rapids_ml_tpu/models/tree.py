@@ -344,19 +344,38 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
                 min_samples_split=int(params.get("min_samples_split", 2)),
                 bootstrap=bool(params["bootstrap"]),
             )
-            out = build_forest(bins, inputs.mask, stats, keys, mesh=inputs.mesh, cfg=cfg)
+            # bound trees per dispatch: the whole group builds inside ONE
+            # device program (lax.map over trees), and a multi-minute
+            # single dispatch can outlive remote-runtime health checks
+            # (observed: 50 deep trees in one call crashed the worker
+            # where 8-tree calls succeed); groups also amortize compiles
+            group = min(t_local, 8)
+            # per key: list of host arrays shaped (n_dp, group_size, ...)
+            pieces: Dict[str, List[np.ndarray]] = {}
+            for g0 in range(0, t_local, group):
+                kg = keys[:, g0 : min(g0 + group, t_local)]
+                gsz = kg.shape[1]
+                outg = build_forest(
+                    bins, inputs.mask, stats, kg, mesh=inputs.mesh, cfg=cfg
+                )
+                for k, a in outg.items():
+                    h = fetch_global(a, inputs.mesh)
+                    pieces.setdefault(k, []).append(
+                        h.reshape(n_dp, gsz, *h.shape[1:])
+                    )
 
             # interleave device-major -> tree-major so the slice to n_trees
             # takes trees evenly from every device
-            def _gather(a: jax.Array) -> np.ndarray:
-                a = fetch_global(a, inputs.mesh)
-                shaped = a.reshape(n_dp, t_local, *a.shape[1:])
-                return np.swapaxes(shaped, 0, 1).reshape(-1, *a.shape[1:])[:n_trees]
+            def _gather(key: str) -> np.ndarray:
+                a = np.concatenate(pieces[key], axis=1)  # (n_dp, t_local, ...)
+                return np.swapaxes(a, 0, 1).reshape(
+                    -1, *a.shape[2:]
+                )[:n_trees]
 
-            feat = _gather(out["feature"])
-            thr_bin = _gather(out["threshold_bin"])
-            leaf_stats = _gather(out["leaf_stats"])
-            gains = _gather(out["gain"])
+            feat = _gather("feature")
+            thr_bin = _gather("threshold_bin")
+            leaf_stats = _gather("leaf_stats")
+            gains = _gather("gain")
 
             # bin thresholds -> raw feature-space values (x >= thr -> right)
             thr = np.where(

@@ -210,12 +210,34 @@ def _build_tree(
             ids = jnp.where(
                 in_level[:, None], local[:, None] * nb + binc, n_nodes * nb
             )
-            hist = jax.vmap(
-                lambda col: jax.ops.segment_sum(
-                    sw, col, num_segments=n_nodes * nb + 1
-                ),
-                in_axes=1,
-            )(ids)                                   # (F, n_nodes*nb+1, S)
+            # Small S (regression stats, binary/few-class): one scalar
+            # segment_sum per stat column — vmapping the (n, S) operand
+            # broadcasts it to (F, n, S) with the tiny S minor dim
+            # lane-padded S -> 128 on TPU, a 64x memory expansion at S=2
+            # (16 GB observed at n=131k, F=256); per-stat 1-D operands
+            # keep the broadcast at (F, n), lane-aligned. Wide S (many
+            # classes): padding overhead fades (<= 8x at S >= 16) and S
+            # unrolled scatters would dominate — keep one (n, S) scatter.
+            if S <= 16:
+                hist = jnp.stack(
+                    [
+                        jax.vmap(
+                            lambda col, c=sw[:, s]: jax.ops.segment_sum(
+                                c, col, num_segments=n_nodes * nb + 1
+                            ),
+                            in_axes=1,
+                        )(ids)                       # (F, n_nodes*nb+1)
+                        for s in range(S)
+                    ],
+                    axis=-1,
+                )                                    # (F, n_nodes*nb+1, S)
+            else:
+                hist = jax.vmap(
+                    lambda col: jax.ops.segment_sum(
+                        sw, col, num_segments=n_nodes * nb + 1
+                    ),
+                    in_axes=1,
+                )(ids)                               # (F, n_nodes*nb+1, S)
             hist = hist[:, : n_nodes * nb, :].reshape(F, n_nodes, nb, S)
             cum = jnp.cumsum(hist, axis=2)
             left = cum[:, :, :-1, :]                 # threshold = bin b goes left
