@@ -308,18 +308,35 @@ def bench_pca_stream(mesh, n_chips):
     }
 
 
-def _probe_backend(attempts: int = 2, probe_timeout: int = 75, cooldown: int = 30) -> bool:
+def _probe_backend(
+    attempts: int | None = None,
+    probe_timeout: int | None = None,
+    cooldown: int | None = None,
+) -> bool:
     """Fail fast if the backend hangs at init (round-1 failure mode).
 
     A wedged TPU tunnel blocks *inside* ``make_c_api_client`` — uninterruptible
     from Python — so probe in a subprocess with a hard timeout before touching
     the backend in-process.  Skipped when pinned to CPU.
 
+    A client killed while HOLDING the grant wedges the tunnel until lease
+    expiry (observed >1 h); waiting clients queue harmlessly. The defaults
+    (~5.5 min of patience) ride out short wedges while leaving budget for
+    the CPU-fallback run; BENCH_PROBE_{ATTEMPTS,TIMEOUT,COOLDOWN} override.
+
     Returns True if the accelerator is reachable; False means the caller
     should fall back to CPU (a flagged CPU number beats no number at all).
     """
     import subprocess
 
+    # env read at call time (import-time defaults would freeze overrides
+    # set after import, and a malformed value would break the import itself)
+    if attempts is None:
+        attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 3))
+    if probe_timeout is None:
+        probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 75))
+    if cooldown is None:
+        cooldown = int(os.environ.get("BENCH_PROBE_COOLDOWN", 45))
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         return True
     last = ""

@@ -187,18 +187,18 @@ def spectral_init(
         diag = np.asarray(graph.sum(axis=1)).ravel()
         d_inv_sqrt = 1.0 / np.sqrt(np.maximum(diag, 1e-12))
         D = sp.diags(d_inv_sqrt)
-        L = sp.identity(n) - D @ graph @ D
         from scipy.sparse.linalg import eigsh
 
-        # Smallest eigenpairs of L via plain Lanczos on the spectrum-flipped
-        # operator 2I - L (normalized-Laplacian spectrum lies in [0, 2], so
-        # its smallest become the flipped operator's largest-magnitude).
-        # NOT shift-invert (sigma=0): that sparse-LU-factorizes L, whose
-        # kNN-graph fill-in scales brutally (measured 34 s at n=4096,
-        # 217 s at n=8192 vs 0.4/0.7 s flipped — it dominated UMAP fits).
+        # Smallest eigenpairs of the normalized Laplacian L = I - D·G·D via
+        # plain Lanczos on the spectrum-flipped operator 2I - L = I + D·G·D
+        # (L's spectrum lies in [0, 2], so its smallest become the flipped
+        # operator's largest-magnitude). NOT shift-invert (sigma=0): that
+        # sparse-LU-factorizes L, whose kNN-graph fill-in scales brutally
+        # (measured 34 s at n=4096, 217 s at n=8192 vs 0.4/0.7 s flipped —
+        # it dominated UMAP fits).
         k = n_components + 1
         flip_vals, vecs = eigsh(
-            2.0 * sp.identity(n) - L, k=k, which="LM", maxiter=n * 5
+            sp.identity(n) + D @ graph @ D, k=k, which="LM", maxiter=n * 5
         )
         order = np.argsort(2.0 - flip_vals)   # ascending eigenvalues of L
         emb = vecs[:, order[1 : n_components + 1]]
