@@ -297,14 +297,20 @@ def bench_pca_stream(mesh, n_chips):
     run(rows)
     t = time.perf_counter() - t0
     flops = 2.0 * rows * d * d  # pass-2 Gram dominates
+    stream_gb = rows * d * 4 * 2 / 1e9  # 2 passes
+    # The stream fit ingests host data every chunk; when the effective
+    # ingest rate is far below PCIe-class (threshold 1 GB/s), the number
+    # measures the link, not the chip, and is excluded from the geomean.
+    ingest_gbps = stream_gb / max(t, 1e-9)
     return {
         "samples_per_sec_per_chip": rows / t / n_chips,
         "fit_seconds": t,
         "rows": rows,
-        "stream_gb": round(rows * d * 4 * 2 / 1e9, 2),  # 2 passes
+        "stream_gb": round(stream_gb, 2),
+        "ingest_gbps": round(ingest_gbps, 3),
         "flops_model": flops,
         "baseline_samples_per_sec": 1.1e8,
-        "tunnel_bound": True,
+        "tunnel_bound": ingest_gbps < 1.0,
     }
 
 

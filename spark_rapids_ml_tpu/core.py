@@ -118,16 +118,17 @@ class FitInputs:
     ragged partitions become an even row-shard plus a validity mask.
     """
 
-    X: jax.Array                     # (N_pad, d) row-sharded over dp
+    X: jax.Array                     # (N_pad, d_padded) row-sharded over dp
     mask: jax.Array                  # (N_pad,) 1.0 valid / 0.0 padding
     mesh: Any
     n_rows: int                      # true (unpadded) row count
-    n_features: int
+    n_features: int                  # true (logical) feature count
     y: Optional[jax.Array] = None    # (N_pad,) labels, padded with 0
     weight: Optional[jax.Array] = None
     X_sparse: Optional[Any] = None   # host scipy CSR when the sparse path is on
     dtype: Any = jnp.float32
     csize: int = 1                   # per-device row-chunk size (scan kernels)
+    n_features_padded: int = 0       # X's column count incl. lane padding
 
 
 # fit function: (inputs, params_dict) -> dict of named numpy arrays/scalars
@@ -351,6 +352,15 @@ class _TpuEstimator(Params, _TpuParams):
             and n_padded_rows % (csize * mesh.shape[DP_AXIS]) == 0
         )
 
+    def _feature_pad_multiple(self) -> int:
+        """Column multiple to zero-pad the design matrix to before sharding
+        (0 = none). Estimators whose fit kernel reads X inside a
+        ``while_loop`` (KMeans) override: at lane-unaligned d XLA inserts a
+        defensive full copy of X around the loop, and on TPU the minor dim
+        is physically tiled to 128 anyway, so explicit zero columns cost no
+        extra HBM while removing the 2x copy."""
+        return 0
+
     def _pre_process_data(self, dataset: DataFrame) -> FitInputs:
         X, X_sparse = _resolve_feature_matrix(self, dataset)
         mesh = make_mesh(self.num_workers)
@@ -370,12 +380,15 @@ class _TpuEstimator(Params, _TpuParams):
         # row count, never the local partition size
         n_global = global_row_count(int(n_rows))
         csize = self._chunk_rows(n_global, mesh.shape["dp"])
+        pad_mult = self._feature_pad_multiple()
+        d_padded = int(n_features)
+        if pad_mult > 0 and n_features % pad_mult:
+            d_padded = -(-int(n_features) // pad_mult) * pad_mult
         if X_sparse is not None:
-            Xd, maskd = shard_rows(
-                np.asarray(X_sparse.todense(), dtype=dtype), mesh, csize
-            )
-        else:
-            Xd, maskd = shard_rows(X, mesh, csize)
+            X = np.asarray(X_sparse.todense(), dtype=dtype)
+        if d_padded != n_features:
+            X = np.pad(X, ((0, 0), (0, d_padded - int(n_features))))
+        Xd, maskd = shard_rows(X, mesh, csize)
 
         y = w = None
         if self._require_label():
@@ -407,6 +420,7 @@ class _TpuEstimator(Params, _TpuParams):
             X_sparse=X_sparse,
             dtype=jnp.dtype(dtype),
             csize=csize,
+            n_features_padded=d_padded,
         )
 
     # ---- fit -------------------------------------------------------------
@@ -639,8 +653,18 @@ class _TpuModel(Params, _TpuParams):
 
     def cpu(self) -> "_TpuModel":
         """The reference converts to a Spark JVM model (``feature.py:365-379``);
-        Spark-free, the model already runs on CPU via jax — return self."""
+        Spark-free, the model already runs on CPU via jax — return self. For
+        serving *outside* this framework entirely, :meth:`to_sklearn` exports
+        a stock fitted scikit-learn estimator."""
         return self
+
+    def to_sklearn(self):
+        """Export to a fitted scikit-learn estimator (accelerator-free
+        serving; the analog of the reference's Spark-model conversion in
+        ``cpu()``). See :mod:`spark_rapids_ml_tpu.export`."""
+        from .export import to_sklearn
+
+        return to_sklearn(self)
 
 
 class _TpuModelWithPredictionCol(_TpuModel, HasPredictionCol):

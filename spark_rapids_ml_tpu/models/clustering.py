@@ -147,6 +147,22 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
     def _chunk_rows(self, n_rows: int, n_dp: int) -> int:
         return self._equal_chunk_rows(n_rows, n_dp, _CHUNK)
 
+    def _feature_pad_multiple(self) -> int:
+        """Lloyd's ``while_loop`` triggers a defensive full copy of X at
+        lane-unaligned d (~2x matrix HBM at exactly the reference's d=3000
+        shape); zero columns are invariant under Lloyd updates (zero-seeded
+        centers stay zero, distances/costs unchanged) and TPU tiles the
+        minor dim to 128 physically anyway, so the padding is HBM-free.
+        ``TPUML_LANE_PAD`` overrides (CI exercises the path on CPU)."""
+        import os
+
+        env = os.environ.get("TPUML_LANE_PAD")
+        if env is not None:
+            return int(env)
+        import jax
+
+        return 128 if jax.default_backend() == "tpu" else 0
+
     # ---- seeding ---------------------------------------------------------
     # ONE sampling implementation serves both the resident and streaming
     # fits, parameterized over a slice "owner" — each rank owns the global
@@ -248,7 +264,8 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
 
         def gather_local(idx: np.ndarray) -> np.ndarray:
             if len(idx) == 0:
-                return np.empty((0, inputs.n_features), np.float32)
+                d = inputs.n_features_padded or inputs.n_features
+                return np.empty((0, d), np.float32)
             return gather_rows_global(inputs.X, valid_pos[idx], inputs.mesh)
 
         def min_d2_vs(cands: np.ndarray) -> np.ndarray:
@@ -322,8 +339,9 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
                 max_iter=int(params["max_iter"]),
                 tol=float(params["tol"]),
             )
+            # strip lane-padding columns (zero by the Lloyd invariant)
             return {
-                "cluster_centers": np.asarray(centers),
+                "cluster_centers": np.asarray(centers)[:, : inputs.n_features],
                 "training_cost": float(cost),
                 "n_iter": int(n_iter),
             }

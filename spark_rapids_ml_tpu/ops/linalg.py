@@ -197,9 +197,13 @@ def mean_and_cov_chunked(
     twice from HBM. Instead the mean is *estimated* from each device's
     first chunk (one cheap psum), the main pass accumulates shifted sums
     ``Σ m·(x-μ̂)`` and Gram ``Σ m·(x-μ̂)(x-μ̂)ᵀ``, and a final rank-1
-    correction re-centers exactly: since ``δ = mean - μ̂`` is O(σ/√csize),
-    the cancellation term is harmless — two-pass stability at one-pass
-    bandwidth. Partials combine with one ``psum`` over dp — the same
+    correction re-centers exactly: with ``δ = mean - μ̂`` small, the
+    cancellation term is harmless — two-pass stability at one-pass
+    bandwidth. The estimate samples ``csize`` rows *strided across the
+    whole device shard* (not the leading chunk), so data sorted or
+    drifting in magnitude still yields δ = O(σ/√csize); only then does
+    the f32 rank-1 correction stay clear of the cancellation the shift
+    avoids. Partials combine with one ``psum`` over dp — the same
     communication volume as the fused form.
 
     Requires per-device rows divisible by ``csize`` (``shard_rows`` pads to
@@ -211,11 +215,13 @@ def mean_and_cov_chunked(
     def per_device(Xl, ml):
         d = Xl.shape[1]
 
-        # mean estimate from each device's leading rows (padding lives at
-        # the tail, so leading rows carry real data; a global psum makes μ̂
-        # well-defined unless the dataset is empty)
+        # mean estimate from rows strided across the whole shard — a
+        # leading-chunk sample misestimates μ̂ on sorted/drifting data
+        # and the rank-1 correction then reintroduces cancellation; the
+        # mask weights out any padding rows the stride lands on
         e = min(csize, Xl.shape[0])
-        x0, m0 = Xl[:e], ml[:e]
+        stride = max(1, Xl.shape[0] // e)
+        x0, m0 = Xl[::stride][:e], ml[::stride][:e]
         s0 = lax.psum((x0 * m0[:, None]).sum(axis=0), DP_AXIS)
         c0 = lax.psum(m0.sum(), DP_AXIS)
         mean_hat = s0 / jnp.maximum(c0, 1.0)

@@ -66,8 +66,10 @@ def pin_platform(
         # and therefore excluded from automatic selection) — a literal
         # jax_platforms="tpu" pin fails where the plugin's name differs.
         # "Run on the accelerator" means: keep whatever non-cpu platform the
-        # environment names, priority-first; with none named, clear the pin
-        # and let jax's default pick the registered plugin.
+        # environment names, priority-first; with none named, pin the literal
+        # "tpu" so a missing/odd-named plugin fails loudly rather than
+        # silently selecting CPU (experimental plugins are excluded from
+        # jax's automatic selection, so clearing the pin could pick cpu).
         if backend_initialized():
             if jax.local_devices()[0].platform == "cpu":
                 raise RuntimeError(

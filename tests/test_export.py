@@ -1,0 +1,133 @@
+"""Export-to-sklearn parity (the reference's ``cpu()`` conversion contract:
+models outlive the accelerator — ``feature.py:365-379``, ``tree.py:510-555``).
+
+Each test fits on the framework, exports via ``to_sklearn()``, pickles and
+reloads the sklearn object (the serving path), and checks the reloaded
+model's predictions against the framework's own transform output.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data.dataframe import DataFrame
+from spark_rapids_ml_tpu.models.classification import LogisticRegression
+from spark_rapids_ml_tpu.models.clustering import KMeans
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.models.regression import LinearRegression
+from spark_rapids_ml_tpu.models.tree import (
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+
+
+def _roundtrip(sk_model):
+    return pickle.loads(pickle.dumps(sk_model))
+
+
+def test_pca_export(rng):
+    X = (rng.normal(size=(200, 12)) * ([1, 5] * 6)).astype(np.float32)
+    model = PCA(k=3).fit(DataFrame({"features": X}))
+    sk = _roundtrip(model.to_sklearn())
+    ours = model.transform(DataFrame({"features": X}))["pca_features"]
+    np.testing.assert_allclose(sk.transform(X), ours, atol=1e-5)
+    # fitted mean preserved for sklearn-style centering
+    np.testing.assert_allclose(sk.tpu_mean_, model.mean_, atol=1e-6)
+    assert sk.components_.shape == (3, 12)
+
+
+def test_kmeans_export(rng):
+    X = np.concatenate(
+        [rng.normal(loc=c, size=(80, 8)) for c in (-4.0, 0.0, 4.0)]
+    ).astype(np.float32)
+    model = KMeans(k=3, seed=5).fit(DataFrame({"features": X}))
+    sk = _roundtrip(model.to_sklearn())
+    ours = model.transform(DataFrame({"features": X}))["prediction"]
+    np.testing.assert_array_equal(sk.predict(X.astype(np.float64)), ours)
+
+
+def test_linreg_export(rng):
+    X = rng.normal(size=(300, 10)).astype(np.float32)
+    y = (X @ rng.normal(size=10) + 2.0).astype(np.float32)
+    model = LinearRegression(regParam=0.1).fit(
+        DataFrame({"features": X, "label": y})
+    )
+    sk = _roundtrip(model.to_sklearn())
+    ours = model.transform(DataFrame({"features": X}))["prediction"]
+    np.testing.assert_allclose(sk.predict(X), ours, atol=1e-4)
+
+
+@pytest.mark.parametrize("k", [2, 3])
+def test_logreg_export(rng, k):
+    X = rng.normal(size=(400, 8)).astype(np.float32)
+    W = rng.normal(size=(8, k))
+    y = np.argmax(X @ W + rng.normal(size=(400, k)) * 0.1, axis=1).astype(
+        np.float32
+    )
+    model = LogisticRegression(regParam=0.01).fit(
+        DataFrame({"features": X, "label": y})
+    )
+    sk = _roundtrip(model.to_sklearn())
+    out = model.transform(DataFrame({"features": X}))
+    np.testing.assert_array_equal(sk.predict(X), out["prediction"])
+    np.testing.assert_allclose(
+        sk.predict_proba(X), out["probability"], atol=1e-5
+    )
+
+
+def test_rf_classifier_export(rng):
+    X = rng.normal(size=(500, 10)).astype(np.float32)
+    y = ((X[:, 0] + X[:, 3] * X[:, 1]) > 0).astype(np.float32)
+    model = RandomForestClassifier(numTrees=12, maxDepth=5, seed=3).fit(
+        DataFrame({"features": X, "label": y})
+    )
+    sk = _roundtrip(model.to_sklearn())
+    Xq = rng.normal(size=(200, 10)).astype(np.float32)
+    out = model.transform(DataFrame({"features": Xq}))
+    np.testing.assert_allclose(
+        sk.predict_proba(Xq), out["probability"], atol=1e-6
+    )
+    np.testing.assert_array_equal(sk.predict(Xq), out["prediction"])
+
+
+def test_rf_regressor_export(rng):
+    X = rng.normal(size=(500, 10)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.abs(X[:, 1])).astype(np.float32)
+    model = RandomForestRegressor(numTrees=12, maxDepth=5, seed=3).fit(
+        DataFrame({"features": X, "label": y})
+    )
+    sk = _roundtrip(model.to_sklearn())
+    Xq = rng.normal(size=(200, 10)).astype(np.float32)
+    ours = model.transform(DataFrame({"features": Xq}))["prediction"]
+    np.testing.assert_allclose(sk.predict(Xq), ours, atol=1e-4)
+
+
+def test_rf_export_split_equality_edge():
+    """Inputs landing exactly on a bin edge must route the same way through
+    the exported tree (x<=t left) as through ours (x>=thr right)."""
+    rng = np.random.default_rng(0)
+    # integer-valued features make exact threshold hits likely
+    X = rng.integers(0, 8, size=(400, 4)).astype(np.float32)
+    y = (X[:, 0] >= 4).astype(np.float32)
+    model = RandomForestClassifier(numTrees=6, maxDepth=4, seed=1).fit(
+        DataFrame({"features": X, "label": y})
+    )
+    sk = model.to_sklearn()
+    out = model.transform(DataFrame({"features": X}))
+    np.testing.assert_array_equal(sk.predict(X), out["prediction"])
+    np.testing.assert_allclose(sk.predict_proba(X), out["probability"], atol=1e-6)
+
+
+def test_rf_export_feature_importances(rng):
+    """Exported trees must agree on n_features even when some trees never
+    split on the last feature (regression: feature_importances_ crashed)."""
+    X = rng.normal(size=(300, 10)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    model = RandomForestClassifier(numTrees=8, maxDepth=4, seed=2).fit(
+        DataFrame({"features": X, "label": y})
+    )
+    sk = model.to_sklearn()
+    fi = sk.feature_importances_
+    assert fi.shape == (10,)
+    assert np.isfinite(fi).all()

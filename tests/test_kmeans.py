@@ -121,3 +121,33 @@ def test_predict_after_prediction_col_change():
     model._set_params(predictionCol="cluster")
     p1 = model.predict(X[0])  # used to KeyError on the stale cached closure
     assert p0 == p1
+
+
+def test_kmeans_lane_padding_matches_unpadded(monkeypatch):
+    """d % 128 != 0 regression: with feature lane-padding forced on (the
+    TPU default — avoids XLA's defensive copy of X around the Lloyd
+    while_loop at unaligned d), the fit must match the unpadded fit:
+    zero columns are invariant under Lloyd updates and the seeding RNG
+    stream is unchanged."""
+    X, _, _ = _blobs(n=300, d=10, k=3, seed=7)
+    df = DataFrame({"features": X})
+
+    monkeypatch.delenv("TPUML_LANE_PAD", raising=False)
+    base = KMeans(k=3, seed=11).fit(df)
+
+    monkeypatch.setenv("TPUML_LANE_PAD", "128")
+    padded = KMeans(k=3, seed=11).fit(df)
+
+    assert padded.cluster_centers_.shape == (3, 10)
+    np.testing.assert_array_equal(
+        padded.cluster_centers_, base.cluster_centers_
+    )
+    # cost reduces over 128 lanes instead of 10 — same math, different
+    # f32 summation tree, so last-bits differences are expected
+    np.testing.assert_allclose(
+        padded.trainingCost, base.trainingCost, rtol=1e-4
+    )
+    out = padded.transform(df)
+    np.testing.assert_array_equal(
+        out["prediction"], base.transform(df)["prediction"]
+    )
