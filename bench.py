@@ -243,6 +243,128 @@ def bench_logreg(X, mask, y, mesh, n_chips):
     }
 
 
+def bench_linreg(X, mask, y, mesh, n_chips):
+    """Normal-equation LinearRegression fit: suffstats (Gram + X'y) then a
+    replicated solve — same roofline shape as PCA (A10G ~15 TFLOP/s on
+    SYRK-shaped work -> 1.1e8 samples/sec/GPU at d=256)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.linreg_kernels import (
+        linreg_suffstats_chunked,
+        solve_normal,
+    )
+
+    def timed_fn(X, m, y):
+        stats = linreg_suffstats_chunked(X, m, y, mesh=mesh, csize=CSIZE)
+        out = solve_normal(stats, jnp.float32(1e-5), standardization=True)
+        return _checksum(out)
+
+    timed = jax.jit(timed_fn)
+    np.asarray(timed(X, mask, y))  # compile
+    t, _ = _best_time(
+        lambda rep: (X, mask * jnp.float32(1.0 + (rep + 1) * 1e-6), y),
+        timed,
+    )
+    n = N_ROWS
+    flops = 2.0 * n * N_COLS * N_COLS
+    return {
+        "samples_per_sec_per_chip": n / t / n_chips,
+        "fit_seconds": t,
+        "flops_model": flops,
+        "baseline_samples_per_sec": 1.1e8,
+    }
+
+
+RF_TREES = int(os.environ.get("BENCH_RF_TREES", 50))
+RF_ROWS = int(os.environ.get("BENCH_RF_ROWS", 131_072))
+RF_DEPTH = int(os.environ.get("BENCH_RF_DEPTH", 13))
+RF_BINS = 128
+
+
+def bench_rf(X, mask, y, mesh, n_chips):
+    """RandomForestClassifier at the reference forest config (50 trees,
+    depth 13, 128 bins — ``databricks/run_benchmark.sh:102-112``) on a
+    131k-row slice (the shape with a recorded round-2 datapoint: 426 s).
+
+    Throughput unit is tree-samples/sec/chip (= rows x trees / seconds):
+    trees are embarrassingly parallel with zero collectives, so the rate is
+    invariant in tree count and scales linearly with chips.
+
+    Baseline model: a histogram builder on A10G is bound by shared-memory
+    atomics; cuML sustains ~1.8e9 histogram updates/s/GPU (consistent with
+    the 2xA10G cluster finishing the 1Mx3000 50-tree benchmark inside its
+    3600 s budget, ``databricks/README.md:37-40``). One tree-sample costs
+    d x depth x n_stats updates, so at d=256/depth 13/S=2 the A10G model
+    is 1.8e9 / 6656 ~= 2.7e5 tree-samples/sec/GPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.tree_kernels import (
+        ForestConfig,
+        binize,
+        build_forest,
+        next_pow2,
+    )
+
+    n_dp = mesh.shape["dp"]
+    n_rf = min(RF_ROWS, X.shape[0])
+    n_rf = max(n_dp, (n_rf // n_dp) * n_dp)
+    Xs = X[:n_rf]
+    ys = y[:n_rf]
+    ms = mask[:n_rf]
+    d_pad = next_pow2(N_COLS)
+    # quantile edges ON DEVICE (a host fetch of the subsample would pay the
+    # tunnel's ~30 MB/s for ~67 MB); the estimator path sketches on host
+    # because there the data starts on host
+    qs = jnp.linspace(0.0, 1.0, RF_BINS + 1)[1:-1]
+    edges = jax.jit(
+        lambda Xs: jnp.quantile(Xs[: min(65536, n_rf)], qs, axis=0).T.astype(
+            jnp.float32
+        )
+    )(Xs)
+    bins = binize(Xs, edges, d_pad=d_pad)
+    stats = jnp.stack([1.0 - ys, ys], axis=1) * ms[:, None]
+    trees_per_dev = -(-RF_TREES // n_dp)
+    keys = jax.random.key_data(
+        jax.random.split(jax.random.key(7), n_dp * trees_per_dev)
+    ).reshape(n_dp, trees_per_dev, 2)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    keys = jax.device_put(
+        np.asarray(keys), NamedSharding(mesh, P("dp"))
+    )
+    cfg = ForestConfig(
+        max_depth=RF_DEPTH, n_bins=RF_BINS, n_features=N_COLS, n_stats=2,
+        impurity="gini", k_features=N_COLS, min_samples_leaf=1,
+        min_info_gain=0.0, min_samples_split=2, bootstrap=True,
+    )
+
+    def timed_fn(bins, ms, stats, keys):
+        return _checksum(
+            build_forest(bins, ms, stats, keys, mesh=mesh, cfg=cfg)
+        )
+
+    timed = jax.jit(timed_fn)
+    np.asarray(timed(bins, ms, stats, keys))  # compile
+    t, _ = _best_time(
+        lambda rep: (bins, ms, stats * jnp.float32(1.0 + (rep + 1) * 1e-6), keys),
+        timed,
+        reps=2,
+    )
+    n_trees = trees_per_dev * n_dp
+    # updates model: one histogram update per (row, feature, stat, level)
+    updates = float(n_rf) * N_COLS * 2 * RF_DEPTH * n_trees
+    return {
+        "samples_per_sec_per_chip": n_rf * n_trees / t / n_chips,
+        "fit_seconds": t,
+        "trees": n_trees,
+        "rows": n_rf,
+        "flops_model": updates,  # scatter-equivalent work, not MXU flops
+        "baseline_samples_per_sec": 1.8e9 / (N_COLS * RF_DEPTH * 2),
+    }
+
+
 def bench_pca_stream(mesh, n_chips):
     """Out-of-core PCA: chunks stream through a bounded device buffer
     (``ops/streaming.py``), the path that handles beyond-HBM datasets
@@ -387,9 +509,13 @@ def main() -> None:
         # the caller pinned a size explicitly
         N_ROWS = min(N_ROWS, 50_000)
         CSIZE = _csize(N_ROWS)
+        global RF_ROWS, RF_TREES, RF_DEPTH
+        if "BENCH_RF_ROWS" not in os.environ:
+            RF_ROWS, RF_TREES, RF_DEPTH = 8192, 4, 8
         print(
-            f"[bench] cpu device: reducing N_ROWS to {N_ROWS} "
-            "(set BENCH_ROWS to override)",
+            f"[bench] cpu device: reducing N_ROWS to {N_ROWS}, "
+            f"rf to {RF_TREES}x{RF_ROWS}x depth {RF_DEPTH} "
+            "(set BENCH_ROWS / BENCH_RF_ROWS to override)",
             file=sys.stderr,
         )
 
@@ -444,6 +570,8 @@ def main() -> None:
         "pca": lambda: bench_pca(X, mask, mesh, n_chips),
         "kmeans": lambda: bench_kmeans(X, mask, mesh, n_chips),
         "logreg": lambda: bench_logreg(X, mask, y, mesh, n_chips),
+        "linreg": lambda: bench_linreg(X, mask, y, mesh, n_chips),
+        "rf": lambda: bench_rf(X, mask, y, mesh, n_chips),
         "pca_stream": lambda: bench_pca_stream(mesh, n_chips),
     }
     from spark_rapids_ml_tpu.utils.profiling import trace

@@ -204,9 +204,21 @@ class ParquetChunkSource(ChunkSource):
         if isinstance(ftype, pa.FixedSizeListType):
             self.n_features = ftype.list_size
         else:
-            # variable list: peek one row group
-            t = pq.ParquetFile(self._files[0]).read_row_group(0, columns=[features_col])
-            self.n_features = len(t.column(0)[0].as_py())
+            # variable list / Spark VectorUDT struct: peek ONE row (a full
+            # row group would materialize ~rows x d float64 on host just
+            # to learn the dimension)
+            from .dataframe import is_spark_vector_struct, spark_vector_to_numpy
+
+            batch = next(
+                pq.ParquetFile(self._files[0]).iter_batches(
+                    batch_size=1, columns=[features_col]
+                )
+            )
+            col = batch.column(0)
+            if is_spark_vector_struct(ftype):
+                self.n_features = spark_vector_to_numpy(col).shape[1]
+            else:
+                self.n_features = len(col[0].as_py())
         self.has_label = label_col is not None
         self.has_weight = weight_col is not None
 
@@ -224,7 +236,12 @@ class ParquetChunkSource(ChunkSource):
         if isinstance(fc.type, pa.FixedSizeListType):
             X = fc.flatten().to_numpy(zero_copy_only=False).reshape(-1, self.n_features)
         else:
-            X = np.stack([np.asarray(v) for v in fc.to_pylist()])
+            from .dataframe import is_spark_vector_struct, spark_vector_to_numpy
+
+            if is_spark_vector_struct(fc.type):
+                X = spark_vector_to_numpy(fc, dtype=dtype)
+            else:
+                X = np.stack([np.asarray(v) for v in fc.to_pylist()])
         X = np.asarray(X, dtype=dtype)
         y = w = None
         if self._label_col:

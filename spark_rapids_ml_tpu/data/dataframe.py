@@ -39,6 +39,69 @@ def _is_sparse(col: Any) -> bool:
     return sp is not None and sp.issparse(col)
 
 
+def is_spark_vector_struct(arrow_type: Any) -> bool:
+    """True for the parquet physical schema Spark ML writes for VectorUDT:
+    ``struct<type: tinyint, size: int, indices: list<int>, values:
+    list<double>>`` (``type`` 1 = dense, 0 = sparse). The reference consumes
+    these via Spark itself (``core.py:160-241``); Spark-free, this module
+    decodes them directly so Spark-written parquet loads unmodified."""
+    import pyarrow as pa
+
+    if not pa.types.is_struct(arrow_type):
+        return False
+    names = {arrow_type.field(i).name for i in range(arrow_type.num_fields)}
+    return {"type", "size", "indices", "values"} <= names
+
+
+def spark_vector_to_numpy(col: Any, dtype: Any = np.float64) -> np.ndarray:
+    """Decode a Spark VectorUDT struct column (arrow) to a dense (n, d)
+    array. Dense and sparse rows may be mixed, as Spark allows."""
+    import pyarrow as pa
+
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    n = len(col)
+    kinds = col.field("type").fill_null(1).to_numpy(zero_copy_only=False)
+    sizes = col.field("size").fill_null(-1).to_numpy(zero_copy_only=False)
+    values = col.field("values")
+    indices = col.field("indices")
+    vflat = np.asarray(values.flatten().to_numpy(zero_copy_only=False))
+    voff = np.asarray(values.offsets.to_numpy(zero_copy_only=False))
+    iflat = np.asarray(indices.flatten().to_numpy(zero_copy_only=False))
+    ioff = np.asarray(indices.offsets.to_numpy(zero_copy_only=False))
+
+    dense = kinds == 1
+    vlen = np.diff(voff)
+    if dense.any():
+        d = int(vlen[dense][0])
+        if not (vlen[dense] == d).all():
+            raise ValueError("ragged dense vectors in VectorUDT column")
+    else:
+        d = int(sizes.max())
+    if (sizes[~dense] > d).any() or d <= 0:
+        raise ValueError(
+            f"inconsistent VectorUDT dimensions (dense d={d}, "
+            f"max sparse size={sizes.max()})"
+        )
+
+    out = np.zeros((n, d), dtype=dtype)
+    didx = np.nonzero(dense)[0]
+    if didx.size:
+        gather = voff[didx][:, None] + np.arange(d)[None, :]
+        out[didx] = vflat[gather]
+    if (~dense).any():
+        # flat sparse entries: indices lists are empty for dense rows, so
+        # iflat rows are exactly the sparse rows' columns; align values by
+        # masking the flat values to sparse rows
+        row_of_v = np.repeat(np.arange(n), vlen)
+        sparse_mask = ~dense[row_of_v]
+        row_of_i = np.repeat(np.arange(n), np.diff(ioff))
+        if sparse_mask.sum() != len(iflat):
+            raise ValueError("VectorUDT sparse rows have mismatched lists")
+        out[row_of_i, iflat] = vflat[sparse_mask]
+    return out
+
+
 def _col_nrows(col: ColumnLike) -> int:
     return int(col.shape[0])
 
@@ -326,6 +389,8 @@ class DataFrame:
             elif pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
                 pylist = col.to_pylist()
                 data[name] = np.stack([np.asarray(v) for v in pylist])
+            elif is_spark_vector_struct(col.type):
+                data[name] = spark_vector_to_numpy(col)
             else:
                 data[name] = col.to_numpy(zero_copy_only=False)
         return DataFrame(data, num_partitions)
@@ -388,6 +453,8 @@ class ParquetScanFrame(DataFrame):
                 out.append((f.name, f"vector<{f.type.value_type}>[{f.type.list_size}]"))
             elif pa.types.is_list(f.type) or pa.types.is_large_list(f.type):
                 out.append((f.name, f"vector<{f.type.value_type}>[?]"))
+            elif is_spark_vector_struct(f.type):
+                out.append((f.name, "vector<spark-udt>[?]"))
             else:
                 out.append((f.name, str(f.type)))
         return out

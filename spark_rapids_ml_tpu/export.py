@@ -201,11 +201,23 @@ def random_forest_to_sklearn(model: Any):
         tot = np.maximum(counts, 1e-12)[:, :, None]
         values = (ls / tot).astype(np.float64)                        # fractions
         p = ls / tot
-        impurity = 1.0 - (p * p).sum(axis=2)                          # gini
-        forest = RandomForestClassifier(n_estimators=T, max_depth=depth)
+        try:
+            criterion = model.getOrDefault("impurity")
+        except Exception:
+            criterion = "gini"
+        if criterion == "entropy":
+            with np.errstate(divide="ignore", invalid="ignore"):
+                impurity = -np.where(p > 0, p * np.log2(p), 0.0).sum(axis=2)
+        else:
+            impurity = 1.0 - (p * p).sum(axis=2)                      # gini
+        forest = RandomForestClassifier(
+            n_estimators=T, max_depth=depth, criterion=criterion
+        )
         forest.classes_ = np.arange(n_classes, dtype=np.float64)
         forest.n_classes_ = n_classes
-        mk = lambda: DecisionTreeClassifier(max_depth=depth)  # noqa: E731
+        mk = lambda: DecisionTreeClassifier(  # noqa: E731
+            max_depth=depth, criterion=criterion
+        )
         V = n_classes
     else:
         counts = ls[:, :, 0]

@@ -207,12 +207,13 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
 
         ids_arr = np.asarray(item_df.column(id_col))
         if nproc > 1 and not np.issubdtype(ids_arr.dtype, np.number):
-            # fail fast, before any device work: the byte-view id exchange
-            # needs a fixed-width viewable dtype (object/str arrays are not)
-            raise NotImplementedError(
-                f"multi-process kneighbors requires a fixed-width numeric "
-                f"idCol (got dtype {ids_arr.dtype})"
-            )
+            # the byte-view id exchange needs a fixed-width viewable dtype:
+            # object/str ids are normalized to a unicode width agreed
+            # across the process world (empty-string padding slots are
+            # never selected — masked rows carry +inf distance in the ring)
+            from ..parallel.mesh import unify_string_width
+
+            ids_arr = unify_string_width(ids_arr)
 
         mesh = make_mesh(self.num_workers)
         Xi_d, mi_d = shard_rows(Xi, mesh)
@@ -272,28 +273,27 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
         self, query_df: DataFrame, distCol: str = "distCol"
     ) -> DataFrame:
         id_col = self.getIdCol()
-        if jax.process_count() > 1:
-            # fail fast, before the (expensive) distributed search: the
-            # item-table gather below needs fixed-width numeric columns
-            probe = self._ensureIdCol(self._item_df_withid)
-            for c in probe.columns:
-                if not np.issubdtype(np.asarray(probe.column(c)).dtype, np.number):
-                    raise NotImplementedError(
-                        f"multi-process exactNearestNeighborsJoin requires "
-                        f"numeric item columns (got non-numeric column {c!r})"
-                    )
         item_df_withid, query_df_withid, knn_df = self.kneighbors(query_df)
         if jax.process_count() > 1:
-            # a query's neighbors may be items owned by other ranks: gather
-            # the item table so every rank can join its own queries' rows
-            # (host memory O(global items) — the reference pays a Spark
-            # shuffle here instead, ``knn.py:655-668``). Byte-exact gather:
-            # a jax-array gather would canonicalize int64/float64 to 32-bit
-            from ..parallel.mesh import allgather_ragged_rows_exact
+            # a query's neighbors may be items owned by other ranks. The
+            # reference pays a Spark shuffle join here (``knn.py:655-668``);
+            # the collective analog is an index-selective exchange: ranks
+            # agree on the union of item ids any rank's knn result touches,
+            # then gather ONLY those items' rows — host memory
+            # O(global unique matches) <= O(nq_global * k), independent of
+            # the item-table size (previously O(global items)). Byte-exact
+            # + string-safe gathers: a jax-array gather would canonicalize
+            # int64/float64 to 32-bit, and str columns ride a width-unified
+            # byte view.
+            from ..parallel.mesh import allgather_ragged_any
 
+            needed_local = np.unique(np.asarray(knn_df.column("indices")).ravel())
+            needed = np.unique(allgather_ragged_any(needed_local))
+            local_ids = np.asarray(item_df_withid.column(id_col))
+            sel = np.isin(local_ids, needed)
             gathered: Dict[str, Any] = {
-                c: allgather_ragged_rows_exact(
-                    np.asarray(item_df_withid.column(c))
+                c: allgather_ragged_any(
+                    np.asarray(item_df_withid.column(c))[sel]
                 )
                 for c in item_df_withid.columns
             }

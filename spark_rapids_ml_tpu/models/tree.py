@@ -21,6 +21,7 @@ trees across workers (``tree.py:256-267``), zero collectives during growth.
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -344,6 +345,26 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
                 min_samples_split=int(params.get("min_samples_split", 2)),
                 bootstrap=bool(params["bootstrap"]),
             )
+            # rows-per-tree mode: "all" gathers the binned matrix to every
+            # device (quality independent of worker count — the TPU-first
+            # upgrade over the reference's partition-local trees), "local"
+            # keeps the reference's exact per-worker semantics, "auto"
+            # gathers when the gathered operands fit a memory budget
+            mode = os.environ.get("TPUML_RF_ROWS_PER_TREE", "auto")
+            if mode not in ("auto", "all", "local"):
+                raise ValueError(
+                    f"TPUML_RF_ROWS_PER_TREE must be auto|all|local, got {mode!r}"
+                )
+            n_pad_global = bins.shape[0]
+            gathered_bytes = n_pad_global * (
+                d_pad + n_stats * stats.dtype.itemsize + 4
+            )
+            budget = float(
+                os.environ.get("TPUML_RF_GATHER_BUDGET_BYTES", 4e9)
+            )
+            gather = n_dp > 1 and (
+                mode == "all" or (mode == "auto" and gathered_bytes <= budget)
+            )
             # bound trees per dispatch: the whole group builds inside ONE
             # device program (lax.map over trees), and a multi-minute
             # single dispatch can outlive remote-runtime health checks
@@ -356,7 +377,8 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
                 kg = keys[:, g0 : min(g0 + group, t_local)]
                 gsz = kg.shape[1]
                 outg = build_forest(
-                    bins, inputs.mask, stats, kg, mesh=inputs.mesh, cfg=cfg
+                    bins, inputs.mask, stats, kg,
+                    mesh=inputs.mesh, cfg=cfg, gather=gather,
                 )
                 for k, a in outg.items():
                     h = fetch_global(a, inputs.mesh)

@@ -41,6 +41,23 @@ from ..parallel.mesh import DP_AXIS
 # deepest level (tile is float32: 1<<22 elems = 16 MiB)
 _HIST_BUDGET = 1 << 22
 
+# Histogram strategy cost model. A scatter-add (segment_sum) update costs a
+# roughly constant time on TPU (~1e8 updates/s measured — the round-2
+# builder's 8.5 s/tree at 131k x 256 x depth 13 is exactly 13 levels of
+# n*d*S updates at that rate), while the one-hot-matmul formulation costs
+# 2*n_nodes*n_bins MXU flops per update (~5e13 flop/s). The matmul path
+# therefore wins while 2*n_nodes*n_bins is below ~5e5 "scatter-equivalent
+# flops" — i.e. every level until n_nodes*n_bins ~ 2.5e5 — by up to two
+# orders of magnitude at shallow levels. Overridable for re-tuning on other
+# chip generations.
+import os as _os
+
+_SCATTER_EQ_FLOPS = float(_os.environ.get("TPUML_RF_SCATTER_EQ_FLOPS", 5e5))
+# rows per matmul accumulation chunk: bounds the (C, n_nodes) node-onehot
+# and (C, F*nb) bin-onehot intermediates (C=8192, level 12, F*nb=512:
+# 8192*4096*4 = 128 MB node-onehot is the largest, still < HBM noise)
+_ROW_CHUNK = 1 << 13
+
 
 class ForestConfig(NamedTuple):
     """Static (compile-time) build configuration."""
@@ -163,7 +180,17 @@ def _build_tree(
 
     kb, kf = jax.random.split(jnp.asarray(key))
     if cfg.bootstrap:
-        w = jax.random.poisson(kb, 1.0, (n,)).astype(dt) * valid
+        # Poisson(1) bootstrap ~ sampling-with-replacement. Draws are
+        # indexed by LOGICAL row position (cumsum of the validity mask),
+        # not padded position: multi-process layouts interleave padding
+        # per-process block, and logical indexing makes the same dataset
+        # produce the same weights — and therefore bit-identical
+        # integer-stat trees — under any process/padding layout.
+        logical = jnp.clip(
+            jnp.cumsum(valid.astype(jnp.int32)) - 1, 0, n - 1
+        )
+        draws = jax.random.poisson(kb, 1.0, (n,)).astype(dt)
+        w = draws[logical] * valid
     else:
         w = valid.astype(dt)
     sw = stats * w[:, None]
@@ -202,11 +229,23 @@ def _build_tree(
         F = _chunk_features(d_pad, n_nodes, nb, S)
         n_chunks = d_pad // F
 
-        def chunk_body(carry, ci, *, n_nodes=n_nodes, parent=parent,
-                       pcount=pcount, pimp=pimp, sel=sel, F=F,
-                       in_level=in_level, local=local, sw=sw):
-            bg, bf, bb = carry
-            binc = lax.dynamic_slice(bins, (0, ci * F), (n, F)).astype(jnp.int32)
+        # strategy per level (static): one-hot matmuls on the MXU until the
+        # 2*n_nodes*nb waste factor exceeds a scatter-add update's cost
+        use_matmul = (2.0 * n_nodes * nb) < _SCATTER_EQ_FLOPS
+        if use_matmul:
+            # the (C, F*nb) bin one-hot is a materialized dot operand; the
+            # histogram-tile budget alone lets F reach d_pad at shallow
+            # levels (17 GB at d_pad=4096, C=8192, nb=128) — cap F so the
+            # one-hot stays ~256 MB. Extra feature chunks cost nothing:
+            # total matmul flops per level are F-invariant.
+            C_lvl = min(_ROW_CHUNK, n)
+            f_cap = max(1, (1 << 26) // (C_lvl * nb))
+            f_cap = 1 << (f_cap.bit_length() - 1)
+            F = min(F, f_cap)
+            n_chunks = d_pad // F
+
+        def _hist_scatter(binc, *, n_nodes, in_level, local, sw):
+            """(F, n_nodes, nb, S) via segment_sum scatter-adds."""
             ids = jnp.where(
                 in_level[:, None], local[:, None] * nb + binc, n_nodes * nb
             )
@@ -218,6 +257,7 @@ def _build_tree(
             # keep the broadcast at (F, n), lane-aligned. Wide S (many
             # classes): padding overhead fades (<= 8x at S >= 16) and S
             # unrolled scatters would dominate — keep one (n, S) scatter.
+            F = binc.shape[1]
             if S <= 16:
                 hist = jnp.stack(
                     [
@@ -238,7 +278,60 @@ def _build_tree(
                     ),
                     in_axes=1,
                 )(ids)                               # (F, n_nodes*nb+1, S)
-            hist = hist[:, : n_nodes * nb, :].reshape(F, n_nodes, nb, S)
+            return hist[:, : n_nodes * nb, :].reshape(F, n_nodes, nb, S)
+
+        def _hist_matmul(binc, *, n_nodes, in_level, local, sw):
+            """(F, n_nodes, nb, S) via MXU one-hot contractions.
+
+            hist[f,nd,b,s] = sum_r N[r,nd] * B[r,f*nb+b] * sw[r,s] with
+            N the (row, node) one-hot (row weight/level mask folded in) and
+            B the (row, feature-bin) one-hot — one (n_nodes, C) x (C, F*nb)
+            matmul per stat per row chunk. Rows are accumulated in chunks
+            so the one-hot intermediates stay bounded; the clamped last
+            chunk masks re-read rows."""
+            F = binc.shape[1]
+            C = min(_ROW_CHUNK, n)
+            nc = -(-n // C)
+            node_ar = jnp.arange(n_nodes, dtype=jnp.int32)
+            bin_ar = jnp.arange(nb, dtype=jnp.int32)
+
+            def row_body(ri, acc):
+                start = jnp.minimum(ri * C, n - C)
+                bc = lax.dynamic_slice(binc, (start, 0), (C, F))
+                loc = lax.dynamic_slice(local, (start,), (C,))
+                lvl = lax.dynamic_slice(in_level, (start,), (C,))
+                swc = lax.dynamic_slice(sw, (start, 0), (C, S))
+                fresh = (start + jnp.arange(C)) >= ri * C  # clamp re-reads
+                Noh = (
+                    (loc[:, None] == node_ar[None, :])
+                    & lvl[:, None]
+                    & fresh[:, None]
+                ).astype(dt)                              # (C, n_nodes)
+                Boh = (bc[:, :, None] == bin_ar[None, None, :]).astype(dt)
+                Boh = Boh.reshape(C, F * nb)              # (C, F*nb)
+                return acc + jnp.stack(
+                    [(Noh * swc[:, s][:, None]).T @ Boh for s in range(S)],
+                    axis=-1,
+                )                                         # (n_nodes, F*nb, S)
+
+            acc = lax.fori_loop(
+                0,
+                nc,
+                row_body,
+                jnp.zeros((n_nodes, F * nb, S), dt),
+            )
+            return acc.reshape(n_nodes, F, nb, S).transpose(1, 0, 2, 3)
+
+        def chunk_body(carry, ci, *, n_nodes=n_nodes, parent=parent,
+                       pcount=pcount, pimp=pimp, sel=sel, F=F,
+                       in_level=in_level, local=local, sw=sw,
+                       use_matmul=use_matmul):
+            bg, bf, bb = carry
+            binc = lax.dynamic_slice(bins, (0, ci * F), (n, F)).astype(jnp.int32)
+            make = _hist_matmul if use_matmul else _hist_scatter
+            hist = make(
+                binc, n_nodes=n_nodes, in_level=in_level, local=local, sw=sw
+            )
             cum = jnp.cumsum(hist, axis=2)
             left = cum[:, :, :-1, :]                 # threshold = bin b goes left
             right = parent[None, :, None, :] - left
@@ -313,7 +406,7 @@ def _build_tree(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "cfg"))
+@functools.partial(jax.jit, static_argnames=("mesh", "cfg", "gather"))
 def build_forest(
     bins: jax.Array,   # (N_pad, d_pad) uint8, dp-sharded
     mask: jax.Array,   # (N_pad,) float, dp-sharded
@@ -322,13 +415,26 @@ def build_forest(
     *,
     mesh: Mesh,
     cfg: ForestConfig,
+    gather: bool = False,
 ) -> Dict[str, jax.Array]:
-    """Each device grows ``trees_per_device`` trees on its LOCAL row shard
-    (the reference's per-worker local cuRF fit, ``tree.py:269-402``); the
-    stacked forest materializes via the out-sharding — the analog of the
-    reference's allGather of serialized treelite bytes (``tree.py:319-366``)."""
+    """Each device grows ``trees_per_device`` trees; the stacked forest
+    materializes via the out-sharding — the analog of the reference's
+    allGather of serialized treelite bytes (``tree.py:319-366``).
+
+    ``gather=False`` matches the reference's semantics exactly: each tree
+    sees only its worker's row partition (the per-worker local cuRF fit,
+    ``tree.py:269-402``), which costs tree quality as worker count grows.
+    ``gather=True`` is the TPU-first improvement the reference cannot
+    afford over NCCL: one ICI ``all_gather`` of the uint8 binned matrix
+    (n x d bytes — 33 MB at 131k x 256, ~3 GB at the 1M x 3000 reference
+    shape) gives every tree the FULL dataset, making quality independent
+    of worker count while growth stays collective-free."""
 
     def per_device(bins_l, mask_l, stats_l, keys_l):
+        if gather:
+            bins_l = lax.all_gather(bins_l, DP_AXIS, axis=0, tiled=True)
+            mask_l = lax.all_gather(mask_l, DP_AXIS, axis=0, tiled=True)
+            stats_l = lax.all_gather(stats_l, DP_AXIS, axis=0, tiled=True)
         return lax.map(
             lambda k: _build_tree(bins_l, stats_l, mask_l, k, cfg), keys_l[0]
         )

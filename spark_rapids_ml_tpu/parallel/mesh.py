@@ -367,6 +367,39 @@ def allgather_ragged_rows_exact(a: np.ndarray) -> np.ndarray:
     )
 
 
+def unify_string_width(a: np.ndarray) -> np.ndarray:
+    """Cast an object/str/bytes array to a fixed-width dtype whose width is
+    agreed across the process world (the byte-moving collectives need every
+    rank to view rows at the same itemsize). Numeric arrays pass through."""
+    if a.dtype.kind not in "OUS":
+        return a
+    if a.dtype.kind == "O":
+        # only genuine strings may be stringified: an object column of
+        # Python ints/bytes would silently come back as digit/repr strings
+        kinds = {type(v) for v in a.ravel()[:1000]}
+        if kinds <= {str, np.str_}:
+            a = np.asarray(a, dtype=np.str_)
+        elif kinds <= {bytes, np.bytes_}:
+            a = np.asarray(a, dtype=np.bytes_)
+        else:
+            raise TypeError(
+                f"cannot exchange object column with element types {kinds}; "
+                "use a numeric or string dtype"
+            )
+    else:
+        a = np.asarray(a, dtype=np.str_ if a.dtype.kind == "U" else np.bytes_)
+    unit = np.dtype(a.dtype.kind + "1").itemsize
+    w_local = max(1, a.dtype.itemsize // unit)
+    w = int(allgather_host(np.asarray([w_local])).max())
+    return a.astype(f"{a.dtype.kind}{w}")
+
+
+def allgather_ragged_any(a: np.ndarray) -> np.ndarray:
+    """:func:`allgather_ragged_rows_exact` that also accepts string/object
+    columns (width-unified first so every rank's byte view agrees)."""
+    return allgather_ragged_rows_exact(unify_string_width(np.asarray(a)))
+
+
 def local_row_block(arr: jax.Array) -> np.ndarray:
     """This process's rows of a row-sharded array, assembled from its
     addressable shards in row order — no collective, and no assumption

@@ -1,5 +1,7 @@
 """DataFrame (data plane) tests."""
 
+import os
+
 import numpy as np
 import pytest
 import scipy.sparse as sp
@@ -94,3 +96,77 @@ def test_kfold():
     assert len(folds) == 4
     for train, val in folds:
         assert train.count() + val.count() == 20
+
+
+def _write_spark_vector_parquet(path, X, sparse_rows=(), label=None):
+    """Write parquet in the physical layout Spark ML uses for VectorUDT:
+    struct<type: int8, size: int32, indices: list<int32>, values:
+    list<double>>; rows in ``sparse_rows`` are stored sparse (type=0)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    sparse_rows = set(sparse_rows)
+    types, sizes, indices, values = [], [], [], []
+    for i, row in enumerate(X):
+        if i in sparse_rows:
+            nz = np.nonzero(row)[0]
+            types.append(0)
+            sizes.append(len(row))
+            indices.append(nz.astype(np.int32).tolist())
+            values.append(row[nz].astype(np.float64).tolist())
+        else:
+            types.append(1)
+            sizes.append(None)
+            indices.append(None)
+            values.append(row.astype(np.float64).tolist())
+    struct = pa.StructArray.from_arrays(
+        [
+            pa.array(types, pa.int8()),
+            pa.array(sizes, pa.int32()),
+            pa.array(indices, pa.list_(pa.int32())),
+            pa.array(values, pa.list_(pa.float64())),
+        ],
+        names=["type", "size", "indices", "values"],
+    )
+    cols, names = [struct], ["features"]
+    if label is not None:
+        cols.append(pa.array(label.astype(np.float64)))
+        names.append("label")
+    pq.write_table(pa.table(cols, names=names), path)
+
+
+def test_spark_vector_udt_parquet_roundtrip(tmp_path):
+    """Parquet written in Spark's VectorUDT physical schema (the format the
+    reference's benchmark data uses, ``core.py:160-241``) loads directly,
+    mixed dense/sparse rows included."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(50, 7))
+    X[5, :4] = 0.0
+    X[9] = 0.0
+    p = str(tmp_path / "sv.parquet")
+    _write_spark_vector_parquet(p, X, sparse_rows={5, 9, 11})
+    df = DataFrame.read_parquet(p)
+    np.testing.assert_allclose(df["features"], X, atol=0)
+
+
+def test_spark_vector_udt_streaming_fit(tmp_path):
+    """A streaming fit consumes Spark-VectorUDT parquet chunk-by-chunk."""
+    from spark_rapids_ml_tpu.feature import PCA
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(400, 6)) * [1, 5, 1, 1, 1, 1]
+    d = str(tmp_path / "dir")
+    os.makedirs(d)
+    for i in range(3):
+        _write_spark_vector_parquet(
+            os.path.join(d, f"part-{i}.parquet"),
+            X[i * 134 : (i + 1) * 134],
+            sparse_rows={0, 3},
+        )
+    scan = DataFrame.scan_parquet(d)
+    assert scan.count() == 400
+    m = PCA(k=2, streaming=True, stream_chunk_rows=64).fit(scan)
+    resident = PCA(k=2).fit(DataFrame({"features": X.astype(np.float32)}))
+    np.testing.assert_allclose(
+        np.abs(m.components_), np.abs(resident.components_), atol=1e-4
+    )

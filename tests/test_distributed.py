@@ -256,6 +256,35 @@ _KNN_WORKER = textwrap.dedent(
     qf = np.asarray(out.column("query_features"))
     itf = np.asarray(out.column("item_features"))
     assert np.allclose(dj, np.sqrt(((qf - itf) ** 2).sum(1)), atol=1e-4)
+
+    # string ids: the cross-process id exchange and the (index-selective)
+    # join must carry str ids byte-exactly; ids of differing widths across
+    # ranks exercise the global width agreement
+    # rank 1's ids are wider: exercises the global width agreement
+    all_sids = np.array(
+        ["it_%03d" % i if i < 90 else "it_%03d_r1" % i for i in range(len(Xi))],
+        dtype=object,
+    )
+    qids = np.array(["q_%02d" % i for i in range(len(Xq))], dtype=object)
+    m2 = NearestNeighbors(k=3, num_workers=4, idCol="sid").fit(
+        DataFrame({{"features": Xi[isl], "sid": all_sids[isl]}})
+    )
+    _, _, knn2 = m2.kneighbors(
+        DataFrame({{"features": Xq[qsl], "sid": qids[qsl]}})
+    )
+    idx2 = np.asarray(knn2.column("indices"))
+    assert idx2.dtype.kind == "U", idx2.dtype
+    exp3 = np.argsort(d2, axis=1)[:, :3]
+    assert (np.sort(idx2, 1) == np.sort(all_sids[exp3].astype(idx2.dtype), 1)).all()
+
+    out2 = m2.exactNearestNeighborsJoin(
+        DataFrame({{"features": Xq[qsl], "sid": qids[qsl]}}), distCol="d"
+    )
+    dj2 = np.asarray(out2.column("d"))
+    qf2 = np.asarray(out2.column("query_features"))
+    itf2 = np.asarray(out2.column("item_features"))
+    assert np.allclose(dj2, np.sqrt(((qf2 - itf2) ** 2).sum(1)), atol=1e-4)
+    assert np.asarray(out2.column("item_sid")).dtype.kind == "U"
     print(f"rank {{pid}} ok", flush=True)
     """
 )

@@ -131,3 +131,20 @@ def test_rf_export_feature_importances(rng):
     fi = sk.feature_importances_
     assert fi.shape == (10,)
     assert np.isfinite(fi).all()
+
+
+def test_rf_export_entropy_criterion(rng):
+    """Entropy-trained forests export entropy node impurities/criterion."""
+    X = rng.normal(size=(200, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    model = RandomForestClassifier(
+        numTrees=4, maxDepth=3, seed=0, impurity="entropy"
+    ).fit(DataFrame({"features": X, "label": y}))
+    sk = model.to_sklearn()
+    assert sk.criterion == "entropy"
+    assert sk.estimators_[0].criterion == "entropy"
+    # root impurity must be the entropy of the root class distribution
+    ls = model._leaf_stats_arr[0, 0]
+    p = ls / ls.sum()
+    exp = -np.sum(np.where(p > 0, p * np.log2(np.maximum(p, 1e-30)), 0.0))
+    np.testing.assert_allclose(sk.estimators_[0].tree_.impurity[0], exp, rtol=1e-5)

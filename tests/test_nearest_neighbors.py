@@ -123,3 +123,45 @@ def test_knn_backend_param_name():
     model = NearestNeighbors(n_neighbors=2, num_workers=1).fit(DataFrame({"features": Xi}))
     _, _, knn_df = model.kneighbors(DataFrame({"features": Xq}))
     assert knn_df["indices"].shape == (5, 2)
+
+
+def test_knn_string_ids_join():
+    """String idCol: kneighbors indices and the join's id columns carry the
+    user's string ids (single-process path; 2-process in test_distributed)."""
+    rng = np.random.default_rng(3)
+    Xi = rng.normal(size=(40, 5)).astype(np.float32)
+    Xq = rng.normal(size=(9, 5)).astype(np.float32)
+    ids = np.array(["item_%02d" % i for i in range(40)], dtype=object)
+    qids = np.array(["q%d" % i for i in range(9)], dtype=object)
+    model = NearestNeighbors(k=3, num_workers=2, idCol="sid").fit(
+        DataFrame({"features": Xi, "sid": ids})
+    )
+    _, _, knn_df = model.kneighbors(DataFrame({"features": Xq, "sid": qids}))
+    idx = np.asarray(knn_df.column("indices"))
+    d2 = ((Xq[:, None, :] - Xi[None, :, :]) ** 2).sum(-1)
+    exp = np.argsort(d2, axis=1)[:, :3]
+    order = np.argsort(qids.astype(str), kind="stable")
+    assert (np.sort(idx, 1) == np.sort(ids[exp[order]].astype(idx.dtype), 1)).all()
+
+    out = model.exactNearestNeighborsJoin(
+        DataFrame({"features": Xq, "sid": qids}), distCol="d"
+    )
+    qf = np.asarray(out.column("query_features"))
+    itf = np.asarray(out.column("item_features"))
+    np.testing.assert_allclose(
+        np.asarray(out.column("d")), np.sqrt(((qf - itf) ** 2).sum(1)), atol=1e-5
+    )
+    assert set(np.asarray(out.column("item_sid"))) <= set(ids)
+
+
+def test_knn_object_int_ids_rejected_for_exchange():
+    """Object columns of non-strings must fail loudly in the width-unified
+    exchange (silent stringification would corrupt ids)."""
+    from spark_rapids_ml_tpu.parallel.mesh import unify_string_width
+
+    with pytest.raises(TypeError, match="element types"):
+        unify_string_width(np.array([1, 2, 3], dtype=object))
+    out = unify_string_width(np.array(["a", "bb"], dtype=object))
+    assert out.dtype.kind == "U"
+    outb = unify_string_width(np.array([b"a", b"bb"], dtype=object))
+    assert outb.dtype.kind == "S"
