@@ -212,6 +212,11 @@ def bench_logreg(X, mask, y, mesh, n_chips):
 
     from spark_rapids_ml_tpu.ops.logreg_kernels import logreg_fit
 
+    # bf16 objective reads (f32 stats/params/accumulation): halves the
+    # HBM bytes of the bandwidth-bound eval — the TPU analog of the TF32
+    # tensor-core reads cuML gets implicitly on Ampere-class GPUs
+    obj_dtype = os.environ.get("BENCH_LOGREG_DTYPE", "bfloat16")
+
     def timed_fn(X, m, y, l2):
         out = logreg_fit(
             X, m, y,
@@ -219,7 +224,7 @@ def bench_logreg(X, mask, y, mesh, n_chips):
             standardization=False,
             l1=jnp.float32(0.0), l2=l2,
             use_l1=False, max_iter=LOGREG_ITERS, tol=jnp.float32(0.0),
-            mesh=mesh,
+            mesh=mesh, objective_dtype=obj_dtype,
         )
         return _checksum(out, aux=out["n_iter"])
 
@@ -238,6 +243,7 @@ def bench_logreg(X, mask, y, mesh, n_chips):
         "samples_per_sec_per_chip": n * iters / t / n_chips,
         "fit_seconds": t,
         "iters": iters,
+        "objective_dtype": obj_dtype,
         "flops_model": flops,
         "baseline_samples_per_sec": 2.9e8,
     }
@@ -511,7 +517,11 @@ def main() -> None:
         CSIZE = _csize(N_ROWS)
         global RF_ROWS, RF_TREES, RF_DEPTH
         if "BENCH_RF_ROWS" not in os.environ:
-            RF_ROWS, RF_TREES, RF_DEPTH = 8192, 4, 8
+            RF_ROWS = 8192
+        if "BENCH_RF_TREES" not in os.environ:
+            RF_TREES = 4
+        if "BENCH_RF_DEPTH" not in os.environ:
+            RF_DEPTH = 8
         print(
             f"[bench] cpu device: reducing N_ROWS to {N_ROWS}, "
             f"rf to {RF_TREES}x{RF_ROWS}x depth {RF_DEPTH} "

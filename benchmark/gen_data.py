@@ -34,8 +34,32 @@ def _blobs_struct(n_rows: int, n_cols: int, seed: int, *, centers: int = 1000,
 
 def _blobs_chunk(s: Dict[str, Any], count: int, rng: np.random.Generator):
     lab = rng.integers(0, len(s["C"]), count)
-    X = s["C"][lab] + s["std"] * rng.normal(size=(count, s["C"].shape[1]))
-    return X.astype(np.float32), lab.astype(np.float64)
+    d = s["C"].shape[1]
+    dt = np.dtype(s.get("_dtype", "float32"))
+    try:
+        # torch's vectorized normal sampler is ~3-4x numpy's ziggurat on
+        # weak cores — at 100M x 256 that is hours. Seeded FROM the
+        # (seed, file, group) numpy stream, so output stays deterministic
+        # and worker-count independent (just a different stream than the
+        # pure-numpy fallback).
+        import torch
+
+        g = torch.Generator().manual_seed(int(rng.integers(0, 2**31 - 1)))
+        tdt = {
+            np.dtype(np.float16): torch.float16,
+            np.dtype(np.float64): torch.float64,
+        }.get(dt, torch.float32)
+        noise = torch.randn((count, d), generator=g, dtype=tdt).numpy()
+    except ImportError:  # pragma: no cover - torch is in the base image
+        noise = rng.normal(size=(count, d)).astype(dt, copy=False)
+    if dt == np.float16:
+        C = s.get("_C16")
+        if C is None:
+            C = s["_C16"] = s["C"].astype(np.float16)
+        X = C[lab] + np.float16(s["std"]) * noise
+    else:
+        X = (s["C"][lab] + np.float32(s["std"]) * noise).astype(dt, copy=False)
+    return X, lab.astype(np.float64)
 
 
 def _low_rank_struct(n_rows: int, n_cols: int, seed: int, *,

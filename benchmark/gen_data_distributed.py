@@ -39,10 +39,10 @@ from .gen_data import GENERATOR_PAIRS as GENERATORS
 _worker_state: Dict[str, Any] = {}
 
 
-def _init_worker(kind, struct, seed, rows_per_group, out_dir):
+def _init_worker(kind, struct, seed, rows_per_group, out_dir, dtype="float32"):
     _worker_state.update(
         kind=kind, struct=struct, seed=seed,
-        rows_per_group=rows_per_group, out_dir=out_dir,
+        rows_per_group=rows_per_group, out_dir=out_dir, dtype=dtype,
     )
 
 
@@ -74,6 +74,10 @@ def _write_file(task: Tuple[int, int]) -> str:
                 # densified on disk, one bounded group at a time —
                 # exactly how DataFrame.write_parquet stores CSR
                 X = X.toarray()
+            # storage dtype: float16 halves disk AND host->device wire
+            # bytes (the streaming path upcasts on device); compute stays
+            # f32/f64 regardless
+            X = np.asarray(X, dtype=st["dtype"])
             arrays = [
                 pa.FixedSizeListArray.from_arrays(pa.array(X.ravel()), X.shape[1])
             ]
@@ -103,6 +107,7 @@ def generate(
     num_procs: Optional[int] = None,
     rows_per_group: int = 262_144,
     seed: int = 0,
+    dtype: str = "float32",
     **gen_kwargs: Any,
 ) -> str:
     """Generate ``n_rows x n_cols`` of ``kind`` as ``num_files`` parquet
@@ -117,13 +122,16 @@ def generate(
     for stale in _glob.glob(os.path.join(output_dir, "part-*.parquet")):
         os.remove(stale)
     struct = GENERATORS[kind][0](n_rows, n_cols, seed, **gen_kwargs)
+    # generators with a fast narrow-dtype path read this; the writer
+    # casts to it regardless, so it is a hint, not a contract
+    struct["_dtype"] = dtype
 
     base = n_rows // num_files
     rem = n_rows % num_files
     tasks = [(i, base + (1 if i < rem else 0)) for i in range(num_files)]
     tasks = [t for t in tasks if t[1] > 0]
 
-    init_args = (kind, struct, seed, rows_per_group, output_dir)
+    init_args = (kind, struct, seed, rows_per_group, output_dir, dtype)
     num_procs = num_procs or min(len(tasks), os.cpu_count() or 1)
     if num_procs <= 1:
         _init_worker(*init_args)
@@ -153,12 +161,18 @@ def main() -> None:
     parser.add_argument("--num_procs", type=int, default=None)
     parser.add_argument("--rows_per_group", type=int, default=262_144)
     parser.add_argument("--random_seed", type=int, default=0)
+    parser.add_argument(
+        "--dtype", choices=["float64", "float32", "float16"], default="float32",
+        help="storage dtype (float16 halves disk + ingest bytes; compute "
+        "dtype is unaffected)",
+    )
     args = parser.parse_args()
 
     generate(
         args.kind, args.num_rows, args.num_cols, args.output_dir,
         num_files=args.output_num_files, num_procs=args.num_procs,
         rows_per_group=args.rows_per_group, seed=args.random_seed,
+        dtype=args.dtype,
     )
     print(
         f"wrote {args.num_rows}x{args.num_cols} {args.kind} -> "

@@ -242,7 +242,12 @@ class ParquetChunkSource(ChunkSource):
                 X = spark_vector_to_numpy(fc, dtype=dtype)
             else:
                 X = np.stack([np.asarray(v) for v in fc.to_pylist()])
-        X = np.asarray(X, dtype=dtype)
+        # keep a narrower float STORAGE dtype: put_chunk ships it as-is
+        # and upcasts on device (wire-dtype optimization)
+        if not (
+            X.dtype.kind == "f" and X.dtype.itemsize < np.dtype(dtype).itemsize
+        ):
+            X = np.asarray(X, dtype=dtype)
         y = w = None
         if self._label_col:
             y = t.column(self._label_col).to_numpy(zero_copy_only=False).astype(dtype)

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional
 
+import os
+
 import numpy as np
 
 import jax
@@ -91,6 +93,7 @@ class LogisticRegressionClass:
             "l1_ratio": 0.0,
             "max_iter": 100,
             "tol": 1e-6,
+            "objective_dtype": None,
         }
 
 
@@ -245,6 +248,12 @@ class LogisticRegression(
                 # rows are dp-sharded by _pre_process_data: lets the TPU
                 # path use the fused Pallas loss+grad pass
                 mesh=inputs.mesh,
+                # bf16 objective reads (f32 accumulation) via framework
+                # kwarg or env; default full f32
+                objective_dtype=str(
+                    params.get("objective_dtype")
+                    or os.environ.get("TPUML_LOGREG_OBJECTIVE_DTYPE", "float32")
+                ),
             )
             return {
                 "coef_": np.asarray(out["coef_"]),

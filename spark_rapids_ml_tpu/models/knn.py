@@ -273,6 +273,16 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
         self, query_df: DataFrame, distCol: str = "distCol"
     ) -> DataFrame:
         id_col = self.getIdCol()
+        if jax.process_count() > 1:
+            # fail fast, before the (expensive) distributed search: every
+            # item column must be exchangeable (numeric, str, or bytes)
+            from ..parallel.mesh import object_string_kind
+
+            probe = self._ensureIdCol(self._item_df_withid)
+            for c in probe.columns:
+                col = np.asarray(probe.column(c))
+                if col.dtype.kind == "O":
+                    object_string_kind(col)  # raises on non-string objects
         item_df_withid, query_df_withid, knn_df = self.kneighbors(query_df)
         if jax.process_count() > 1:
             # a query's neighbors may be items owned by other ranks. The

@@ -52,15 +52,16 @@ def _row_tile(d: int, Kp: int) -> int:
 
 
 def logreg_pallas_ok(d: int, n_classes: int, dtype) -> bool:
-    """Trace-time gate: TPU, f32, lane-aligned d, and few enough classes
-    that the sublane-padded class block plus the loss lane pack into one
-    128-lane row (ceil(K/8)*8 + 1 <= 128, i.e. K <= 120)."""
+    """Trace-time gate: TPU, f32/bf16 X, lane-aligned d, and few enough
+    classes that the sublane-padded class block plus the loss lane pack
+    into one 128-lane row (ceil(K/8)*8 + 1 <= 128, i.e. K <= 120). bf16 X
+    tiles are upcast in VMEM; all arithmetic stays f32."""
     return (
         (jax.default_backend() == "tpu" or FORCE_INTERPRET)
         and d % _LANES == 0
         and d <= 2048
         and -(-n_classes // 8) * 8 + 1 <= _LANES
-        and dtype == jnp.float32
+        and dtype in (jnp.float32, jnp.bfloat16)
     )
 
 
@@ -89,7 +90,8 @@ def _loss_grad_pallas(Xl, yl, ml, A, b_row, *, multinomial: bool,
 
         row = i * tile + lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
         valid = row < n
-        x = jnp.where(valid, x_ref[:], 0.0)
+        # bf16 X tiles upcast here (VMEM-resident); HBM read was half-width
+        x = jnp.where(valid, x_ref[:].astype(jnp.float32), 0.0)
         m = jnp.where(valid[:, 0], m_ref[:], 0.0)
         yv = jnp.where(valid[:, 0], y_ref[:], 0.0)
 

@@ -309,8 +309,24 @@ def _build_tree(
                 ).astype(dt)                              # (C, n_nodes)
                 Boh = (bc[:, :, None] == bin_ar[None, None, :]).astype(dt)
                 Boh = Boh.reshape(C, F * nb)              # (C, F*nb)
+                # TPU's default f32 matmul uses bf16 multiplies — exact for
+                # classification (one-hots and small-integer weights are
+                # bf16-representable; accumulation is f32) but NOT for
+                # variance stats carrying y/y^2, where rounding would flip
+                # near-tied splits vs the scatter path. Those pay the
+                # multi-pass HIGHEST f32 emulation.
+                prec = (
+                    lax.Precision.HIGHEST
+                    if cfg.impurity == "variance"
+                    else None
+                )
                 return acc + jnp.stack(
-                    [(Noh * swc[:, s][:, None]).T @ Boh for s in range(S)],
+                    [
+                        jnp.matmul(
+                            (Noh * swc[:, s][:, None]).T, Boh, precision=prec
+                        )
+                        for s in range(S)
+                    ],
                     axis=-1,
                 )                                         # (n_nodes, F*nb, S)
 

@@ -367,6 +367,23 @@ def allgather_ragged_rows_exact(a: np.ndarray) -> np.ndarray:
     )
 
 
+def object_string_kind(a: np.ndarray):
+    """np.str_/np.bytes_ for an all-str / all-bytes object array; raises
+    TypeError otherwise. Scans EVERY element: a single stray Python int
+    would silently stringify (corrupting joins), and ranks sampling
+    different prefixes could disagree on raising vs entering a collective
+    (deadlock) — so no shortcut sampling."""
+    kinds = {type(v) for v in a.ravel()}
+    if kinds <= {str, np.str_}:
+        return np.str_
+    if kinds <= {bytes, np.bytes_}:
+        return np.bytes_
+    raise TypeError(
+        f"cannot exchange object column with element types {kinds}; "
+        "use a numeric or string dtype"
+    )
+
+
 def unify_string_width(a: np.ndarray) -> np.ndarray:
     """Cast an object/str/bytes array to a fixed-width dtype whose width is
     agreed across the process world (the byte-moving collectives need every
@@ -374,18 +391,7 @@ def unify_string_width(a: np.ndarray) -> np.ndarray:
     if a.dtype.kind not in "OUS":
         return a
     if a.dtype.kind == "O":
-        # only genuine strings may be stringified: an object column of
-        # Python ints/bytes would silently come back as digit/repr strings
-        kinds = {type(v) for v in a.ravel()[:1000]}
-        if kinds <= {str, np.str_}:
-            a = np.asarray(a, dtype=np.str_)
-        elif kinds <= {bytes, np.bytes_}:
-            a = np.asarray(a, dtype=np.bytes_)
-        else:
-            raise TypeError(
-                f"cannot exchange object column with element types {kinds}; "
-                "use a numeric or string dtype"
-            )
+        a = np.asarray(a, dtype=object_string_kind(a))
     else:
         a = np.asarray(a, dtype=np.str_ if a.dtype.kind == "U" else np.bytes_)
     unit = np.dtype(a.dtype.kind + "1").itemsize

@@ -184,3 +184,45 @@ def test_mean_and_cov_chunked_pallas_branch_matches_scan(monkeypatch):
     assert np.abs(np.asarray(m1) - np.asarray(m2)).max() < 1e-3
     scale = np.abs(np.asarray(c1)).max()
     assert np.abs(np.asarray(c1) - np.asarray(c2)).max() / scale < 1e-4
+
+
+def test_logreg_fused_bf16_objective_close_to_f32():
+    """bf16 X reads (f32 accumulation) must land within solver noise of
+    the f32 fit — the bandwidth-halving bench configuration."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import logreg_pallas
+    from spark_rapids_ml_tpu.ops.logreg_kernels import logreg_fit
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh, shard_rows
+
+    rng = np.random.default_rng(0)
+    n, d = 512, 128
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d,))
+    y = (X @ w > 0).astype(np.float32)
+    mesh = make_mesh(2)
+    Xd, mask = shard_rows(X, mesh)
+    yd, _ = shard_rows(y, mesh)
+
+    logreg_pallas.FORCE_INTERPRET = True
+    jax.clear_caches()
+    try:
+        kw = dict(
+            n_classes=2, multinomial=False, fit_intercept=True,
+            standardization=True, l1=jnp.float32(0.0), l2=jnp.float32(1e-3),
+            use_l1=False, max_iter=30, tol=jnp.float32(0.0), mesh=mesh,
+        )
+        f32 = logreg_fit(Xd, mask, yd, objective_dtype="float32", **kw)
+        b16 = logreg_fit(Xd, mask, yd, objective_dtype="bfloat16", **kw)
+    finally:
+        logreg_pallas.FORCE_INTERPRET = False
+        jax.clear_caches()
+    np.testing.assert_allclose(
+        np.asarray(b16["coef_"]), np.asarray(f32["coef_"]), rtol=0.05, atol=0.02
+    )
+    # predictions must agree except at the decision boundary
+    agree = np.mean(
+        (X @ np.asarray(f32["coef_"]).T[:, 0] > 0)
+        == (X @ np.asarray(b16["coef_"]).T[:, 0] > 0)
+    )
+    assert agree > 0.99, agree

@@ -423,3 +423,42 @@ def test_logreg_streaming_csr_matches_streaming_dense_exactly(rng):
     np.testing.assert_allclose(
         m_csr.interceptVector, m_dense.interceptVector, rtol=1e-5, atol=1e-6
     )
+
+
+def test_wire_dtype_f16_storage_streams_to_f32_fit(tmp_path):
+    """float16-stored parquet streams with the storage dtype on the wire
+    (upcast on device) and fits in f32 with resident-fit parity."""
+    import os as _os
+
+    from spark_rapids_ml_tpu.data.dataframe import DataFrame
+    from spark_rapids_ml_tpu.data.chunks import ParquetChunkSource
+    from spark_rapids_ml_tpu.models.feature import PCA
+
+    rng = np.random.default_rng(3)
+    X = (rng.normal(size=(500, 8)) * [1, 6, 1, 1, 1, 1, 1, 1]).astype(np.float16)
+    d = str(tmp_path / "f16")
+    DataFrame({"features": X}).write_parquet(d)
+    src = ParquetChunkSource(d)
+    chunk = next(iter(src.iter_chunks(128, dtype=np.float32)))
+    assert chunk.X.dtype == np.float16  # storage dtype preserved on host
+
+    m = PCA(k=2, streaming=True, stream_chunk_rows=128).fit(
+        DataFrame.scan_parquet(d)
+    )
+    res = PCA(k=2).fit(DataFrame({"features": X.astype(np.float32)}))
+    np.testing.assert_allclose(
+        np.abs(m.components_), np.abs(res.components_), atol=2e-3
+    )
+
+
+def test_gen_data_distributed_f16(tmp_path):
+    from benchmark.gen_data_distributed import generate
+    from spark_rapids_ml_tpu.data.dataframe import DataFrame
+
+    out = generate(
+        "blobs", 2000, 16, str(tmp_path / "d"),
+        num_files=3, num_procs=1, rows_per_group=512, dtype="float16",
+    )
+    df = DataFrame.read_parquet(out)
+    X = np.asarray(df["features"])
+    assert X.dtype == np.float16 and X.shape == (2000, 16)
