@@ -141,29 +141,58 @@ def _best_time(make_args, run, reps: int = 3):
     return min(times), aux
 
 
-def bench_pca(X, mask, mesh, n_chips):
+INNER_FITS = max(1, int(os.environ.get("BENCH_INNER_FITS", 4)))
+
+
+def _time_scanned_fits(fit_body, args_for_rep):
+    """Best per-fit time of INNER_FITS fits inside ONE dispatch.
+
+    A single fit is ~20-50 ms on chip while the tunnel charges ~65 ms per
+    dispatch — one fit per dispatch under-reports the chip several-fold.
+    ``fit_body(eps, *args) -> checksum`` runs per inner fit; the eps scan
+    perturbs each fit's inputs so XLA cannot CSE them into one."""
     import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def inner(*args):
+        def body(acc, eps):
+            return acc + fit_body(eps, *args), None
+
+        acc, _ = lax.scan(
+            body,
+            jnp.zeros((2,), jnp.float32),
+            jnp.arange(1, INNER_FITS + 1, dtype=jnp.float32) * 1e-7,
+        )
+        return acc
+
+    timed = jax.jit(inner)
+    np.asarray(timed(*args_for_rep(0)))  # compile (distinct rep-0 inputs
+    # would be memoizable on remote backends; _best_time starts at rep 1)
+    t, _ = _best_time(lambda rep: args_for_rep(rep + 1), timed)
+    return t / INNER_FITS
+
+
+def bench_pca(X, mask, mesh, n_chips):
     import jax.numpy as jnp
 
     from spark_rapids_ml_tpu.models.feature import _pca_fit_kernel
 
-    timed = jax.jit(
-        lambda X, m: _checksum(
-            _pca_fit_kernel(X, m, 3, mesh=mesh, csize=CSIZE)
+    def fit_body(eps, X, m):
+        return _checksum(
+            _pca_fit_kernel(X, m * (1.0 + eps), 3, mesh=mesh, csize=CSIZE)
         )
-    )
-    np.asarray(timed(X, mask))  # compile
-    # rep+1: never reuse the warmup's input values (memoizable on remote
-    # backends); each rep gets a distinct perturbed mask buffer
-    t, _ = _best_time(
-        lambda rep: (X, mask * jnp.float32(1.0 + (rep + 1) * 1e-6)),
-        timed,
+
+    t = _time_scanned_fits(
+        fit_body,
+        lambda rep: (X, mask * jnp.float32(1.0 + rep * 1e-6)),
     )
     n = N_ROWS
     flops = 2.0 * n * N_COLS * N_COLS  # Gram dominates
     return {
         "samples_per_sec_per_chip": n / t / n_chips,
         "fit_seconds": t,
+        "inner_fits_per_dispatch": INNER_FITS,
         "flops_model": flops,
         "baseline_samples_per_sec": 1.1e8,
     }
@@ -261,22 +290,24 @@ def bench_linreg(X, mask, y, mesh, n_chips):
         solve_normal,
     )
 
-    def timed_fn(X, m, y):
-        stats = linreg_suffstats_chunked(X, m, y, mesh=mesh, csize=CSIZE)
-        out = solve_normal(stats, jnp.float32(1e-5), standardization=True)
-        return _checksum(out)
+    def fit_body(eps, X, m, y):
+        stats = linreg_suffstats_chunked(
+            X, m * (1.0 + eps), y, mesh=mesh, csize=CSIZE
+        )
+        return _checksum(
+            solve_normal(stats, jnp.float32(1e-5), standardization=True)
+        )
 
-    timed = jax.jit(timed_fn)
-    np.asarray(timed(X, mask, y))  # compile
-    t, _ = _best_time(
-        lambda rep: (X, mask * jnp.float32(1.0 + (rep + 1) * 1e-6), y),
-        timed,
+    t = _time_scanned_fits(
+        fit_body,
+        lambda rep: (X, mask * jnp.float32(1.0 + rep * 1e-6), y),
     )
     n = N_ROWS
     flops = 2.0 * n * N_COLS * N_COLS
     return {
         "samples_per_sec_per_chip": n / t / n_chips,
         "fit_seconds": t,
+        "inner_fits_per_dispatch": INNER_FITS,
         "flops_model": flops,
         "baseline_samples_per_sec": 1.1e8,
     }

@@ -48,13 +48,39 @@ class BenchmarkBase:
         pass
 
     # -- data --------------------------------------------------------------
+    @staticmethod
+    def _world() -> "tuple[int, int]":
+        """(rank, nprocs) from the distributed-launcher env (the same
+        TPUML_* contract parallel/context.py bootstraps from)."""
+        try:
+            n = int(os.environ.get("TPUML_NUM_PROCS", "1"))
+            r = int(os.environ.get("TPUML_PROC_ID", "0"))
+        except ValueError:
+            return 0, 1
+        return (r, n) if n > 1 else (0, 1)
+
     def load_data(self) -> DataFrame:
         a = self.args
         if a.train_path:
-            return DataFrame.read_parquet(a.train_path)
-        return make_dataframe(
-            self.default_dataset, a.num_rows, a.num_cols, seed=a.random_seed
-        )
+            df = DataFrame.read_parquet(a.train_path)
+        else:
+            df = make_dataframe(
+                self.default_dataset, a.num_rows, a.num_cols, seed=a.random_seed
+            )
+        rank, nprocs = self._world()
+        if nprocs > 1:
+            # multi-process runs hold one partition per rank (the cluster
+            # layout the reference's spark-submit scripts produce); the
+            # full dataset — generated or read — is loaded identically on
+            # every rank and sliced, so ranks agree on the global contents
+            # and no rows are duplicated into the distributed fit
+            n = df.count()
+            self._global_rows = n  # report global scale, not the partition
+            lo, hi = rank * n // nprocs, (rank + 1) * n // nprocs
+            mask = np.zeros(n, bool)
+            mask[lo:hi] = True
+            df = df.filter(mask)
+        return df
 
     def load_transform_data(self, train_df: DataFrame) -> DataFrame:
         if self.args.transform_path:
@@ -68,7 +94,7 @@ class BenchmarkBase:
     def run(self) -> None:
         train_df = self.load_data()
         transform_df = self.load_transform_data(train_df)
-        self._actual_rows = train_df.count()
+        self._actual_rows = getattr(self, "_global_rows", None) or train_df.count()
         self._actual_cols = (
             train_df.column("features").shape[1] if "features" in train_df else 0
         )
@@ -90,7 +116,7 @@ class BenchmarkBase:
 
     def report(self, row: Dict[str, Any]) -> None:
         path = self.args.report_path
-        if not path:
+        if not path or self._world()[0] != 0:
             return
         meta = {
             "datetime": datetime.datetime.now().isoformat(timespec="seconds"),

@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Multi-host benchmark launcher — the honest analog of the reference's
+# cluster submission scripts (databricks/run_benchmark.sh:44-135,
+# dataproc/, aws-emr/: they create a Spark cluster and spark-submit the
+# same benchmark_runner with N workers). Spark-free, a "cluster" is N
+# processes joined through the jax.distributed bootstrap this framework
+# already uses (parallel/context.py): each process gets the SAME command
+# line plus TPUML_COORDINATOR / TPUML_NUM_PROCS / TPUML_PROC_ID.
+#
+#   ./run_benchmark_multihost.sh <nprocs> [cpu|tpu] [num_rows] [num_cols] [report.csv]
+#
+# Single-machine form (this script): N local processes, each simulating a
+# host with its virtual CPU devices — the topology the 2-process
+# distributed tests validate. On a real multi-host TPU pod, run the inner
+# command on every host with TPUML_PROC_ID set to the host index and
+# TPUML_COORDINATOR pointing at host 0 (exactly how the reference's
+# cluster scripts fan out spark-submit).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+NPROCS="${1:-2}"
+PLATFORM="${2:-cpu}"
+NUM_ROWS="${3:-5000}"
+NUM_COLS="${4:-64}"
+REPORT="${5:-}"
+
+PORT=$(python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+EOF
+)
+COORD="127.0.0.1:${PORT}"
+
+REPORT_ARGS=()
+if [ -n "$REPORT" ]; then
+    REPORT_ARGS=(--report_path "$REPORT")
+fi
+
+# one representative workload per family keeps the multi-host smoke fast;
+# pass EXTRA_ALGOS to widen
+ALGOS="${EXTRA_ALGOS:-pca kmeans logistic_regression}"
+
+for ALGO in $ALGOS; do
+    echo "== multihost($NPROCS) $ALGO =="
+    PIDS=()
+    for PID_IDX in $(seq 0 $((NPROCS - 1))); do
+        TPUML_COORDINATOR="$COORD" TPUML_NUM_PROCS="$NPROCS" \
+        TPUML_PROC_ID="$PID_IDX" \
+        python benchmark_runner.py "$ALGO" \
+            --platform "$PLATFORM" --num_rows "$NUM_ROWS" \
+            --num_cols "$NUM_COLS" --num_chips "$NPROCS" --num_runs 1 \
+            ${REPORT_ARGS[@]+"${REPORT_ARGS[@]}"} \
+            > "/tmp/bench_mh_${ALGO}_${PID_IDX}.log" 2>&1 &
+        PIDS+=($!)
+    done
+    FAIL=0
+    for P in "${PIDS[@]}"; do
+        wait "$P" || FAIL=1
+    done
+    if [ "$FAIL" -ne 0 ]; then
+        echo "-- $ALGO FAILED; rank logs:"
+        tail -20 "/tmp/bench_mh_${ALGO}_"*.log
+        exit 1
+    fi
+    grep -h "fit_s\|total_s\|seconds\|RESULT" "/tmp/bench_mh_${ALGO}_0.log" | tail -3 || true
+done
+echo "multihost benchmark OK ($NPROCS procs)"

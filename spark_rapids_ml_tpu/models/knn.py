@@ -275,14 +275,30 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
         id_col = self.getIdCol()
         if jax.process_count() > 1:
             # fail fast, before the (expensive) distributed search: every
-            # item column must be exchangeable (numeric, str, or bytes)
-            from ..parallel.mesh import object_string_kind
+            # item column must be exchangeable (numeric, str, or bytes).
+            # The verdict must be AGREED across ranks — partitions can
+            # differ in typing, and one rank raising while another enters
+            # the kneighbors collective would hang, not error.
+            from ..parallel.mesh import allgather_host, object_string_kind
 
             probe = self._ensureIdCol(self._item_df_withid)
+            local_err = ""
             for c in probe.columns:
                 col = np.asarray(probe.column(c))
                 if col.dtype.kind == "O":
-                    object_string_kind(col)  # raises on non-string objects
+                    try:
+                        object_string_kind(col)
+                    except TypeError as e:
+                        local_err = f"column {c!r}: {e}"
+                        break
+            any_err = allgather_host(
+                np.asarray([1 if local_err else 0], np.int64)
+            ).sum()
+            if any_err:
+                raise TypeError(
+                    "exactNearestNeighborsJoin: non-exchangeable item column "
+                    f"on at least one rank ({local_err or 'other rank'})"
+                )
         item_df_withid, query_df_withid, knn_df = self.kneighbors(query_df)
         if jax.process_count() > 1:
             # a query's neighbors may be items owned by other ranks. The

@@ -427,3 +427,16 @@ def test_two_process_streaming_matches_single_process(tmp_path):
         res["log"], np.asarray(log.coefficientMatrix), rtol=2e-2, atol=2e-3
     )
     np.testing.assert_allclose(float(res["km_cost"]), km.trainingCost, rtol=2e-2)
+
+
+@pytest.mark.slow
+def test_multihost_benchmark_launcher():
+    """The cluster-submission analog (reference databricks/run_benchmark.sh):
+    N processes, same command line, joined via the TPUML_* bootstrap."""
+    r = subprocess.run(
+        [os.path.join(REPO, "run_benchmark_multihost.sh"), "2", "cpu", "3000", "16"],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "EXTRA_ALGOS": "pca"},
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "multihost benchmark OK" in r.stdout
