@@ -208,10 +208,19 @@ def bench_kmeans(X, mask, mesh, n_chips):
     centers0 = jax.random.normal(key, (KMEANS_K, N_COLS), dtype=jnp.float32)
     jax.block_until_ready(centers0)
     csize = CSIZE
+    # bf16 matmul operands (f32 accumulation) on the two MXU contractions
+    # — the TF32-tensor-core analog; see pairwise_sq_dists
+    km_dtype = os.environ.get("BENCH_KMEANS_DTYPE", "bfloat16")
+    if km_dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"BENCH_KMEANS_DTYPE must be float32|bfloat16, got {km_dtype!r}"
+        )
+    mm = jnp.bfloat16 if km_dtype == "bfloat16" else None
 
     def timed_fn(X, m, c):
         out = kmeans_lloyd(
-            X, m, c, mesh=mesh, csize=csize, max_iter=KMEANS_ITERS, tol=0.0
+            X, m, c, mesh=mesh, csize=csize, max_iter=KMEANS_ITERS, tol=0.0,
+            matmul_dtype=mm,
         )
         return _checksum(out, aux=out[2])
 
@@ -230,6 +239,7 @@ def bench_kmeans(X, mask, mesh, n_chips):
         "samples_per_sec_per_chip": n * iters / t / n_chips,
         "fit_seconds": t,
         "iters": iters,
+        "matmul_dtype": km_dtype,
         "flops_model": flops,
         "baseline_samples_per_sec": 2.9e7,
     }
@@ -615,6 +625,15 @@ def main() -> None:
         "rf": lambda: bench_rf(X, mask, y, mesh, n_chips),
         "pca_stream": lambda: bench_pca_stream(mesh, n_chips),
     }
+    # BENCH_ONLY=rf,kmeans : run a subset (tuning loops); full runs only
+    # for the recorded metric
+    only = os.environ.get("BENCH_ONLY")
+    if only:
+        keep = {s.strip() for s in only.split(",") if s.strip()}
+        unknown = keep - set(runs)
+        if unknown:
+            sys.exit(f"BENCH_ONLY names unknown entries: {sorted(unknown)}")
+        runs = {k: v for k, v in runs.items() if k in keep}
     from spark_rapids_ml_tpu.utils.profiling import trace
 
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
@@ -665,9 +684,12 @@ def main() -> None:
         if not r.get("tunnel_bound")
     ] or [r["vs_baseline"] for r in results.values()]
     geomean_vs = math.exp(sum(math.log(max(v, 1e-12)) for v in vs) / len(vs))
-    headline = results.get("pca") or next(iter(results.values()))
+    if "pca" in results:
+        head_name, headline = "pca", results["pca"]
+    else:  # BENCH_ONLY subset without pca: label honestly
+        head_name, headline = next(iter(results.items()))
     line = {
-        "metric": "pca_fit_throughput",
+        "metric": f"{head_name}_fit_throughput",
         "value": round(headline["samples_per_sec_per_chip"], 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(headline["vs_baseline"], 3),

@@ -17,6 +17,7 @@ TPU-native fit (vs cuML's NCCL-allreduce Lloyd):
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -85,6 +86,7 @@ class KMeansClass:
             "random_state": 1,
             "oversampling_factor": 2.0,
             "distance_measure": "euclidean",
+            "matmul_dtype": None,
         }
 
 
@@ -146,6 +148,19 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
 
     def _chunk_rows(self, n_rows: int, n_dp: int) -> int:
         return self._equal_chunk_rows(n_rows, n_dp, _CHUNK)
+
+    @staticmethod
+    def _resolve_matmul_dtype(params):
+        """Validated (early, before any seeding work) bf16-matmul option;
+        returns a jnp dtype or None. Kwarg beats TPUML_KMEANS_MATMUL_DTYPE."""
+        mm = params.get("matmul_dtype") or os.environ.get(
+            "TPUML_KMEANS_MATMUL_DTYPE"
+        )
+        if mm is not None and str(mm) not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"matmul_dtype must be float32|bfloat16, got {mm!r}"
+            )
+        return jnp.bfloat16 if str(mm) == "bfloat16" else None
 
     def _feature_pad_multiple(self) -> int:
         """Lloyd's ``while_loop`` triggers a defensive full copy of X at
@@ -321,6 +336,7 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
             k = int(params["n_clusters"])
             if k > inputs.n_rows:
                 raise ValueError(f"k={k} must be <= number of rows {inputs.n_rows}")
+            mm = self._resolve_matmul_dtype(params)
             rng = np.random.default_rng(int(params.get("random_state") or 0))
             if params.get("init") == "random":
                 centers0 = self._init_random(inputs, k, rng)
@@ -338,6 +354,9 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
                 csize=inputs.csize,
                 max_iter=int(params["max_iter"]),
                 tol=float(params["tol"]),
+                # bf16 matmul operands / f32 accumulation on the two MXU
+                # contractions (~2x); final cost pass stays f32
+                matmul_dtype=mm,
             )
             # strip lane-padding columns (zero by the Lloyd invariant)
             return {
@@ -419,6 +438,7 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
             k = int(params["n_clusters"])
             if k > inputs.n_rows:
                 raise ValueError(f"k={k} must be <= number of rows {inputs.n_rows}")
+            mm = self._resolve_matmul_dtype(params)  # validate before seeding
             rng = np.random.default_rng(int(params.get("random_state") or 0))
             owner = _stream_owner(inputs)
             if params.get("init") == "random":
@@ -436,6 +456,7 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
                 np.asarray(centers0),
                 max_iter=int(params["max_iter"]),
                 tol=float(params["tol"]),
+                matmul_dtype=mm,
             )
             return {
                 "cluster_centers": np.asarray(centers),

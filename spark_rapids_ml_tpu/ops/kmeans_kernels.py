@@ -29,25 +29,45 @@ from ..parallel.mesh import DP_AXIS
 from .linalg import check_row_chunking, row_chunk
 
 
-def pairwise_sq_dists(x: jax.Array, centers: jax.Array, c_sq: jax.Array | None = None) -> jax.Array:
+def pairwise_sq_dists(
+    x: jax.Array,
+    centers: jax.Array,
+    c_sq: jax.Array | None = None,
+    *,
+    matmul_dtype=None,
+) -> jax.Array:
     """(rows, k) squared euclidean distances: ||x||² - 2 x·c + ||c||², ≥ 0.
 
     The single distance formula shared by Lloyd, seeding, transform and
     single-row predict — the x@centers.T contraction is the MXU hot loop.
+    ``matmul_dtype=bfloat16`` runs that contraction with bf16 operands and
+    f32 accumulation (~2x MXU rate; ||x||²/||c||² stay f32): assignment
+    flips only on near-ties, which Lloyd's local search absorbs.
     """
     if c_sq is None:
         c_sq = (centers * centers).sum(axis=1)
     x_sq = (x * x).sum(axis=1)
-    d2 = x_sq[:, None] - 2.0 * (x @ centers.T) + c_sq[None, :]
+    if matmul_dtype is not None:
+        xc = jnp.dot(
+            x.astype(matmul_dtype),
+            centers.T.astype(matmul_dtype),
+            preferred_element_type=x.dtype,
+        )
+    else:
+        xc = x @ centers.T
+    d2 = x_sq[:, None] - 2.0 * xc + c_sq[None, :]
     return jnp.maximum(d2, 0.0)
 
 
-def _chunk_stats(X_local, mask_local, centers, csize: int):
+def _chunk_stats(X_local, mask_local, centers, csize: int, matmul_dtype=None):
     """Chunked pass over local rows; returns (sums (k,d), counts int32 (k,),
     cost).
 
     Chunks are read with :func:`ops.linalg.row_chunk` (NOT a lax.scan over
-    a reshaped X — see its docstring for the layout-repack hazard)."""
+    a reshaped X — see its docstring for the layout-repack hazard).
+    ``matmul_dtype=bfloat16`` also runs the one-hot stats contraction with
+    bf16 operands (one-hots are exact; x rounds at ~1e-3 relative, washed
+    out by the per-cluster mean)."""
     k = centers.shape[0]
     d = X_local.shape[1]
     n_chunks = check_row_chunking(X_local.shape[0], csize)
@@ -56,10 +76,17 @@ def _chunk_stats(X_local, mask_local, centers, csize: int):
     def body(i, carry):
         sums, counts, cost = carry
         x, m = row_chunk(i, csize, X_local, mask_local)
-        d2 = pairwise_sq_dists(x, centers, c_sq)
+        d2 = pairwise_sq_dists(x, centers, c_sq, matmul_dtype=matmul_dtype)
         assign = jnp.argmin(d2, axis=1)
         onehot = jax.nn.one_hot(assign, k, dtype=x.dtype) * m[:, None]
-        sums = sums + onehot.T @ x
+        if matmul_dtype is not None:
+            sums = sums + jnp.dot(
+                onehot.T.astype(matmul_dtype),
+                x.astype(matmul_dtype),
+                preferred_element_type=x.dtype,
+            )
+        else:
+            sums = sums + onehot.T @ x
         # counts in int32: float accumulation drops +1 increments once a
         # cluster's count passes 2^24 (realistic at ~1e8 rows/device)
         counts = counts + onehot.sum(axis=0).astype(jnp.int32)
@@ -75,7 +102,7 @@ def _chunk_stats(X_local, mask_local, centers, csize: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "csize", "max_iter")
+    jax.jit, static_argnames=("mesh", "csize", "max_iter", "matmul_dtype")
 )
 def kmeans_lloyd(
     X: jax.Array,
@@ -86,6 +113,7 @@ def kmeans_lloyd(
     csize: int,
     max_iter: int,
     tol: float,
+    matmul_dtype=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Run Lloyd to convergence. Returns (centers, cost, n_iters)."""
 
@@ -96,7 +124,9 @@ def kmeans_lloyd(
 
         def body(state):
             centers, _, it = state
-            sums, counts, _ = _chunk_stats(X_local, mask_local, centers, csize)
+            sums, counts, _ = _chunk_stats(
+                X_local, mask_local, centers, csize, matmul_dtype
+            )
             sums = lax.psum(sums, DP_AXIS)
             counts = lax.psum(counts, DP_AXIS)
             # empty cluster keeps its previous center (Spark behavior)
@@ -117,6 +147,12 @@ def kmeans_lloyd(
         # no-update phase still copies AND costs ~4% per iteration), so the
         # straight-line form is kept; the unaligned-d memory note lives in
         # COVERAGE.md.
+        #
+        # The final cost pass ALWAYS runs f32: the ||x||²-2x·c+||c||²
+        # expansion cancels catastrophically at bf16 precision when rows
+        # sit near their centroid (intra-cluster distance² ~ |x|²·2⁻⁸
+        # rounding), which corrupts the reported cost even though
+        # iteration ARGMIN assignments only need inter-center contrast.
         _, _, cost = _chunk_stats(X_local, mask_local, centers, csize)
         cost = lax.psum(cost, DP_AXIS)
         return centers, cost, it

@@ -134,19 +134,34 @@ def gram2_step(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("matmul_dtype",))
 def kmeans_chunk_step(
-    acc: Dict[str, jax.Array], X: jax.Array, mask: jax.Array, centers: jax.Array
+    acc: Dict[str, jax.Array],
+    X: jax.Array,
+    mask: jax.Array,
+    centers: jax.Array,
+    matmul_dtype=None,
 ) -> Dict[str, jax.Array]:
-    """Fold one chunk's assignment statistics into (sums, counts, cost)."""
+    """Fold one chunk's assignment statistics into (sums, counts, cost).
+
+    ``matmul_dtype``: see ``kmeans_kernels.pairwise_sq_dists`` — the
+    resident kernel's bf16-operand option, same semantics here."""
     from .kmeans_kernels import pairwise_sq_dists
 
     k = centers.shape[0]
-    d2 = pairwise_sq_dists(X, centers)
+    d2 = pairwise_sq_dists(X, centers, matmul_dtype=matmul_dtype)
     assign = jnp.argmin(d2, axis=1)
     onehot = jax.nn.one_hot(assign, k, dtype=X.dtype) * mask[:, None]
+    if matmul_dtype is not None:
+        sums_inc = jnp.dot(
+            onehot.T.astype(matmul_dtype),
+            X.astype(matmul_dtype),
+            preferred_element_type=X.dtype,
+        )
+    else:
+        sums_inc = onehot.T @ X
     return {
-        "sums": acc["sums"] + onehot.T @ X,
+        "sums": acc["sums"] + sums_inc,
         "counts": acc["counts"] + onehot.sum(axis=0).astype(jnp.int32),
         "cost": acc["cost"] + (jnp.min(d2, axis=1) * mask).sum(),
     }
@@ -437,6 +452,7 @@ def streamed_kmeans_lloyd(
     *,
     max_iter: int,
     tol: float,
+    matmul_dtype=None,
 ):
     """Out-of-core Lloyd: one chunked pass per iteration accumulates
     (sums, counts, cost); centroid state stays tiny (k×d). Matches the
@@ -451,7 +467,7 @@ def streamed_kmeans_lloyd(
     k, d = centers0.shape
     centers = jnp.asarray(centers0, dtype)
 
-    def one_pass(cts):
+    def one_pass(cts, mm=matmul_dtype):
         acc = {
             "sums": jnp.zeros((k, d), dtype),
             "counts": jnp.zeros((k,), jnp.int32),
@@ -459,7 +475,7 @@ def streamed_kmeans_lloyd(
         }
         for chunk in source.iter_chunks(chunk_rows, np_dtype):
             dev = put_chunk(chunk, mesh, dtype)
-            acc = kmeans_chunk_step(acc, dev["X"], dev["mask"], cts)
+            acc = kmeans_chunk_step(acc, dev["X"], dev["mask"], cts, matmul_dtype=mm)
         # per-iteration allreduce of (sums, counts, cost) partials — the
         # Lloyd-loop NCCL allreduce; every rank then updates identically
         s_h, c_h, cost_h = allreduce_sum_host(
@@ -483,7 +499,9 @@ def streamed_kmeans_lloyd(
         centers = jnp.asarray(new_centers, dtype)
         it += 1
 
-    final = one_pass(centers)
+    # final cost pass always f32 (bf16 distance expansion cancels near
+    # centroids — see kmeans_kernels.kmeans_lloyd)
+    final = one_pass(centers, mm=None)
     return np.asarray(centers), float(final["cost"]), it
 
 

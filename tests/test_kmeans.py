@@ -151,3 +151,37 @@ def test_kmeans_lane_padding_matches_unpadded(monkeypatch):
     np.testing.assert_array_equal(
         out["prediction"], base.transform(df)["prediction"]
     )
+
+
+def test_kmeans_bf16_matmul_close_to_f32():
+    """bf16 matmul operands (f32 accumulation) in Lloyd must converge to
+    the same clustering on separated blobs — the bench configuration."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.kmeans_kernels import kmeans_lloyd
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh, shard_rows
+
+    X, centers, _ = _blobs(n=600, d=8, k=4, seed=3, spread=0.1)
+    mesh = make_mesh(2)
+    Xd, mask = shard_rows(X.astype(np.float32), mesh, 4)
+    c0 = jnp.asarray(X[:4], jnp.float32)
+    f32 = kmeans_lloyd(Xd, mask, c0, mesh=mesh, csize=4, max_iter=25, tol=0.0)
+    b16 = kmeans_lloyd(Xd, mask, c0, mesh=mesh, csize=4, max_iter=25, tol=0.0,
+                       matmul_dtype=jnp.bfloat16)
+    np.testing.assert_allclose(
+        np.asarray(b16[0]), np.asarray(f32[0]), rtol=2e-2, atol=2e-2
+    )
+    np.testing.assert_allclose(float(b16[1]), float(f32[1]), rtol=2e-2)
+
+
+def test_kmeans_estimator_bf16_matmul_kwarg():
+    X, _, _ = _blobs(n=400, d=8, k=3, seed=6)
+    df = DataFrame({"features": X})
+    f32 = KMeans(k=3, seed=2).fit(df)
+    b16 = KMeans(k=3, seed=2, matmul_dtype="bfloat16").fit(df)
+    # same seeding + separated blobs: identical clustering
+    np.testing.assert_allclose(
+        b16.cluster_centers_, f32.cluster_centers_, rtol=2e-2, atol=2e-2
+    )
+    with pytest.raises(ValueError, match="matmul_dtype"):
+        KMeans(k=3, matmul_dtype="fp8").fit(df)
