@@ -544,6 +544,17 @@ def main() -> None:
     if not tpu_ok:
         pin_platform("cpu")
     import jax
+
+    # persistent compile cache: the RF depth-13 program dominates compile
+    # time; caching lets an in-round run warm the driver's capture run
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -633,6 +644,8 @@ def main() -> None:
         unknown = keep - set(runs)
         if unknown:
             sys.exit(f"BENCH_ONLY names unknown entries: {sorted(unknown)}")
+        if not keep:
+            sys.exit(f"BENCH_ONLY={only!r} selects no entries")
         runs = {k: v for k, v in runs.items() if k in keep}
     from spark_rapids_ml_tpu.utils.profiling import trace
 

@@ -153,8 +153,11 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
     def _resolve_matmul_dtype(params):
         """Validated (early, before any seeding work) bf16-matmul option;
         returns a jnp dtype or None. Kwarg beats TPUML_KMEANS_MATMUL_DTYPE."""
-        mm = params.get("matmul_dtype") or os.environ.get(
-            "TPUML_KMEANS_MATMUL_DTYPE"
+        # `or None`: empty-string env (a shell-default pattern) means unset
+        mm = (
+            params.get("matmul_dtype")
+            or os.environ.get("TPUML_KMEANS_MATMUL_DTYPE")
+            or None
         )
         if mm is not None and str(mm) not in ("float32", "bfloat16"):
             raise ValueError(

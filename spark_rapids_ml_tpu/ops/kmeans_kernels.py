@@ -59,6 +59,19 @@ def pairwise_sq_dists(
     return jnp.maximum(d2, 0.0)
 
 
+def stats_dot(onehot: jax.Array, x: jax.Array, matmul_dtype=None) -> jax.Array:
+    """onehot.T @ x with optional bf16 operands / f32 accumulation — the
+    assignment-stats contraction shared by the resident and streamed Lloyd
+    steps (keep the two numerically identical: change it HERE only)."""
+    if matmul_dtype is None:
+        return onehot.T @ x
+    return jnp.dot(
+        onehot.T.astype(matmul_dtype),
+        x.astype(matmul_dtype),
+        preferred_element_type=x.dtype,
+    )
+
+
 def _chunk_stats(X_local, mask_local, centers, csize: int, matmul_dtype=None):
     """Chunked pass over local rows; returns (sums (k,d), counts int32 (k,),
     cost).
@@ -79,14 +92,7 @@ def _chunk_stats(X_local, mask_local, centers, csize: int, matmul_dtype=None):
         d2 = pairwise_sq_dists(x, centers, c_sq, matmul_dtype=matmul_dtype)
         assign = jnp.argmin(d2, axis=1)
         onehot = jax.nn.one_hot(assign, k, dtype=x.dtype) * m[:, None]
-        if matmul_dtype is not None:
-            sums = sums + jnp.dot(
-                onehot.T.astype(matmul_dtype),
-                x.astype(matmul_dtype),
-                preferred_element_type=x.dtype,
-            )
-        else:
-            sums = sums + onehot.T @ x
+        sums = sums + stats_dot(onehot, x, matmul_dtype)
         # counts in int32: float accumulation drops +1 increments once a
         # cluster's count passes 2^24 (realistic at ~1e8 rows/device)
         counts = counts + onehot.sum(axis=0).astype(jnp.int32)

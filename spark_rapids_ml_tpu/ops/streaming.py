@@ -146,22 +146,14 @@ def kmeans_chunk_step(
 
     ``matmul_dtype``: see ``kmeans_kernels.pairwise_sq_dists`` — the
     resident kernel's bf16-operand option, same semantics here."""
-    from .kmeans_kernels import pairwise_sq_dists
+    from .kmeans_kernels import pairwise_sq_dists, stats_dot
 
     k = centers.shape[0]
     d2 = pairwise_sq_dists(X, centers, matmul_dtype=matmul_dtype)
     assign = jnp.argmin(d2, axis=1)
     onehot = jax.nn.one_hot(assign, k, dtype=X.dtype) * mask[:, None]
-    if matmul_dtype is not None:
-        sums_inc = jnp.dot(
-            onehot.T.astype(matmul_dtype),
-            X.astype(matmul_dtype),
-            preferred_element_type=X.dtype,
-        )
-    else:
-        sums_inc = onehot.T @ X
     return {
-        "sums": acc["sums"] + sums_inc,
+        "sums": acc["sums"] + stats_dot(onehot, X, matmul_dtype),
         "counts": acc["counts"] + onehot.sum(axis=0).astype(jnp.int32),
         "cost": acc["cost"] + (jnp.min(d2, axis=1) * mask).sum(),
     }
