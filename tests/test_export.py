@@ -148,3 +148,21 @@ def test_rf_export_entropy_criterion(rng):
     p = ls / ls.sum()
     exp = -np.sum(np.where(p > 0, p * np.log2(np.maximum(p, 1e-30)), 0.0))
     np.testing.assert_allclose(sk.estimators_[0].tree_.impurity[0], exp, rtol=1e-5)
+
+
+def test_rf_multiclass_export(rng):
+    """3-class forest export: per-tree normalized distributions must
+    average to our Spark-vote probabilities."""
+    X = rng.normal(size=(600, 8)).astype(np.float32)
+    y = np.argmax(X[:, :3] + rng.normal(size=(600, 3)) * 0.3, axis=1).astype(
+        np.float32
+    )
+    model = RandomForestClassifier(numTrees=10, maxDepth=6, seed=4).fit(
+        DataFrame({"features": X, "label": y})
+    )
+    sk = _roundtrip(model.to_sklearn())
+    Xq = rng.normal(size=(150, 8)).astype(np.float32)
+    out = model.transform(DataFrame({"features": Xq}))
+    np.testing.assert_allclose(sk.predict_proba(Xq), out["probability"], atol=1e-6)
+    np.testing.assert_array_equal(sk.predict(Xq), out["prediction"])
+    assert sk.n_classes_ == 3
