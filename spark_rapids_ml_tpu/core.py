@@ -585,9 +585,30 @@ class _TpuModel(Params, _TpuParams):
         Embarrassingly parallel: rows are processed in device-sized batches;
         no collectives (matching the reference, which builds no communicator
         for transform)."""
+        from .data.dataframe import AugmentedScanFrame, ParquetScanFrame
         from .utils.profiling import annotate, timed
 
         self._apply_verbosity()
+        if isinstance(dataset, ParquetScanFrame) and not dataset.is_materialized():
+            # out-of-core transform (the reference transforms per Arrow
+            # batch, ``core.py:1463-1568``): stream chunks through the
+            # jitted transform; host memory holds the OUTPUT columns only
+            # (O(n) scalars/embeddings), never the feature matrix. Only
+            # when the input column lives ON DISK: a chained transform
+            # whose featuresCol is a prior stage's in-memory output column
+            # (AugmentedScanFrame) takes the materializing path below.
+            input_col, input_cols = self._get_input_columns()
+            if input_cols is None and dataset.has_disk_column(input_col):
+                np_dtype = np.dtype(
+                    np.float32 if self._float32_inputs else np.float64
+                )
+                with _x64_ctx(np_dtype):
+                    fn = self._get_tpu_transform_func(dataset)
+                    with annotate(f"{type(self).__name__}.transform"), timed(
+                        self.logger, "transform(streamed)"
+                    ):
+                        out_columns = self._apply_streamed(fn, dataset, input_col)
+                return AugmentedScanFrame(dataset, out_columns)
         X = self._extract_features_for_transform(dataset)
         with _x64_ctx(X.dtype):
             fn = self._get_tpu_transform_func(dataset)
@@ -599,6 +620,22 @@ class _TpuModel(Params, _TpuParams):
         for name, col in out_columns.items():
             out = out.withColumn(name, col)
         return out
+
+    def _apply_streamed(
+        self,
+        fn: Callable[[np.ndarray], Dict[str, np.ndarray]],
+        scan: Any,
+        input_col: str,
+    ) -> Dict[str, np.ndarray]:
+        source = scan.chunk_source(features_col=input_col)
+        bs = self._transform_batch_rows()
+        dtype = np.float32 if self._float32_inputs else np.float64
+        chunks: Dict[str, List[np.ndarray]] = {}
+        for chunk in source.iter_chunks(bs, dtype=dtype):
+            Xb = np.ascontiguousarray(chunk.X[: chunk.n_valid], dtype=dtype)
+            for k, v in fn(Xb).items():
+                chunks.setdefault(k, []).append(np.asarray(v)[: chunk.n_valid])
+        return {k: np.concatenate(v, axis=0) for k, v in chunks.items()}
 
     def _extract_features_for_transform(self, dataset: DataFrame) -> np.ndarray:
         X, X_sparse = _resolve_feature_matrix(self, dataset)

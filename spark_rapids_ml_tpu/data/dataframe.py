@@ -462,6 +462,11 @@ class ParquetScanFrame(DataFrame):
     def is_materialized(self) -> bool:
         return self._materialized is not None
 
+    def has_disk_column(self, name: str) -> bool:
+        """True when ``name`` is backed by the parquet files themselves
+        (streamable), as opposed to an in-memory appended column."""
+        return name in self._schema.names
+
     def chunk_source(
         self,
         features_col: str = "features",
@@ -478,6 +483,67 @@ class ParquetScanFrame(DataFrame):
             _files=self._files,
             _n_rows=self._nrows,
         )
+
+
+class AugmentedScanFrame(ParquetScanFrame):
+    """A parquet scan plus in-memory appended columns — the result type of
+    a streaming ``model.transform(scan)``: output columns (predictions,
+    embeddings) live in memory, the on-disk feature columns stay lazy.
+    Touching an on-disk column materializes the scan (the caller's
+    explicit choice); the appended columns never force that."""
+
+    def __init__(self, base: ParquetScanFrame, extra: Dict[str, ColumnLike]):
+        # share the base scan's metadata; never re-read footers
+        self._path = base._path
+        self._files = base._files
+        self._schema = base._schema
+        self._nrows = base._nrows
+        self._num_partitions = base._num_partitions
+        self._materialized = None
+        self._extra = dict(extra)
+
+    @property
+    def _data(self) -> Dict[str, ColumnLike]:
+        if self._materialized is None:
+            d = DataFrame.read_parquet(self._path)._data
+            d.update(self._extra)
+            self._materialized = d
+        return self._materialized
+
+    @_data.setter
+    def _data(self, value: Dict[str, ColumnLike]) -> None:
+        self._materialized = value
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._schema.names) + [
+            c for c in self._extra if c not in self._schema.names
+        ]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._extra or name in self._schema.names
+
+    def column(self, name: str) -> ColumnLike:
+        if self._materialized is None and name in self._extra:
+            return self._extra[name]
+        return super().column(name)
+
+    def __getitem__(self, name: str) -> ColumnLike:
+        return self.column(name)
+
+    def dtypes(self) -> List[Tuple[str, str]]:
+        out = super().dtypes()
+        listed = {n for n, _ in out}
+        for name, col in self._extra.items():
+            if name not in listed:
+                arr = np.asarray(col)
+                kind = (
+                    f"vector<{arr.dtype}>[{arr.shape[1]}]"
+                    if arr.ndim == 2
+                    else str(arr.dtype)
+                )
+                out.append((name, kind))
+        return out
 
 
 def kfold(df: DataFrame, n_folds: int, seed: int = 0) -> List[Tuple[DataFrame, DataFrame]]:

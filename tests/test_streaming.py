@@ -462,3 +462,64 @@ def test_gen_data_distributed_f16(tmp_path):
     df = DataFrame.read_parquet(out)
     X = np.asarray(df["features"])
     assert X.dtype == np.float16 and X.shape == (2000, 16)
+
+
+def test_streaming_transform_never_materializes_scan(tmp_path):
+    """model.transform(scan) streams chunks: output columns arrive without
+    the feature matrix ever materializing on host (the reference's
+    per-Arrow-batch transform, core.py:1463-1568)."""
+    from spark_rapids_ml_tpu.data.dataframe import DataFrame
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+    from spark_rapids_ml_tpu.models.feature import PCA
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(5000, 12)).astype(np.float32)
+    d = str(tmp_path / "p")
+    DataFrame({"features": X}).write_parquet(d, rows_per_file=1250)
+
+    model = PCA(k=2).fit(DataFrame({"features": X}))
+    scan = DataFrame.scan_parquet(d)
+    out = model.transform(scan)
+    assert not scan.is_materialized()
+    assert not out.is_materialized()
+    got = np.asarray(out["pca_features"])
+    exp = model.transform(DataFrame({"features": X}))["pca_features"]
+    np.testing.assert_allclose(got, exp, atol=1e-5)
+    assert not out.is_materialized()  # reading the output column is lazy-safe
+    assert out.count() == 5000 and "features" in out.columns
+
+    km = KMeans(k=3, seed=1).fit(DataFrame({"features": X}))
+    out2 = km.transform(DataFrame.scan_parquet(d))
+    np.testing.assert_array_equal(
+        np.asarray(out2["prediction"]),
+        km.transform(DataFrame({"features": X}))["prediction"],
+    )
+    # touching an on-disk column is the caller's explicit materialization
+    feats = np.asarray(out2["features"])
+    assert feats.shape == (5000, 12) and out2.is_materialized()
+
+
+def test_streaming_transform_chained_in_memory_column(tmp_path):
+    """A second stage whose featuresCol is a prior stage's in-memory output
+    column must fall back to the materializing path (Pipeline chaining),
+    and dtypes() must list appended columns."""
+    from spark_rapids_ml_tpu.data.dataframe import DataFrame
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+    from spark_rapids_ml_tpu.models.feature import PCA
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(2000, 8)).astype(np.float32)
+    d = str(tmp_path / "p")
+    DataFrame({"features": X}).write_parquet(d, rows_per_file=500)
+
+    pca = PCA(k=3).fit(DataFrame({"features": X}))
+    out = pca.transform(DataFrame.scan_parquet(d))
+    assert dict(out.dtypes())["pca_features"].startswith("vector<")
+
+    km = KMeans(k=2, seed=0, featuresCol="pca_features").fit(
+        DataFrame({"features": np.asarray(out["pca_features"])}).withColumn(
+            "pca_features", np.asarray(out["pca_features"])
+        )
+    )
+    pred = km.transform(out)["prediction"]  # chains through the aug frame
+    assert len(pred) == 2000
