@@ -256,19 +256,37 @@ def bench_logreg(X, mask, y, mesh, n_chips):
     # tensor-core reads cuML gets implicitly on Ampere-class GPUs
     obj_dtype = os.environ.get("BENCH_LOGREG_DTYPE", "bfloat16")
 
-    def timed_fn(X, m, y, l2):
-        out = logreg_fit(
-            X, m, y,
-            n_classes=2, multinomial=False, fit_intercept=True,
-            standardization=False,
-            l1=jnp.float32(0.0), l2=l2,
-            use_l1=False, max_iter=LOGREG_ITERS, tol=jnp.float32(0.0),
-            mesh=mesh, objective_dtype=obj_dtype,
-        )
-        return _checksum(out, aux=out["n_iter"])
+    def make_timed(dt):
+        def timed_fn(X, m, y, l2):
+            out = logreg_fit(
+                X, m, y,
+                n_classes=2, multinomial=False, fit_intercept=True,
+                standardization=False,
+                l1=jnp.float32(0.0), l2=l2,
+                use_l1=False, max_iter=LOGREG_ITERS, tol=jnp.float32(0.0),
+                mesh=mesh, objective_dtype=dt,
+            )
+            return _checksum(out, aux=out["n_iter"])
 
-    timed = jax.jit(timed_fn)
-    warm = np.asarray(timed(X, mask, y, jnp.float32(1e-5)))  # compile
+        return jax.jit(timed_fn)
+
+    timed = make_timed(obj_dtype)
+    try:
+        warm = np.asarray(timed(X, mask, y, jnp.float32(1e-5)))  # compile
+    except Exception as e:  # noqa: BLE001
+        if obj_dtype == "float32":
+            raise
+        # narrow-dtype path failed on this backend (e.g. Mosaic lowering):
+        # fall back to f32, record the dtype that actually ran, and keep
+        # the original error visible for diagnosis
+        print(
+            f"[bench] logreg {obj_dtype} objective failed "
+            f"({type(e).__name__}: {e}); falling back to float32",
+            file=sys.stderr,
+        )
+        obj_dtype = "float32"
+        timed = make_timed(obj_dtype)
+        warm = np.asarray(timed(X, mask, y, jnp.float32(1e-5)))
     iters = max(int(warm[1]), 1)
     # rep-dependent l2 -> distinct scalar input buffer (see _best_time)
     t, _ = _best_time(

@@ -216,9 +216,16 @@ class _TpuEstimator(Params, _TpuParams):
 
         input_col, input_cols = self._get_input_columns()
         if isinstance(dataset, ParquetScanFrame) and not dataset.is_materialized():
-            # multi-column features are resident-only; the scan will
-            # materialize transparently on column access
-            return input_cols is None
+            # multi-column features are resident-only, and streaming can
+            # only read DISK-backed columns: a chained stage whose
+            # features/label col is a prior transform's in-memory output
+            # (AugmentedScanFrame) takes the materializing path
+            if input_cols is not None:
+                return False
+            needed = [input_col]
+            if self._require_label():
+                needed.append(self.getOrDefault("labelCol"))
+            return all(dataset.has_disk_column(c) for c in needed)
         if input_cols is not None:
             n_features = len(input_cols)
         else:

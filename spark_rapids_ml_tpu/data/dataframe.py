@@ -493,14 +493,15 @@ class AugmentedScanFrame(ParquetScanFrame):
     explicit choice); the appended columns never force that."""
 
     def __init__(self, base: ParquetScanFrame, extra: Dict[str, ColumnLike]):
-        # share the base scan's metadata; never re-read footers
+        # share the base scan's metadata; never re-read footers. Chaining:
+        # a prior streaming transform's appended columns carry over.
         self._path = base._path
         self._files = base._files
         self._schema = base._schema
         self._nrows = base._nrows
         self._num_partitions = base._num_partitions
         self._materialized = None
-        self._extra = dict(extra)
+        self._extra = {**getattr(base, "_extra", {}), **extra}
 
     @property
     def _data(self) -> Dict[str, ColumnLike]:
@@ -527,9 +528,6 @@ class AugmentedScanFrame(ParquetScanFrame):
         if self._materialized is None and name in self._extra:
             return self._extra[name]
         return super().column(name)
-
-    def __getitem__(self, name: str) -> ColumnLike:
-        return self.column(name)
 
     def dtypes(self) -> List[Tuple[str, str]]:
         out = super().dtypes()

@@ -523,3 +523,30 @@ def test_streaming_transform_chained_in_memory_column(tmp_path):
     )
     pred = km.transform(out)["prediction"]  # chains through the aug frame
     assert len(pred) == 2000
+
+
+def test_chained_streaming_transforms_and_fit(tmp_path):
+    """Two chained streaming transforms keep both output columns, and a
+    FIT whose featuresCol is an in-memory column materializes instead of
+    crashing in the streaming chunk source."""
+    from spark_rapids_ml_tpu.data.dataframe import DataFrame
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+    from spark_rapids_ml_tpu.models.feature import PCA
+
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(1500, 6)).astype(np.float32)
+    d = str(tmp_path / "p")
+    DataFrame({"features": X}).write_parquet(d, rows_per_file=500)
+
+    pca = PCA(k=2).fit(DataFrame({"features": X}))
+    km = KMeans(k=2, seed=0).fit(DataFrame({"features": X}))
+    out1 = pca.transform(DataFrame.scan_parquet(d))
+    out2 = km.transform(out1)  # featuresCol="features" (on disk): streams
+    assert not out2.is_materialized()
+    assert "pca_features" in out2.columns and "prediction" in out2.columns
+    assert np.asarray(out2["pca_features"]).shape == (1500, 2)  # carried over
+    assert not out2.is_materialized()
+
+    # fit on the in-memory column: must fall back to the resident path
+    km2 = KMeans(k=2, seed=1, featuresCol="pca_features").fit(out1)
+    assert km2.cluster_centers_.shape == (2, 2)
