@@ -391,32 +391,57 @@ def bench_rf(X, mask, y, mesh, n_chips):
     bins = binize(Xs, edges, d_pad=d_pad)
     stats = jnp.stack([1.0 - ys, ys], axis=1) * ms[:, None]
     trees_per_dev = -(-RF_TREES // n_dp)
-    keys = jax.random.key_data(
-        jax.random.split(jax.random.key(7), n_dp * trees_per_dev)
-    ).reshape(n_dp, trees_per_dev, 2)
     from jax.sharding import NamedSharding, PartitionSpec as P
-
-    keys = jax.device_put(
-        np.asarray(keys), NamedSharding(mesh, P("dp"))
-    )
     cfg = ForestConfig(
         max_depth=RF_DEPTH, n_bins=RF_BINS, n_features=N_COLS, n_stats=2,
         impurity="gini", k_features=N_COLS, min_samples_leaf=1,
         min_info_gain=0.0, min_samples_split=2, bootstrap=True,
     )
 
-    def timed_fn(bins, ms, stats, keys):
+    # trees build in groups of <= 8 per dispatch: a multi-minute single
+    # device program outlives remote-runtime health checks and a killed
+    # client wedges the tunnel (round-2 postmortem; the estimator groups
+    # the same way). One compiled program serves every group (same size).
+    group = min(8, trees_per_dev)
+    trees_per_dev = -(-trees_per_dev // group) * group
+    keys = jax.random.key_data(
+        jax.random.split(jax.random.key(7), n_dp * trees_per_dev)
+    ).reshape(n_dp, trees_per_dev, 2)
+    keys = jax.device_put(np.asarray(keys), NamedSharding(mesh, P("dp")))
+
+    def timed_fn(bins, ms, stats, kg):
         return _checksum(
-            build_forest(bins, ms, stats, keys, mesh=mesh, cfg=cfg)
+            build_forest(bins, ms, stats, kg, mesh=mesh, cfg=cfg)
         )
 
     timed = jax.jit(timed_fn)
-    np.asarray(timed(bins, ms, stats, keys))  # compile
-    t, _ = _best_time(
-        lambda rep: (bins, ms, stats * jnp.float32(1.0 + (rep + 1) * 1e-6), keys),
-        timed,
-        reps=2,
+    # warm-up/compile on a DISTINCT key set: remote backends may memoize
+    # (executable, input values) pairs, and the timed groups must be fresh
+    warm_keys = jax.device_put(
+        np.asarray(
+            jax.random.key_data(
+                jax.random.split(jax.random.key(99), n_dp * group)
+            ).reshape(n_dp, group, 2)
+        ),
+        NamedSharding(mesh, P("dp")),
     )
+    np.asarray(timed(bins, ms, stats, warm_keys))  # compile
+    # best of BENCH_RF_REPS full passes: a transient tunnel stall would
+    # otherwise land in the single summed time (every rep perturbs stats
+    # so a remote backend cannot memoize the group dispatches)
+    reps = max(1, int(os.environ.get("BENCH_RF_REPS", 2)))
+    times = []
+    for rep in range(reps):
+        stats_r = stats * jnp.float32(1.0 + (rep + 1) * 1e-6)
+        jax.block_until_ready(stats_r)
+        t_rep = 0.0
+        for g0 in range(0, trees_per_dev, group):
+            kg = keys[:, g0 : g0 + group]
+            t0 = time.perf_counter()
+            np.asarray(timed(bins, ms, stats_r, kg))
+            t_rep += time.perf_counter() - t0
+        times.append(t_rep)
+    t = min(times)
     n_trees = trees_per_dev * n_dp
     # updates model: one histogram update per (row, feature, stat, level)
     updates = float(n_rf) * N_COLS * 2 * RF_DEPTH * n_trees
@@ -734,6 +759,12 @@ def main() -> None:
         "n_rows": N_ROWS,
         "n_cols": N_COLS,
     }
+    # provenance scalars each entry may carry (configuration that actually
+    # ran — dtype fallbacks, tree counts, dispatch amortization)
+    _extras = (
+        "iters", "trees", "rows", "objective_dtype", "matmul_dtype",
+        "inner_fits_per_dispatch", "ingest_gbps", "stream_gb",
+    )
     for name, r in results.items():
         line[name] = {
             "samples_per_sec_per_chip": round(r["samples_per_sec_per_chip"], 1),
@@ -741,6 +772,9 @@ def main() -> None:
             "mfu": round(r["mfu"], 4),
             "vs_baseline": round(r["vs_baseline"], 3),
         }
+        for k in _extras:
+            if k in r:
+                line[name][k] = r[k]
         if r.get("tunnel_bound"):
             line[name]["tunnel_bound"] = True
     print(json.dumps(line))

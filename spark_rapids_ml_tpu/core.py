@@ -225,6 +225,13 @@ class _TpuEstimator(Params, _TpuParams):
             needed = [input_col]
             if self._require_label():
                 needed.append(self.getOrDefault("labelCol"))
+            if (
+                isinstance(self, HasWeightCol)
+                and self.hasParam("weightCol")
+                and self.isSet("weightCol")
+                and self.getOrDefault("weightCol") is not None
+            ):
+                needed.append(self.getOrDefault("weightCol"))
             return all(dataset.has_disk_column(c) for c in needed)
         if input_cols is not None:
             n_features = len(input_cols)
@@ -280,8 +287,21 @@ class _TpuEstimator(Params, _TpuParams):
         ):
             weight_col = self.getOrDefault("weightCol")
 
-        if isinstance(dataset, ParquetScanFrame) and not dataset.is_materialized():
-            input_col, input_cols = self._get_input_columns()
+        input_col, input_cols = self._get_input_columns()
+        scan_cols_on_disk = all(
+            dataset.has_disk_column(c)
+            for c in [input_col, label_col, weight_col]
+            if c is not None
+        ) if isinstance(dataset, ParquetScanFrame) else False
+        if (
+            isinstance(dataset, ParquetScanFrame)
+            and not dataset.is_materialized()
+            and scan_cols_on_disk
+        ):
+            # NOT scan_cols_on_disk: a column lives only in memory (e.g. a
+            # prior streaming transform's output, possibly SHADOWING a
+            # same-named disk column) — the in-memory branch below reads
+            # the authoritative values via dataset.column()
             if input_cols is not None:
                 raise ValueError(
                     "streaming fit over a parquet scan requires a single "

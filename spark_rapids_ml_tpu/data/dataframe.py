@@ -529,6 +529,12 @@ class AugmentedScanFrame(ParquetScanFrame):
             return self._extra[name]
         return super().column(name)
 
+    def has_disk_column(self, name: str) -> bool:
+        # an in-memory appended column SHADOWS a same-named disk column
+        # (column() prefers _extra, materialization applies _extra last):
+        # streaming must not silently read the stale on-disk bytes
+        return name not in self._extra and super().has_disk_column(name)
+
     def dtypes(self) -> List[Tuple[str, str]]:
         out = super().dtypes()
         listed = {n for n, _ in out}

@@ -550,3 +550,28 @@ def test_chained_streaming_transforms_and_fit(tmp_path):
     # fit on the in-memory column: must fall back to the resident path
     km2 = KMeans(k=2, seed=1, featuresCol="pca_features").fit(out1)
     assert km2.cluster_centers_.shape == (2, 2)
+
+
+def test_shadowed_disk_column_not_streamed(tmp_path):
+    """An in-memory appended column that shadows a same-named disk column
+    must force the materializing path (streaming would silently read the
+    stale on-disk bytes)."""
+    from spark_rapids_ml_tpu.data.dataframe import (
+        AugmentedScanFrame,
+        DataFrame,
+    )
+    from spark_rapids_ml_tpu.models.feature import PCA
+
+    rng = np.random.default_rng(8)
+    X_old = rng.normal(size=(800, 6)).astype(np.float32)
+    X_new = (X_old * 100.0).astype(np.float32)
+    d = str(tmp_path / "p")
+    DataFrame({"features": X_old}).write_parquet(d, rows_per_file=400)
+    aug = AugmentedScanFrame(DataFrame.scan_parquet(d), {"features": X_new})
+    assert not aug.has_disk_column("features")
+    m = PCA(k=2, streaming=True, stream_chunk_rows=128).fit(aug)
+    # fit must have seen the IN-MEMORY values (variance scales by 100^2)
+    res = PCA(k=2).fit(DataFrame({"features": X_new}))
+    np.testing.assert_allclose(
+        m.explained_variance_, res.explained_variance_, rtol=1e-4
+    )
