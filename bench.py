@@ -430,17 +430,29 @@ def bench_rf(X, mask, y, mesh, n_chips):
     # otherwise land in the single summed time (every rep perturbs stats
     # so a remote backend cannot memoize the group dispatches)
     reps = max(1, int(os.environ.get("BENCH_RF_REPS", 2)))
+    # transient-stall filtering matters for sub-second dispatches; once a
+    # full pass takes this long, a ~100 ms stall is noise and a second
+    # pass would only burn the capture run's wall-clock budget
+    rep_cap_s = float(os.environ.get("BENCH_RF_MAX_SECONDS_FOR_REPS", 90))
+    # pre-slice and block every group's keys OUTSIDE the timed region
+    # (the _best_time discipline). Inside it, groups stay host-synchronous
+    # — one dispatch, one fetch — deliberately: the ~65 ms/group fetch is
+    # <1% of a multi-second group build, and queueing many unfetched
+    # multi-second programs is the long-occupancy shape that tripped
+    # remote health checks in round 2.
+    kgs = [keys[:, g0 : g0 + group] for g0 in range(0, trees_per_dev, group)]
+    jax.block_until_ready(kgs)
     times = []
     for rep in range(reps):
         stats_r = stats * jnp.float32(1.0 + (rep + 1) * 1e-6)
         jax.block_until_ready(stats_r)
-        t_rep = 0.0
-        for g0 in range(0, trees_per_dev, group):
-            kg = keys[:, g0 : g0 + group]
-            t0 = time.perf_counter()
+        t0 = time.perf_counter()
+        for kg in kgs:
             np.asarray(timed(bins, ms, stats_r, kg))
-            t_rep += time.perf_counter() - t0
+        t_rep = time.perf_counter() - t0
         times.append(t_rep)
+        if t_rep > rep_cap_s:
+            break
     t = min(times)
     n_trees = trees_per_dev * n_dp
     # updates model: one histogram update per (row, feature, stat, level)

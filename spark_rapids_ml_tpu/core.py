@@ -208,6 +208,19 @@ class _TpuEstimator(Params, _TpuParams):
         resident-matrix path. Engaged by :meth:`_should_stream`."""
         return None
 
+    def _resolved_weight_col(self) -> Optional[str]:
+        """The explicitly-set weight column, or None — the ONE definition
+        of weight-col eligibility shared by the stream gate and both data
+        planes."""
+        if (
+            isinstance(self, HasWeightCol)
+            and self.hasParam("weightCol")
+            and self.isSet("weightCol")
+            and self.getOrDefault("weightCol") is not None
+        ):
+            return self.getOrDefault("weightCol")
+        return None
+
     # ---- streaming decision / data plane --------------------------------
     def _should_stream(self, dataset: DataFrame) -> bool:
         if self._streaming is not None:
@@ -225,13 +238,8 @@ class _TpuEstimator(Params, _TpuParams):
             needed = [input_col]
             if self._require_label():
                 needed.append(self.getOrDefault("labelCol"))
-            if (
-                isinstance(self, HasWeightCol)
-                and self.hasParam("weightCol")
-                and self.isSet("weightCol")
-                and self.getOrDefault("weightCol") is not None
-            ):
-                needed.append(self.getOrDefault("weightCol"))
+            if self._resolved_weight_col() is not None:
+                needed.append(self._resolved_weight_col())
             return all(dataset.has_disk_column(c) for c in needed)
         if input_cols is not None:
             n_features = len(input_cols)
@@ -278,14 +286,7 @@ class _TpuEstimator(Params, _TpuParams):
         label_col = (
             self.getOrDefault("labelCol") if self._require_label() else None
         )
-        weight_col = None
-        if (
-            isinstance(self, HasWeightCol)
-            and self.hasParam("weightCol")
-            and self.isSet("weightCol")
-            and self.getOrDefault("weightCol") is not None
-        ):
-            weight_col = self.getOrDefault("weightCol")
+        weight_col = self._resolved_weight_col()
 
         input_col, input_cols = self._get_input_columns()
         scan_cols_on_disk = all(
@@ -422,13 +423,8 @@ class _TpuEstimator(Params, _TpuParams):
             label_col = self.getOrDefault("labelCol")
             y_host = np.asarray(dataset.column(label_col), dtype=dtype)
             y = shard_aligned(y_host, mesh, Xd.shape[0])
-        if (
-            isinstance(self, HasWeightCol)
-            and self.hasParam("weightCol")
-            and self.isSet("weightCol")
-            and self.getOrDefault("weightCol") is not None
-        ):
-            wcol = self.getOrDefault("weightCol")
+        wcol = self._resolved_weight_col()
+        if wcol is not None:
             if wcol not in dataset:
                 raise ValueError(
                     f"weightCol {wcol!r} not found in dataset columns {dataset.columns}"
