@@ -238,8 +238,9 @@ class _TpuEstimator(Params, _TpuParams):
             needed = [input_col]
             if self._require_label():
                 needed.append(self.getOrDefault("labelCol"))
-            if self._resolved_weight_col() is not None:
-                needed.append(self._resolved_weight_col())
+            wcol = self._resolved_weight_col()
+            if wcol is not None:
+                needed.append(wcol)
             return all(dataset.has_disk_column(c) for c in needed)
         if input_cols is not None:
             n_features = len(input_cols)
