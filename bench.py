@@ -370,6 +370,7 @@ def bench_rf(X, mask, y, mesh, n_chips):
         binize,
         build_forest,
         next_pow2,
+        resolve_hist_strategy,
     )
 
     n_dp = mesh.shape["dp"]
@@ -396,6 +397,7 @@ def bench_rf(X, mask, y, mesh, n_chips):
         max_depth=RF_DEPTH, n_bins=RF_BINS, n_features=N_COLS, n_stats=2,
         impurity="gini", k_features=N_COLS, min_samples_leaf=1,
         min_info_gain=0.0, min_samples_split=2, bootstrap=True,
+        hist_strategy=resolve_hist_strategy(),
     )
 
     # trees build in groups of <= 8 per dispatch: a multi-minute single

@@ -45,6 +45,7 @@ from ..params import (
 )
 from ..parallel.mesh import DP_AXIS
 from ..ops.tree_kernels import (
+    resolve_hist_strategy,
     ForestConfig,
     binize,
     build_forest,
@@ -344,6 +345,7 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
                 min_info_gain=float(params.get("min_impurity_decrease", 0.0) or 0.0),
                 min_samples_split=int(params.get("min_samples_split", 2)),
                 bootstrap=bool(params["bootstrap"]),
+                hist_strategy=resolve_hist_strategy(),
             )
             # rows-per-tree mode: "all" gathers the binned matrix to every
             # device (quality independent of worker count — the TPU-first

@@ -59,6 +59,18 @@ _SCATTER_EQ_FLOPS = float(_os.environ.get("TPUML_RF_SCATTER_EQ_FLOPS", 5e5))
 _ROW_CHUNK = 1 << 13
 
 
+def resolve_hist_strategy(value=None) -> str:
+    """Validated histogram strategy from an explicit value or the
+    TPUML_RF_FORCE_STRATEGY env var (typos must error, not silently fall
+    back to the heuristic)."""
+    v = value or _os.environ.get("TPUML_RF_FORCE_STRATEGY") or "auto"
+    if v not in ("auto", "matmul", "scatter"):
+        raise ValueError(
+            f"RF histogram strategy must be auto|matmul|scatter, got {v!r}"
+        )
+    return v
+
+
 class ForestConfig(NamedTuple):
     """Static (compile-time) build configuration."""
 
@@ -72,6 +84,11 @@ class ForestConfig(NamedTuple):
     min_info_gain: float   # Spark minInfoGain
     min_samples_split: int
     bootstrap: bool
+    # histogram strategy: "auto" (TPU: per-level cost model; CPU: scatter),
+    # "matmul", or "scatter". Part of the static config so it participates
+    # in the jit cache key (an env var read inside the traced function
+    # would be silently ignored on cache hits).
+    hist_strategy: str = "auto"
 
 
 def max_nodes(max_depth: int) -> int:
@@ -230,8 +247,19 @@ def _build_tree(
         n_chunks = d_pad // F
 
         # strategy per level (static): one-hot matmuls on the MXU until the
-        # 2*n_nodes*nb waste factor exceeds a scatter-add update's cost
-        use_matmul = (2.0 * n_nodes * nb) < _SCATTER_EQ_FLOPS
+        # 2*n_nodes*nb waste factor exceeds a scatter-add update's cost.
+        # "auto" is TPU-only: the trade inverts on CPU, where scatter-adds
+        # are cheap and dense one-hot matmuls are pure waste (a CPU run of
+        # the reference forest config went from ~seconds to minutes).
+        if cfg.hist_strategy == "matmul":
+            use_matmul = True
+        elif cfg.hist_strategy == "scatter":
+            use_matmul = False
+        else:
+            use_matmul = (
+                jax.default_backend() == "tpu"
+                and (2.0 * n_nodes * nb) < _SCATTER_EQ_FLOPS
+            )
         if use_matmul:
             # the (C, F*nb) bin one-hot is a materialized dot operand; the
             # histogram-tile budget alone lets F reach d_pad at shallow

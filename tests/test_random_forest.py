@@ -338,3 +338,32 @@ def test_rf_maxbins_clamped_to_uint8_range():
     ).fit(df)
     acc = (model.transform(df)["prediction"] == y).mean()
     assert acc > 0.9
+
+
+def test_histogram_matmul_strategy_matches_scatter(monkeypatch):
+    """The MXU one-hot matmul histogram path (TPU default at shallow
+    levels) must produce the same forest as the scatter path — driven on
+    CPU via the strategy override."""
+    import jax
+
+    from spark_rapids_ml_tpu.data import DataFrame
+
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(800, 9)).astype(np.float32)
+    y = ((X[:, 0] + X[:, 2]) > 0).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+
+    monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "scatter")
+    jax.clear_caches()
+    m_sc = RandomForestClassifier(numTrees=5, maxDepth=5, seed=2).fit(df)
+    monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "matmul")
+    jax.clear_caches()
+    m_mm = RandomForestClassifier(numTrees=5, maxDepth=5, seed=2).fit(df)
+    monkeypatch.delenv("TPUML_RF_FORCE_STRATEGY")
+    jax.clear_caches()
+
+    np.testing.assert_array_equal(m_mm._features_arr, m_sc._features_arr)
+    np.testing.assert_allclose(m_mm._thresholds_arr, m_sc._thresholds_arr)
+    np.testing.assert_allclose(
+        m_mm._leaf_stats_arr, m_sc._leaf_stats_arr, rtol=1e-5, atol=1e-5
+    )
