@@ -469,6 +469,56 @@ def bench_rf(X, mask, y, mesh, n_chips):
     }
 
 
+KNN_QUERIES = int(os.environ.get("BENCH_KNN_QUERIES", 131_072))
+KNN_ITEMS = int(os.environ.get("BENCH_KNN_ITEMS", 1_000_000))
+KNN_K = 16
+
+
+def bench_knn(X, mask, mesh, n_chips):
+    """Exact brute-force kNN (the reference's NearestNeighbors workload):
+    one ring pass over the item shards, distance matmul + running top-k.
+
+    Baseline model: brute-force knn is matmul-bound (2*nq*ni*d FLOPs);
+    A10G ~15 TFLOP/s effective -> 15e12 / (2*1e6*256) ~= 2.9e4
+    queries/sec/GPU at these shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.knn_kernels import ring_knn
+
+    n_dp = mesh.shape["dp"]
+    # clamp to REAL rows (N_ROWS), not the padded count: padding rows are
+    # masked out of results but would inflate "rows" and the baseline's
+    # workload credit
+    ni = min(KNN_ITEMS, N_ROWS, X.shape[0])
+    ni = max(n_dp, (ni // n_dp) * n_dp)
+    nq = min(KNN_QUERIES, ni)
+    nq = max(n_dp, (nq // n_dp) * n_dp)
+    Xi, mi = X[:ni], mask[:ni]
+    ids = jnp.arange(ni, dtype=jnp.int32)
+
+    def timed_fn(Xq, Xi, mi, ids):
+        return _checksum(ring_knn(Xq, Xi, mi, ids, mesh=mesh, k=KNN_K))
+
+    timed = jax.jit(timed_fn)
+    np.asarray(timed(X[:nq], Xi, mi, ids))  # compile
+    t, _ = _best_time(
+        lambda rep: (
+            X[:nq] * jnp.float32(1.0 + (rep + 1) * 1e-6), Xi, mi, ids
+        ),
+        timed,
+    )
+    flops = 2.0 * nq * ni * N_COLS
+    return {
+        "samples_per_sec_per_chip": nq / t / n_chips,
+        "fit_seconds": t,
+        "rows": ni,
+        "queries": nq,
+        "flops_model": flops,
+        "baseline_samples_per_sec": 15e12 / (2.0 * ni * N_COLS),
+    }
+
+
 def bench_pca_stream(mesh, n_chips):
     """Out-of-core PCA: chunks stream through a bounded device buffer
     (``ops/streaming.py``), the path that handles beyond-HBM datasets
@@ -624,7 +674,11 @@ def main() -> None:
         # the caller pinned a size explicitly
         N_ROWS = min(N_ROWS, 50_000)
         CSIZE = _csize(N_ROWS)
-        global RF_ROWS, RF_TREES, RF_DEPTH
+        global RF_ROWS, RF_TREES, RF_DEPTH, KNN_QUERIES, KNN_ITEMS
+        if "BENCH_KNN_QUERIES" not in os.environ:
+            KNN_QUERIES = 512
+        if "BENCH_KNN_ITEMS" not in os.environ:
+            KNN_ITEMS = 8192
         if "BENCH_RF_ROWS" not in os.environ:
             RF_ROWS = 8192
         if "BENCH_RF_TREES" not in os.environ:
@@ -691,6 +745,7 @@ def main() -> None:
         "logreg": lambda: bench_logreg(X, mask, y, mesh, n_chips),
         "linreg": lambda: bench_linreg(X, mask, y, mesh, n_chips),
         "rf": lambda: bench_rf(X, mask, y, mesh, n_chips),
+        "knn": lambda: bench_knn(X, mask, mesh, n_chips),
         "pca_stream": lambda: bench_pca_stream(mesh, n_chips),
     }
     # BENCH_ONLY=rf,kmeans : run a subset (tuning loops); full runs only
@@ -776,8 +831,9 @@ def main() -> None:
     # provenance scalars each entry may carry (configuration that actually
     # ran — dtype fallbacks, tree counts, dispatch amortization)
     _extras = (
-        "iters", "trees", "rows", "objective_dtype", "matmul_dtype",
-        "inner_fits_per_dispatch", "ingest_gbps", "stream_gb",
+        "iters", "trees", "rows", "queries", "objective_dtype",
+        "matmul_dtype", "inner_fits_per_dispatch", "ingest_gbps",
+        "stream_gb",
     )
     for name, r in results.items():
         line[name] = {

@@ -59,11 +59,10 @@ _SCATTER_EQ_FLOPS = float(_os.environ.get("TPUML_RF_SCATTER_EQ_FLOPS", 5e5))
 _ROW_CHUNK = 1 << 13
 
 
-def resolve_hist_strategy(value=None) -> str:
-    """Validated histogram strategy from an explicit value or the
-    TPUML_RF_FORCE_STRATEGY env var (typos must error, not silently fall
-    back to the heuristic)."""
-    v = value or _os.environ.get("TPUML_RF_FORCE_STRATEGY") or "auto"
+def resolve_hist_strategy() -> str:
+    """Validated histogram strategy from the TPUML_RF_FORCE_STRATEGY env
+    var (typos must error, not silently fall back to the heuristic)."""
+    v = _os.environ.get("TPUML_RF_FORCE_STRATEGY") or "auto"
     if v not in ("auto", "matmul", "scatter"):
         raise ValueError(
             f"RF histogram strategy must be auto|matmul|scatter, got {v!r}"
