@@ -46,6 +46,23 @@ from ..params import (
     _mk,
 )
 from ..ops.logreg_kernels import logreg_fit, logreg_predict
+from ..utils.logging import get_logger
+
+
+def _resolve_objective_dtype(params: Dict[str, Any]) -> str:
+    """Validated objective dtype from the kwarg or env (empty string means
+    unset; typos error rather than silently running f32)."""
+    v = (
+        params.get("objective_dtype")
+        or os.environ.get("TPUML_LOGREG_OBJECTIVE_DTYPE")
+        or "float32"
+    )
+    v = str(v)
+    if v not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"objective_dtype must be float32|bfloat16, got {v!r}"
+        )
+    return v
 
 
 class LogisticRegressionClass:
@@ -250,10 +267,7 @@ class LogisticRegression(
                 mesh=inputs.mesh,
                 # bf16 objective reads (f32 accumulation) via framework
                 # kwarg or env; default full f32
-                objective_dtype=str(
-                    params.get("objective_dtype")
-                    or os.environ.get("TPUML_LOGREG_OBJECTIVE_DTYPE", "float32")
-                ),
+                objective_dtype=_resolve_objective_dtype(params),
             )
             return {
                 "coef_": np.asarray(out["coef_"]),
@@ -310,6 +324,14 @@ class LogisticRegression(
             c = float(params["C"])
             reg = 1.0 / c if c > 0.0 else 0.0
             l1_ratio = float(params["l1_ratio"])
+            if _resolve_objective_dtype(params) != "float32":
+                # validate AND be explicit: the streamed fit's bottleneck
+                # is chunk ingest (the wire-dtype path already narrows
+                # transfers), so bf16 objective reads do not apply here
+                get_logger(type(self)).warning(
+                    "objective_dtype=bfloat16 applies to the resident fit "
+                    "only; the streaming fit reads chunks at wire dtype"
+                )
             out = streamed_logreg_fit(
                 inputs.source,
                 inputs.mesh,
