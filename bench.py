@@ -144,6 +144,64 @@ def _best_time(make_args, run, reps: int = 3):
 INNER_FITS = max(1, int(os.environ.get("BENCH_INNER_FITS", 4)))
 
 
+def _gen_dataset(mesh, n_rows, seed, dtype=None):
+    """On-device chunked dataset generation -> (X, mask, y), row-sharded.
+
+    Chunked because random.normal over the full matrix would hold the
+    uint32 bit buffer AND the f32 output at once (2x matrix bytes — OOM
+    for a ~12 GB X on a 16 GiB chip). Chunks land in a preallocated
+    buffer via dynamic_update_slice (aliased in-place by XLA) — NOT a
+    lax.scan stacked output, whose exotic layout forces downstream
+    shard_map kernels to materialize a default-layout copy of the whole
+    matrix (observed OOM at d=3000). ``dtype`` narrows the stored X
+    (generation stays f32); labels come from a fixed seed-0 true weight
+    vector so every caller labels consistently.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x_dtype = jnp.float32 if dtype is None else dtype
+    n_dp = mesh.shape["dp"]
+    pad_unit = CSIZE * n_dp
+    n_pad = ((n_rows + pad_unit - 1) // pad_unit) * pad_unit
+    row_sharding = NamedSharding(mesh, P("dp"))
+    w_true = jnp.asarray(
+        np.random.default_rng(0).standard_normal(N_COLS, dtype=np.float32)
+    )
+
+    def _gen(key, w):
+        def body(i, Xg):
+            blk = jax.random.normal(
+                jax.random.fold_in(key, i), (pad_unit, N_COLS), jnp.float32
+            )
+            return lax.dynamic_update_slice_in_dim(
+                Xg, blk.astype(x_dtype), i * pad_unit, 0
+            )
+
+        Xg = lax.fori_loop(
+            0, n_pad // pad_unit, body, jnp.zeros((n_pad, N_COLS), x_dtype)
+        )
+        m = (jnp.arange(n_pad) < n_rows).astype(jnp.float32)
+        yg = (
+            lax.dot_general(
+                Xg, w.astype(x_dtype)[:, None],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )[:, 0]
+            > 0
+        ).astype(jnp.float32) * m
+        return Xg, m, yg
+
+    gen = jax.jit(
+        _gen, out_shardings=(row_sharding, row_sharding, row_sharding)
+    )
+    X, m, y = gen(jax.random.key(seed), w_true)
+    jax.block_until_ready(X)
+    return X, m, y
+
+
 def _time_scanned_fits(fit_body, args_for_rep):
     """Best per-fit time of INNER_FITS fits inside ONE dispatch.
 
@@ -256,6 +314,29 @@ def bench_logreg(X, mask, y, mesh, n_chips):
     # tensor-core reads cuML gets implicitly on Ampere-class GPUs
     obj_dtype = os.environ.get("BENCH_LOGREG_DTYPE", "bfloat16")
 
+    n_rows = N_ROWS
+    Xb, mb, yb = X, mask, y
+    if obj_dtype == "bfloat16":
+        # the fit must SEE a bf16 X: converting the shared f32 X inside the
+        # program holds both copies live (observed 17.3 GB > 15.75 GB at
+        # 12M x 256 on v5e). Generate a separate bf16 dataset instead —
+        # at half the rows so it fits NEXT TO the f32 X the other entries
+        # still need. The eval is bandwidth-bound, so samples/sec is
+        # row-count-insensitive at these sizes; "rows" is recorded.
+        n_rows = int(os.environ.get("BENCH_LOGREG_BF16_ROWS", N_ROWS // 2))
+        try:
+            Xb, mb, yb = _gen_dataset(mesh, n_rows, seed=7, dtype=jnp.bfloat16)
+        except Exception as e:  # noqa: BLE001
+            # the extra bf16 dataset may not fit next to the resident f32
+            # X; deliver the f32 number rather than no logreg entry at all
+            print(
+                f"[bench] logreg bf16 dataset generation failed "
+                f"({type(e).__name__}: {e}); falling back to float32",
+                file=sys.stderr,
+            )
+            obj_dtype = "float32"
+            n_rows = N_ROWS
+
     def make_timed(dt):
         def timed_fn(X, m, y, l2):
             out = logreg_fit(
@@ -272,7 +353,7 @@ def bench_logreg(X, mask, y, mesh, n_chips):
 
     timed = make_timed(obj_dtype)
     try:
-        warm = np.asarray(timed(X, mask, y, jnp.float32(1e-5)))  # compile
+        warm = np.asarray(timed(Xb, mb, yb, jnp.float32(1e-5)))  # compile
     except Exception as e:  # noqa: BLE001
         if obj_dtype == "float32":
             raise
@@ -285,21 +366,25 @@ def bench_logreg(X, mask, y, mesh, n_chips):
             file=sys.stderr,
         )
         obj_dtype = "float32"
+        n_rows = N_ROWS
+        Xb, mb, yb = X, mask, y
         timed = make_timed(obj_dtype)
-        warm = np.asarray(timed(X, mask, y, jnp.float32(1e-5)))
+        warm = np.asarray(timed(Xb, mb, yb, jnp.float32(1e-5)))
     iters = max(int(warm[1]), 1)
     # rep-dependent l2 -> distinct scalar input buffer (see _best_time)
     t, _ = _best_time(
-        lambda rep: (X, mask, y, jnp.float32(1e-5 * (1.0 + (rep + 1) * 1e-3))),
+        lambda rep: (
+            Xb, mb, yb, jnp.float32(1e-5 * (1.0 + (rep + 1) * 1e-3))
+        ),
         timed,
     )
-    n = N_ROWS
     # ~2 objective evals/iter (step + line search), fwd+grad = 4*n*d each
-    flops = 8.0 * n * N_COLS * iters
+    flops = 8.0 * n_rows * N_COLS * iters
     return {
-        "samples_per_sec_per_chip": n * iters / t / n_chips,
+        "samples_per_sec_per_chip": n_rows * iters / t / n_chips,
         "fit_seconds": t,
         "iters": iters,
+        "rows": n_rows,
         "objective_dtype": obj_dtype,
         "flops_model": flops,
         "baseline_samples_per_sec": 2.9e8,
@@ -662,8 +747,6 @@ def main() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     devices = jax.devices()
     n_chips = len(devices)
@@ -695,49 +778,11 @@ def main() -> None:
     from spark_rapids_ml_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(n_chips)
-    csize = CSIZE
-    n_dp = mesh.shape["dp"]
-    pad_unit = csize * n_dp
-    n_pad = ((N_ROWS + pad_unit - 1) // pad_unit) * pad_unit
 
     # Generate the design matrix ON DEVICE (host gen + device_put would pay
     # the tunnel's ~30 MB/s: minutes for gigabytes). Padded rows get random
     # values and a zero mask — kernels mask them out.
-    row_sharding = NamedSharding(mesh, P("dp"))
-    w_true = jnp.asarray(
-        np.random.default_rng(0).standard_normal(N_COLS, dtype=np.float32)
-    )
-
-    # chunked generation: random.normal over the full matrix would hold the
-    # uint32 bit buffer AND the f32 output at once (2x matrix bytes — OOM
-    # for a ~12 GB X on a 16 GiB chip). Generate chunk-by-chunk into a
-    # preallocated buffer via dynamic_update_slice (aliased in-place by
-    # XLA) — NOT by reshaping a lax.scan's stacked output, whose exotic
-    # layout forces downstream shard_map kernels to materialize a
-    # default-layout copy of the whole matrix (observed OOM at d=3000)
-    n_gen_chunks = n_pad // pad_unit
-
-    def _gen(key, w):
-        from jax import lax
-
-        def body(i, X):
-            blk = jax.random.normal(
-                jax.random.fold_in(key, i), (pad_unit, N_COLS), jnp.float32
-            )
-            return lax.dynamic_update_slice_in_dim(X, blk, i * pad_unit, 0)
-
-        X = lax.fori_loop(
-            0, n_gen_chunks, body, jnp.zeros((n_pad, N_COLS), jnp.float32)
-        )
-        mask = (jnp.arange(n_pad) < N_ROWS).astype(jnp.float32)
-        y = (X @ w > 0).astype(jnp.float32) * mask
-        return X, mask, y
-
-    gen = jax.jit(
-        _gen, out_shardings=(row_sharding, row_sharding, row_sharding)
-    )
-    X, mask, y = gen(jax.random.key(0), w_true)
-    jax.block_until_ready(X)
+    X, mask, y = _gen_dataset(mesh, N_ROWS, seed=0)
 
     runs = {
         "pca": lambda: bench_pca(X, mask, mesh, n_chips),

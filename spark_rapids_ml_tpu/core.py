@@ -390,6 +390,16 @@ class _TpuEstimator(Params, _TpuParams):
         extra HBM while removing the 2x copy."""
         return 0
 
+    def _x_placement_dtype(self) -> Optional[Any]:
+        """Device dtype the design matrix is PLACED in (None = the resolved
+        input dtype). Estimators whose fit kernel reads X in a narrower
+        dtype (LogisticRegression's bf16 objective) override: placing X
+        narrow from the host halves H2D bytes and — critically — avoids an
+        in-program ``astype``, which would hold the wide argument and the
+        narrow copy live at once (OOM at near-HBM scales). Labels, weights,
+        masks and solver state keep the resolved input dtype."""
+        return None
+
     def _pre_process_data(self, dataset: DataFrame) -> FitInputs:
         X, X_sparse = _resolve_feature_matrix(self, dataset)
         mesh = make_mesh(self.num_workers)
@@ -417,6 +427,9 @@ class _TpuEstimator(Params, _TpuParams):
             X = np.asarray(X_sparse.todense(), dtype=dtype)
         if d_padded != n_features:
             X = np.pad(X, ((0, 0), (0, d_padded - int(n_features))))
+        place = self._x_placement_dtype()
+        if place is not None and np.dtype(dtype) == np.dtype(np.float32):
+            X = X.astype(place)
         Xd, maskd = shard_rows(X, mesh, csize)
 
         y = w = None

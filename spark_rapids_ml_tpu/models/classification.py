@@ -199,6 +199,24 @@ class LogisticRegression(
     def _enable_fit_multiple_in_single_pass(self) -> bool:
         return True
 
+    def _x_placement_dtype(self):
+        """bf16 objective reads start at placement: X goes to device in
+        bf16 (half the H2D bytes, zero-copy inside ``logreg_fit``) instead
+        of being converted in-program, which would hold the f32 argument
+        AND the bf16 copy live (OOM at near-HBM scales). Resolved from the
+        ESTIMATOR-level setting: fitMultiple param maps share one placed X,
+        so a per-map override cannot re-place it (a map asking f32 over a
+        bf16-placed X still reads bf16 — solver state is f32 either way).
+        Whether placement actually applies is core's decision: it narrows
+        only when the RESOLVED input dtype is f32 (so f64 compat fits are
+        never silently rounded), which covers float32_inputs=False over
+        f32 data too."""
+        import jax.numpy as jnp
+
+        if _resolve_objective_dtype(self._tpu_params) == "bfloat16":
+            return jnp.bfloat16
+        return None
+
     def _supportsTransformEvaluate(self, evaluator: Any) -> bool:
         from ..evaluation import MulticlassClassificationEvaluator
 

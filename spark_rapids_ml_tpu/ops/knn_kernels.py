@@ -29,9 +29,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..parallel.mesh import DP_AXIS
 from .kmeans_kernels import pairwise_sq_dists
 
-# rows per query chunk inside a ring step: bounds the live distance tile to
-# _Q_CHUNK x ni_local so huge query shards don't blow HBM
+# chunk sizes inside a ring step: the live distance tile is bounded to
+# (_Q_CHUNK x _I_CHUNK) regardless of shard sizes — without the item
+# chunking a single-device "ring" against a 1M-item shard would
+# materialize an (nq, 1M) f32 tile (32.7 GB at nq=8192, observed OOM on a
+# 16 GB v5e)
 _Q_CHUNK = 8192
+_I_CHUNK = 32768
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "k"))
@@ -51,10 +55,10 @@ def ring_knn(
 
     def per_device(Xq_l, Xi_l, mi_l, idi_l):
         nq = Xq_l.shape[0]
+        ni = Xi_l.shape[0]
         # pad the local query shard to a chunk multiple so the scan below
-        # always engages — the live tile is bounded to (qc, ni_local)
-        # regardless of query count; padded query rows are sliced off at the
-        # end (their results are garbage but harmless)
+        # always engages; padded query rows are sliced off at the end
+        # (their results are garbage but harmless)
         qc = min(_Q_CHUNK, nq)
         q_pad = (-nq) % qc
         Xq_p = jnp.pad(Xq_l, ((0, q_pad), (0, 0)))
@@ -62,20 +66,48 @@ def ring_knn(
         bd0 = jnp.full((nc, qc, k), jnp.inf, Xq_l.dtype)
         bi0 = jnp.full((nc, qc, k), -1, jnp.int32)
         Xq_c = Xq_p.reshape(nc, qc, -1)
+        # pad the item shard to a chunk multiple too: padded rows carry
+        # mask 0 -> +inf distance, never selected. The padding travels the
+        # ring (every device pads identically, so permuted shapes agree).
+        ic = min(_I_CHUNK, ni)
+        i_pad = (-ni) % ic
+        Xi_l = jnp.pad(Xi_l, ((0, i_pad), (0, 0)))
+        mi_l = jnp.pad(mi_l, ((0, i_pad),))
+        idi_l = jnp.pad(idi_l, ((0, i_pad),))
+        nic = (ni + i_pad) // ic
 
         def step(state, _):
             Xi_cur, mi_cur, idi_cur, bd, bi = state
 
             def body(_, ch):
                 xq, bd_c, bi_c = ch
-                d2 = pairwise_sq_dists(xq, Xi_cur)
-                d2 = jnp.where(mi_cur[None, :] > 0, d2, jnp.inf)
-                cat_d = jnp.concatenate([bd_c, d2], axis=1)
-                cat_i = jnp.concatenate(
-                    [bi_c, jnp.broadcast_to(idi_cur[None, :], d2.shape)], axis=1
+
+                def iblock(carry, blk):
+                    bd_c, bi_c = carry
+                    xi, mi_b, idi_b = blk
+                    d2 = pairwise_sq_dists(xq, xi)
+                    d2 = jnp.where(mi_b[None, :] > 0, d2, jnp.inf)
+                    cat_d = jnp.concatenate([bd_c, d2], axis=1)
+                    cat_i = jnp.concatenate(
+                        [bi_c, jnp.broadcast_to(idi_b[None, :], d2.shape)],
+                        axis=1,
+                    )
+                    negd, sel = lax.top_k(-cat_d, k)
+                    return (
+                        -negd,
+                        jnp.take_along_axis(cat_i, sel, axis=1),
+                    ), None
+
+                (bd_c, bi_c), _ = lax.scan(
+                    iblock,
+                    (bd_c, bi_c),
+                    (
+                        Xi_cur.reshape(nic, ic, -1),
+                        mi_cur.reshape(nic, ic),
+                        idi_cur.reshape(nic, ic),
+                    ),
                 )
-                negd, sel = lax.top_k(-cat_d, k)
-                return None, (-negd, jnp.take_along_axis(cat_i, sel, axis=1))
+                return None, (bd_c, bi_c)
 
             _, (bd, bi) = lax.scan(body, None, (Xq_c, bd, bi))
             Xi_cur = lax.ppermute(Xi_cur, DP_AXIS, perm)

@@ -81,16 +81,25 @@ def logreg_fit(
     TF32 tensor-core reads cuML gets implicitly on Ampere. Per-element
     rounding is ~1e-2 relative but i.i.d. across rows, so gradient sums
     see it averaged down by sqrt(n); solution drift at bench scales is
-    well inside the solver tolerance."""
-    dtype = X.dtype
+    well inside the solver tolerance.
+
+    X may itself arrive in bf16 (with any ``objective_dtype``): solver
+    state, statistics and reductions still run f32 — the upcast fuses
+    into the reduction/matmul loops, so no f32 copy of X is ever
+    materialized. Passing bf16 X is the memory-safe route at near-HBM
+    scales: an in-program ``astype`` of an f32 argument would hold both
+    copies live (observed 17.3 GB > 15.75 GB on a 12M x 256 bench fit)."""
+    dtype = jnp.float32 if X.dtype == jnp.bfloat16 else X.dtype
     d = X.shape[1]
     n = mask.sum()
     yi = y.astype(jnp.int32)
     yf = y.astype(dtype)
 
-    mean = (X * mask[:, None]).sum(axis=0) / n
+    mean = (X.astype(dtype) * mask[:, None]).sum(axis=0) / n
     if standardization:
-        sq = ((X - mean[None, :]) ** 2 * mask[:, None]).sum(axis=0)
+        sq = ((X.astype(dtype) - mean[None, :]) ** 2 * mask[:, None]).sum(
+            axis=0
+        )
         var = sq / jnp.maximum(n - 1.0, 1.0)
         std = jnp.sqrt(jnp.maximum(var, 0.0))
         inv_std = jnp.where(std > 0, 1.0 / std, 1.0)
@@ -120,15 +129,37 @@ def logreg_fit(
 
     from .logreg_pallas import logreg_pallas_ok, make_fused_data_loss
 
-    # the objective's X copy: mean/std above always come from the f32
-    # input; only the per-iteration data passes read the narrow copy
+    # the objective's X copy: mean/std above come from X as it arrived
+    # (exact-f32 moments for f32 input; bf16-rounded-then-f32-accumulated
+    # for a bf16-placed X); only the per-iteration data passes read the
+    # narrow copy
     if objective_dtype not in ("float32", "bfloat16"):
         raise ValueError(
             f"objective_dtype must be float32|bfloat16, got {objective_dtype!r}"
         )
     X_obj = X
-    if objective_dtype == "bfloat16" and dtype == jnp.float32:
-        X_obj = X.astype(jnp.bfloat16)
+    if objective_dtype == "bfloat16" and X.dtype == jnp.float32:
+        # near-HBM-capacity guard: the in-program convert holds the f32
+        # argument AND the bf16 copy live — per chip, so the budget is the
+        # PER-DEVICE shard (global bytes / dp size on a mesh). Past ~1 GB
+        # per device callers must pass X in bf16 instead (zero-copy here;
+        # the estimator's ``_x_placement_dtype`` hook does exactly that).
+        # The skip is trace-time, so the warning fires once per shape.
+        from ..parallel.mesh import DP_AXIS
+
+        n_dp = dict(mesh.shape).get(DP_AXIS, 1) if mesh is not None else 1
+        if X.size * X.dtype.itemsize // max(n_dp, 1) <= (1 << 30):
+            X_obj = X.astype(jnp.bfloat16)
+        else:
+            from ..utils.logging import get_logger
+
+            get_logger("logreg_fit").warning(
+                "objective_dtype=bfloat16 requested for a %.1f GB f32 X: "
+                "running f32 reads instead (an in-program convert would "
+                "double X's residency). Pass X placed in bf16 to get bf16 "
+                "reads at this scale.",
+                X.size * X.dtype.itemsize / 2**30,
+            )
 
     fused_data = None
     if mesh is not None and logreg_pallas_ok(d, K, X_obj.dtype):
@@ -142,6 +173,9 @@ def logreg_fit(
         if fused_data is not None:
             data_loss = fused_data(Aeff, beff) / n
         else:
+            # weights stay f32 (rounding A to bf16 would bias every row
+            # identically — no sqrt(n) averaging); the X upcast feeds the
+            # dot and XLA fuses it into operand loading where it can.
             logits = X_obj.astype(dtype) @ Aeff.T + beff[None, :]  # (n, K)
             if multinomial:
                 ll = jax.nn.logsumexp(logits, axis=1) - jnp.take_along_axis(

@@ -165,3 +165,24 @@ def test_knn_object_int_ids_rejected_for_exchange():
     assert out.dtype.kind == "U"
     outb = unify_string_width(np.array([b"a", b"bb"], dtype=object))
     assert outb.dtype.kind == "S"
+
+
+def test_knn_item_chunking_exact(monkeypatch):
+    """The ring step must stay exact when the item shard spans several
+    item chunks (the bound that keeps the live distance tile from scaling
+    with the shard: an unchunked step OOM'd at 8192 x 1M on a 16 GB v5e).
+    Chunk sizes are shrunk so chunked/unchunked boundaries, a non-multiple
+    tail, and padded rows are all crossed at test scale."""
+    from spark_rapids_ml_tpu.ops import knn_kernels
+
+    monkeypatch.setattr(knn_kernels, "_I_CHUNK", 64)
+    monkeypatch.setattr(knn_kernels, "_Q_CHUNK", 32)
+    Xi, Xq = _data(n_items=389, n_query=71, d=8, seed=11)  # 389 % 64 != 0
+    k = 9
+    model = NearestNeighbors(k=k, num_workers=2).fit(
+        DataFrame({"features": Xi})
+    )
+    _, _, knn_df = model.kneighbors(DataFrame({"features": Xq}))
+    dist, idx = _sklearn_knn(Xi, Xq, k)
+    np.testing.assert_allclose(knn_df["distances"], dist, atol=1e-4)
+    np.testing.assert_array_equal(knn_df["indices"], idx)
