@@ -808,6 +808,22 @@ def main() -> None:
 
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
     results = {}
+    watchdog_tripped = []
+    meta = {
+        "device": getattr(devices[0], "device_kind", "cpu"),
+        "tpu_unreachable": not tpu_ok,
+        # timings taken inside an active trace carry profiler overhead —
+        # not comparable with unprofiled runs
+        "profiled": bool(profile_dir),
+        "n_chips": n_chips,
+        "n_rows": N_ROWS,
+        "n_cols": N_COLS,
+    }
+    # live references for the SIGTERM handler: an external timeout kill
+    # mid-run still emits the entries that already finished
+    _PARTIAL.update(
+        results=results, meta=meta, tripped=watchdog_tripped, emitted=False
+    )
     for name, fn in runs.items():
         for attempt in (0, 1):
             try:
@@ -815,7 +831,7 @@ def main() -> None:
                 with trace(
                     os.path.join(profile_dir, name) if profile_dir else None
                 ):
-                    res = fn()
+                    res = _run_with_watchdog(name, fn, watchdog_tripped)
                 res["mfu"] = res["flops_model"] / (
                     res["fit_seconds"] * peak * n_chips
                 )
@@ -844,8 +860,28 @@ def main() -> None:
 
     if not results:
         print("[bench] all algorithms failed; no metric to report", file=sys.stderr)
+        if watchdog_tripped:
+            # a parked worker thread can block interpreter teardown — see
+            # the _hard_exit note below
+            _hard_exit(1)
         sys.exit(1)
 
+    # flag BEFORE emitting: a SIGTERM landing mid-print must not re-enter
+    # emission from the handler (interleaved/duplicate JSON lines)
+    _PARTIAL["emitted"] = True
+    _emit_line(results, meta, watchdog_tripped)
+    if watchdog_tripped:
+        # a tripped watchdog means a worker thread is still parked inside
+        # a device call that never returned; normal interpreter exit would
+        # block on runtime teardown behind it, leaving this process alive
+        # and holding the tunnel grant — the exact wedge the watchdog
+        # exists to bound. Flush and leave.
+        _hard_exit(0)
+
+
+def _emit_line(results, meta, watchdog_tripped):
+    """Assemble and print the one-line JSON metric. Pure-Python over
+    already-fetched scalars — safe to call from the SIGTERM handler."""
     # tunnel-bound entries (host->device ingest via the remote tunnel)
     # measure the link, not the chip — keep them out of the geomean
     vs = [
@@ -864,21 +900,14 @@ def main() -> None:
         "unit": "samples/sec/chip",
         "vs_baseline": round(headline["vs_baseline"], 3),
         "vs_baseline_geomean": round(geomean_vs, 3),
-        "device": getattr(devices[0], "device_kind", "cpu"),
-        "tpu_unreachable": not tpu_ok,
-        # timings taken inside an active trace carry profiler overhead —
-        # not comparable with unprofiled runs
-        "profiled": bool(profile_dir),
-        "n_chips": n_chips,
-        "n_rows": N_ROWS,
-        "n_cols": N_COLS,
+        **meta,
     }
     # provenance scalars each entry may carry (configuration that actually
     # ran — dtype fallbacks, tree counts, dispatch amortization)
     _extras = (
         "iters", "trees", "rows", "queries", "objective_dtype",
         "matmul_dtype", "inner_fits_per_dispatch", "ingest_gbps",
-        "stream_gb",
+        "stream_gb", "overlapped_abandoned",
     )
     for name, r in results.items():
         line[name] = {
@@ -892,8 +921,141 @@ def main() -> None:
                 line[name][k] = r[k]
         if r.get("tunnel_bound"):
             line[name]["tunnel_bound"] = True
+    if watchdog_tripped:
+        line["watchdog_tripped"] = watchdog_tripped
     print(json.dumps(line))
 
 
+class _BenchTimeout(RuntimeError):
+    pass
+
+
+def _hard_exit(code):
+    """Flush and leave WITHOUT interpreter unwind: with a worker thread
+    parked in a dead device call, normal exit blocks on runtime teardown
+    (keeping the process alive holding the tunnel grant), and an unwind
+    with a dispatch mid-flight aborts in teardown anyway (observed)."""
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(code)
+
+
+def _algo_deadline():
+    raw = os.environ.get("BENCH_ALGO_TIMEOUT", "1200")
+    try:
+        return float(raw)
+    except ValueError:
+        # one clear config error, not N phantom per-algorithm failures
+        sys.exit(f"BENCH_ALGO_TIMEOUT must be a number of seconds, got {raw!r}")
+
+
+_ABANDONED = []  # threads of tripped entries; may wake and run later
+
+
+def _run_with_watchdog(name, fn, tripped):
+    """Run one bench entry on a worker thread with a deadline.
+
+    A tunnel dispatch can hang forever client-side (observed: a compile
+    fetch that never returned, eating an entire capture run). The worker
+    is a daemon thread: on timeout the entry is abandoned (recorded in
+    ``tripped``) and the loop moves on — later entries may still succeed
+    if the backend recovers, and the final JSON line always prints.
+    BENCH_ALGO_TIMEOUT=0 disables the deadline.
+
+    An abandoned worker that UNBLOCKS later would keep issuing its
+    entry's device work concurrently with whatever runs next: workers
+    check a cancel flag between fetches-from-box and results from a
+    cancelled worker are discarded; entries that overlapped a still-alive
+    abandoned worker are flagged ``overlapped_abandoned`` (their timings
+    shared the chip)."""
+    import threading
+
+    deadline = _algo_deadline()
+    if deadline <= 0:
+        return fn()
+    box = {}
+    cancelled = threading.Event()
+
+    def work():
+        try:
+            res = fn()
+            if not cancelled.is_set():
+                box["res"] = res
+        except BaseException as e:  # noqa: BLE001
+            if not cancelled.is_set():
+                box["err"] = e
+
+    t = threading.Thread(target=work, name=f"bench-{name}", daemon=True)
+    t.start()
+    t.join(deadline)
+    if t.is_alive():
+        cancelled.set()
+        tripped.append(name)
+        _ABANDONED.append(t)
+        raise _BenchTimeout(
+            f"{name} exceeded BENCH_ALGO_TIMEOUT={deadline:.0f}s "
+            "(device call never returned; entry abandoned)"
+        )
+    if "err" in box:
+        err = box["err"]
+        if not isinstance(err, Exception):
+            # KeyboardInterrupt/SystemExit re-raised in the main thread
+            # would escape the per-entry handler and unwind the whole run
+            # (wedge-prone with parked workers); surface as a failure
+            raise RuntimeError(f"{name} worker raised {type(err).__name__}: {err}")
+        raise err
+    res = box["res"]
+    if any(a.is_alive() for a in _ABANDONED):
+        res["overlapped_abandoned"] = True
+    return res
+
+
+_PARTIAL = {"results": None, "meta": None, "tripped": None, "emitted": False}
+
+
+def _install_signal_handlers():
+    """External timeouts/cancellations send SIGTERM; the default handler
+    kills the process mid-dispatch with nothing recorded. Instead: emit
+    the JSON line for every entry that already finished (a partial
+    capture beats none), then leave via os._exit — an interpreter unwind
+    with a dispatch mid-flight aborts in runtime teardown anyway
+    (observed), and a lingering process would keep holding the tunnel's
+    exclusive chip grant (the round-2 wedge postmortem)."""
+    import signal
+
+    def _graceful(signum, frame):
+        print(
+            f"[bench] signal {signum}: emitting partial results and exiting",
+            file=sys.stderr,
+        )
+        try:
+            if (
+                not _PARTIAL["emitted"]
+                and _PARTIAL["results"]  # placed by main(), non-empty
+            ):
+                _PARTIAL["emitted"] = True
+                _emit_line(
+                    _PARTIAL["results"], _PARTIAL["meta"], _PARTIAL["tripped"]
+                )
+        except Exception:  # noqa: BLE001 — never mask the exit on a bug here
+            traceback.print_exc()
+        _hard_exit(128 + signum)
+
+    def _interrupt(signum, frame):
+        # Ctrl-C on a healthy run: default KeyboardInterrupt unwind (the
+        # clean client teardown). After a watchdog trip the unwind would
+        # block behind the parked worker — partial-emit and leave instead.
+        if _PARTIAL["tripped"]:
+            _graceful(signum, frame)
+        raise KeyboardInterrupt
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _interrupt)
+    except (ValueError, OSError):
+        pass  # non-main thread or unsupported platform
+
+
 if __name__ == "__main__":
+    _install_signal_handlers()
     main()
