@@ -478,9 +478,23 @@ def bench_rf(X, mask, y, mesh, n_chips):
     stats = jnp.stack([1.0 - ys, ys], axis=1) * ms[:, None]
     trees_per_dev = -(-RF_TREES // n_dp)
     from jax.sharding import NamedSharding, PartitionSpec as P
+    # reference semantics: the benchmark config leaves featureSubsetStrategy
+    # at Spark's default "auto", which cuML resolves to sqrt(d) per split
+    # for classification (``/root/reference/python/src/spark_rapids_ml/
+    # tree.py:380-386``). Resolution is shared with the estimator so the
+    # bench can never drift from what the library fits. Override with
+    # BENCH_RF_K=<n> or BENCH_RF_K=all (all-features variant).
+    from spark_rapids_ml_tpu.models.tree import _resolve_k_features
+
+    raw_k = os.environ.get("BENCH_RF_K", "auto")
+    k_feat = _resolve_k_features(
+        N_COLS if raw_k == "all" else (raw_k if raw_k == "auto" else int(raw_k)),
+        N_COLS,
+        True,
+    )
     cfg = ForestConfig(
         max_depth=RF_DEPTH, n_bins=RF_BINS, n_features=N_COLS, n_stats=2,
-        impurity="gini", k_features=N_COLS, min_samples_leaf=1,
+        impurity="gini", k_features=k_feat, min_samples_leaf=1,
         min_info_gain=0.0, min_samples_split=2, bootstrap=True,
         hist_strategy=resolve_hist_strategy(),
     )
@@ -542,15 +556,18 @@ def bench_rf(X, mask, y, mesh, n_chips):
             break
     t = min(times)
     n_trees = trees_per_dev * n_dp
-    # updates model: one histogram update per (row, feature, stat, level)
-    updates = float(n_rf) * N_COLS * 2 * RF_DEPTH * n_trees
+    # updates model: one histogram update per (row, sampled feature, stat,
+    # level) — both sides of the comparison pay k_features per node, so
+    # the A10G atomics baseline divides by the same per-sample cost
+    updates = float(n_rf) * k_feat * 2 * RF_DEPTH * n_trees
     return {
         "samples_per_sec_per_chip": n_rf * n_trees / t / n_chips,
         "fit_seconds": t,
         "trees": n_trees,
         "rows": n_rf,
+        "k_features": k_feat,
         "flops_model": updates,  # scatter-equivalent work, not MXU flops
-        "baseline_samples_per_sec": 1.8e9 / (N_COLS * RF_DEPTH * 2),
+        "baseline_samples_per_sec": 1.8e9 / (k_feat * RF_DEPTH * 2),
     }
 
 
@@ -907,7 +924,7 @@ def _emit_line(results, meta, watchdog_tripped):
     _extras = (
         "iters", "trees", "rows", "queries", "objective_dtype",
         "matmul_dtype", "inner_fits_per_dispatch", "ingest_gbps",
-        "stream_gb", "overlapped_abandoned",
+        "stream_gb", "overlapped_abandoned", "k_features",
     )
     for name, r in results.items():
         line[name] = {
@@ -962,17 +979,20 @@ def _run_with_watchdog(name, fn, tripped):
     if the backend recovers, and the final JSON line always prints.
     BENCH_ALGO_TIMEOUT=0 disables the deadline.
 
-    An abandoned worker that UNBLOCKS later would keep issuing its
-    entry's device work concurrently with whatever runs next: workers
-    check a cancel flag between fetches-from-box and results from a
-    cancelled worker are discarded; entries that overlapped a still-alive
-    abandoned worker are flagged ``overlapped_abandoned`` (their timings
-    shared the chip)."""
+    An abandoned worker that UNBLOCKS later keeps issuing its entry's
+    remaining device work until the entry finishes (a parked C call
+    cannot be interrupted); its late result is discarded via the cancel
+    flag. Entries that overlapped a live abandoned worker at START or
+    END are flagged ``overlapped_abandoned`` (their timings shared the
+    chip) — a worker that wakes and finishes strictly inside another
+    entry's window can still evade the flag; treat entries after a trip
+    with suspicion."""
     import threading
 
     deadline = _algo_deadline()
     if deadline <= 0:
         return fn()
+    overlapped_at_start = any(a.is_alive() for a in _ABANDONED)
     box = {}
     cancelled = threading.Event()
 
@@ -1005,7 +1025,7 @@ def _run_with_watchdog(name, fn, tripped):
             raise RuntimeError(f"{name} worker raised {type(err).__name__}: {err}")
         raise err
     res = box["res"]
-    if any(a.is_alive() for a in _ABANDONED):
+    if overlapped_at_start or any(a.is_alive() for a in _ABANDONED):
         res["overlapped_abandoned"] = True
     return res
 

@@ -361,3 +361,30 @@ def test_histogram_matmul_strategy_matches_scatter(monkeypatch):
     np.testing.assert_allclose(
         m_mm._leaf_stats_arr, m_sc._leaf_stats_arr, rtol=1e-5, atol=1e-5
     )
+
+
+def test_subset_gather_histogram_strategies_agree(monkeypatch):
+    """featureSubsetStrategy < all takes the gathered-subset histogram
+    path (n*k*S updates per level instead of n*d*S — the cut that makes
+    the reference's 1M x 3000 sqrt(d) config buildable). Matmul and
+    scatter strategies must produce the same forest on it, and the
+    forest must use only real features."""
+    rng = np.random.default_rng(23)
+    X = rng.normal(size=(900, 21)).astype(np.float32)  # 21: k_pad padding
+    y = ((X[:, 3] - X[:, 7] + 0.5 * X[:, 11]) > 0).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+
+    kw = dict(
+        numTrees=6, maxDepth=5, seed=5, featureSubsetStrategy="sqrt"
+    )
+    monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "scatter")
+    m_sc = RandomForestClassifier(**kw).fit(df)
+    monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "matmul")
+    m_mm = RandomForestClassifier(**kw).fit(df)
+
+    np.testing.assert_array_equal(m_mm._features_arr, m_sc._features_arr)
+    np.testing.assert_allclose(m_mm._thresholds_arr, m_sc._thresholds_arr)
+    feats = np.asarray(m_sc._features_arr)
+    assert feats.max() < 21  # split features are real (no pad sentinel)
+    acc = (m_sc.transform(df)["prediction"] == y).mean()
+    assert acc > 0.85
