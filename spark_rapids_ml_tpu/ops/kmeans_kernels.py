@@ -76,13 +76,25 @@ def _chunk_stats(X_local, mask_local, centers, csize: int, matmul_dtype=None):
     """Chunked pass over local rows; returns (sums (k,d), counts int32 (k,),
     cost).
 
+    On TPU at qualifying shapes the pass runs as ONE fused Pallas kernel
+    (``ops.kmeans_pallas``): distances, argmin, one-hot and both
+    contractions stay VMEM-resident, so HBM sees a single read of X per
+    iteration instead of the two (csize, k) intermediates this XLA path
+    materializes per chunk.
+
     Chunks are read with :func:`ops.linalg.row_chunk` (NOT a lax.scan over
     a reshaped X — see its docstring for the layout-repack hazard).
     ``matmul_dtype=bfloat16`` also runs the one-hot stats contraction with
     bf16 operands (one-hots are exact; x rounds at ~1e-3 relative, washed
     out by the per-cluster mean)."""
+    from .kmeans_pallas import kmeans_pallas_ok, lloyd_step_pallas
+
     k = centers.shape[0]
     d = X_local.shape[1]
+    if kmeans_pallas_ok(X_local.shape[0], d, k, X_local.dtype):
+        return lloyd_step_pallas(
+            X_local, mask_local, centers, matmul_dtype=matmul_dtype
+        )
     n_chunks = check_row_chunking(X_local.shape[0], csize)
     c_sq = (centers * centers).sum(axis=1)  # (k,)
 

@@ -226,3 +226,104 @@ def test_logreg_fused_bf16_objective_close_to_f32():
         == (X @ np.asarray(b16["coef_"]).T[:, 0] > 0)
     )
     assert agree > 0.99, agree
+
+
+@pytest.mark.parametrize("matmul_dtype", [None, "bfloat16"])
+def test_lloyd_step_pallas_matches_xla_chunk_stats(matmul_dtype):
+    """The fused Pallas Lloyd pass must reproduce the XLA chunked step's
+    (sums, counts, cost) triple — including masked rows, a non-128 k
+    (center padding must never win the argmin), and both contraction
+    dtypes."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import kmeans_pallas
+    from spark_rapids_ml_tpu.ops.kmeans_kernels import _chunk_stats
+
+    md = None if matmul_dtype is None else jnp.bfloat16
+    rng = np.random.default_rng(9)
+    n, d, k = 4096, 128, 37
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    mask = np.ones((n,), np.float32)
+    mask[-300:] = 0.0  # padding rows must not contribute
+    centers = rng.normal(size=(k, d)).astype(np.float32)
+
+    # force the XLA branch for the reference values: on a TPU host the
+    # gate would engage Pallas inside _chunk_stats too, and the test
+    # would compare the kernel against itself
+    orig_ok = kmeans_pallas.kmeans_pallas_ok
+    kmeans_pallas.kmeans_pallas_ok = lambda *a: False
+    try:
+        sums_x, counts_x, cost_x = jax.jit(
+            lambda X, m, c: _chunk_stats(X, m, c, csize=1024, matmul_dtype=md)
+        )(X, mask, centers)
+    finally:
+        kmeans_pallas.kmeans_pallas_ok = orig_ok
+
+    # TILE must divide n for the gate; shrink it for test scale. _TILE is
+    # baked into lloyd_step_pallas's jit trace — drop caches on restore
+    # so later same-shape calls don't silently reuse the test tile.
+    old_tile = kmeans_pallas._TILE
+    kmeans_pallas._TILE = 512
+    try:
+        sums_p, counts_p, cost_p = kmeans_pallas.lloyd_step_pallas(
+            X, mask, centers, matmul_dtype=md, interpret=True
+        )
+    finally:
+        kmeans_pallas._TILE = old_tile
+        jax.clear_caches()
+
+    np.testing.assert_array_equal(np.asarray(counts_p), np.asarray(counts_x))
+    rtol = 1e-6 if md is None else 1e-2
+    np.testing.assert_allclose(
+        np.asarray(sums_p), np.asarray(sums_x), rtol=rtol, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        float(cost_p), float(cost_x), rtol=1e-5 if md is None else 1e-2
+    )
+
+
+def test_kmeans_fit_pallas_branch_matches_xla(monkeypatch):
+    """Full KMeans fit with the fused Pallas step ENGAGED (interpret +
+    TPUML_LANE_PAD, mirroring the on-TPU ingestion) must match the
+    XLA-step fit. The spy asserts the branch actually ran — the gate
+    silently falling back would make this test vacuous."""
+    from spark_rapids_ml_tpu.data import DataFrame
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+    from spark_rapids_ml_tpu.ops import kmeans_pallas
+
+    rng = np.random.default_rng(4)
+    # 1024 rows / 2 workers -> 512-row shards: divisible by the test TILE
+    X = np.concatenate(
+        [
+            rng.normal(loc=c, scale=0.3, size=(256, 5))
+            for c in (-3.0, 0.0, 3.0, 6.0)
+        ]
+    ).astype(np.float32)
+    df = DataFrame({"features": X})
+    kw = dict(k=4, maxIter=12, seed=1, initMode="random", num_workers=2)
+
+    m_xla = KMeans(**kw).fit(df)
+
+    calls = []
+    orig = kmeans_pallas.lloyd_step_pallas
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setenv("TPUML_LANE_PAD", "128")  # on-TPU ingestion shape
+    monkeypatch.setattr(kmeans_pallas, "FORCE_INTERPRET", True)
+    monkeypatch.setattr(kmeans_pallas, "_TILE", 128)
+    monkeypatch.setattr(kmeans_pallas, "lloyd_step_pallas", spy)
+    jax.clear_caches()  # FORCE_INTERPRET/_TILE are not jit cache keys
+    try:
+        m_pl = KMeans(**kw).fit(df)
+    finally:
+        jax.clear_caches()
+
+    assert calls, "fused Pallas Lloyd step never engaged"
+    np.testing.assert_allclose(
+        np.sort(np.asarray(m_pl.clusterCenters()), axis=0),
+        np.sort(np.asarray(m_xla.clusterCenters()), axis=0),
+        rtol=1e-5, atol=1e-5,
+    )
