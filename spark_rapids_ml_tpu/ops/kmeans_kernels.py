@@ -91,7 +91,7 @@ def _chunk_stats(X_local, mask_local, centers, csize: int, matmul_dtype=None):
 
     k = centers.shape[0]
     d = X_local.shape[1]
-    if kmeans_pallas_ok(X_local.shape[0], d, k, X_local.dtype):
+    if kmeans_pallas_ok(X_local.shape[0], d, k, X_local.dtype, matmul_dtype):
         return lloyd_step_pallas(
             X_local, mask_local, centers, matmul_dtype=matmul_dtype
         )

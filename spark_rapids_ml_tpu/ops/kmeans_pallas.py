@@ -44,7 +44,47 @@ FORCE_INTERPRET = False
 _TILE = 2048
 
 
-def kmeans_pallas_ok(n_local: int, d: int, k: int, dtype) -> bool:
+# Hardware-lowering probe results, keyed by the lowering-relevant config
+# (d, k_pad, matmul_dtype). Interpret-mode tests exercise the kernel BODY
+# but not Mosaic lowering: round 3 shipped a scalar VMEM store that traced
+# and interpreted fine yet failed only on the real chip, dropping KMeans
+# from the bench capture entirely. Before the first real use of a config on
+# a TPU backend, a one-tile instance with the caller's actual d/k/dtype is
+# compiled; if Mosaic rejects it, that caller falls back to the XLA chunked
+# path instead of crashing the fit. (n does not affect lowering — it only
+# changes the grid length — so one tile suffices.)
+_LOWERING_OK: dict = {}
+
+
+def _probe_lowering(d: int, k: int, matmul_dtype) -> bool:
+    key = (d, -(-k // 128) * 128, jnp.dtype(matmul_dtype).name if matmul_dtype else None)
+    if key not in _LOWERING_OK:
+        try:
+            # avals only — the probe may run while an outer fit is tracing,
+            # so no device buffers and nothing the outer trace could capture
+            x = jax.ShapeDtypeStruct((_TILE, d), jnp.float32)
+            m = jax.ShapeDtypeStruct((_TILE,), jnp.float32)
+            c = jax.ShapeDtypeStruct((k, d), jnp.float32)
+            lloyd_step_pallas.lower(x, m, c, matmul_dtype=matmul_dtype).compile()
+            _LOWERING_OK[key] = True
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fused Lloyd Pallas kernel failed to lower for config %s; "
+                "falling back to the XLA chunked step: %s", key, e
+            )
+            # permanently cache only genuine Mosaic rejections; a transient
+            # backend failure (RPC hiccup, HBM pressure) must not pin the
+            # process to the slower XLA path forever
+            msg = str(e)
+            if "Mosaic" in msg or "Not implemented" in msg:
+                _LOWERING_OK[key] = False
+            return False
+    return _LOWERING_OK[key]
+
+
+def kmeans_pallas_ok(n_local: int, d: int, k: int, dtype, matmul_dtype=None) -> bool:
     """Trace-time gate: TPU, f32 input, lane-aligned d (KMeans ingestion
     pads features to 128, so the reference d=3000 shape qualifies), local
     rows divisible by the tile (the shard_rows csize invariant makes the
@@ -59,13 +99,16 @@ def kmeans_pallas_ok(n_local: int, d: int, k: int, dtype) -> bool:
         + 2 * k_pad * d * 4
         + _TILE * d * 4 * 2
     )
-    return (
+    ok = (
         (jax.default_backend() == "tpu" or FORCE_INTERPRET)
         and dtype == jnp.float32
         and d % 128 == 0
         and n_local % _TILE == 0
         and vmem < 90 * 1024 * 1024
     )
+    if ok and not FORCE_INTERPRET:
+        ok = _probe_lowering(d, k, matmul_dtype)
+    return ok
 
 
 @functools.partial(jax.jit, static_argnames=("matmul_dtype", "interpret"))
@@ -104,6 +147,9 @@ def lloyd_step_pallas(
     cd = centers.astype(matmul_dtype) if matmul_dtype is not None else centers
 
     def kern(x_ref, m_ref, c_ref, csq_ref, sums_ref, counts_ref, cost_ref):
+        # Everything stays 2-D (keepdims): Mosaic rejects both scalar VMEM
+        # stores and 1-D full reductions ("Offset change" on
+        # vector<1x2048> -> vector<1>) — both discovered only on hardware.
         i = pl.program_id(0)
 
         @pl.when(i == 0)
@@ -113,7 +159,10 @@ def lloyd_step_pallas(
             cost_ref[:] = jnp.zeros_like(cost_ref)
 
         x = x_ref[:]                       # (tile, d) f32
-        m = m_ref[:]                       # (tile,)
+        # mask loads 1-D ((tile,) linear layout: a (n, 1) operand would be
+        # tile-padded T(8,128) = 128x HBM expansion + a full copy) and is
+        # expanded to (tile, 1) in-register for the 2-D ops below
+        m = m_ref[:][:, None]              # (tile, 1) f32
         xd = x.astype(cd.dtype)
         xc = jax.lax.dot_general(
             xd, c_ref[:], (((1,), (1,)), ((), ())),
@@ -121,18 +170,18 @@ def lloyd_step_pallas(
         )                                  # (tile, k_pad)
         # x_sq is row-constant: it joins for the cost only, never the argmin
         part = csq_ref[:] - 2.0 * xc       # (1, k_pad) - : broadcasts
-        a = jnp.argmin(part, axis=1)       # (tile,)
-        best = jnp.min(part, axis=1)
-        x_sq = (x * x).sum(axis=1)
-        cost_ref[0, 0] += jnp.sum(jnp.maximum(best + x_sq, 0.0) * m)
+        a = jnp.argmin(part, axis=1, keepdims=True)   # (tile, 1)
+        best = jnp.min(part, axis=1, keepdims=True)   # (tile, 1)
+        x_sq = (x * x).sum(axis=1, keepdims=True)     # (tile, 1)
+        contrib = jnp.maximum(best + x_sq, 0.0) * m   # (tile, 1)
+        cost_ref[:, :] += jnp.sum(contrib, axis=0, keepdims=True)
         onehot = (
-            a[:, None]
-            == jax.lax.broadcasted_iota(jnp.int32, (1, k_pad), 1)
-        )
+            a == jax.lax.broadcasted_iota(jnp.int32, (1, k_pad), 1)
+        )                                  # (tile, k_pad) bool
         counts_ref[:] += jnp.sum(
-            onehot & (m[:, None] > 0), axis=0, keepdims=True
+            onehot & (m > 0), axis=0, keepdims=True
         ).astype(jnp.int32)
-        oh = onehot.astype(cd.dtype) * m[:, None].astype(cd.dtype)
+        oh = onehot.astype(cd.dtype) * m.astype(cd.dtype)
         sums_ref[:] += jax.lax.dot_general(
             oh, xd, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
