@@ -455,6 +455,7 @@ def bench_rf(X, mask, y, mesh, n_chips):
         binize,
         build_forest,
         next_pow2,
+        resolve_contract_gather,
         resolve_hist_strategy,
     )
 
@@ -497,6 +498,7 @@ def bench_rf(X, mask, y, mesh, n_chips):
         impurity="gini", k_features=k_feat, min_samples_leaf=1,
         min_info_gain=0.0, min_samples_split=2, bootstrap=True,
         hist_strategy=resolve_hist_strategy(),
+        contract_gather=resolve_contract_gather(),
     )
 
     # trees build in groups of <= 8 per dispatch: a multi-minute single

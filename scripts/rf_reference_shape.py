@@ -55,6 +55,7 @@ def main() -> None:
         ForestConfig,
         build_forest,
         next_pow2,
+        resolve_contract_gather,
         resolve_hist_strategy,
     )
     from spark_rapids_ml_tpu.parallel.mesh import make_mesh
@@ -120,6 +121,7 @@ def main() -> None:
         impurity="gini", k_features=k, min_samples_leaf=1,
         min_info_gain=0.0, min_samples_split=2, bootstrap=True,
         hist_strategy=resolve_hist_strategy(),
+        contract_gather=resolve_contract_gather(),
     )
     trees_per_dev = -(-args.trees // n_dp)
     group = min(args.group, trees_per_dev)

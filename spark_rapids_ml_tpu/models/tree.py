@@ -45,7 +45,8 @@ from ..params import (
 )
 from ..parallel.mesh import DP_AXIS
 from ..ops.tree_kernels import (
-    resolve_hist_strategy,
+    resolve_contract_gather,
+        resolve_hist_strategy,
     ForestConfig,
     binize,
     build_forest,
@@ -346,6 +347,7 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
                 min_samples_split=int(params.get("min_samples_split", 2)),
                 bootstrap=bool(params["bootstrap"]),
                 hist_strategy=resolve_hist_strategy(),
+                contract_gather=resolve_contract_gather(),
             )
             # rows-per-tree mode: "all" gathers the binned matrix to every
             # device (quality independent of worker count — the TPU-first

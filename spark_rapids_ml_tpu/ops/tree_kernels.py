@@ -53,6 +53,17 @@ _HIST_BUDGET = 1 << 22
 import os as _os
 
 _SCATTER_EQ_FLOPS = float(_os.environ.get("TPUML_RF_SCATTER_EQ_FLOPS", 5e5))
+def resolve_contract_gather() -> str:
+    """Validated subset-extraction strategy from TPUML_RF_CONTRACT_GATHER:
+    "auto" (TPU at moderate widths), "on", or "off". Rides the static
+    ForestConfig so it participates in the jit cache key — a module flag
+    read at trace time would be silently ignored on cache hits."""
+    v = _os.environ.get("TPUML_RF_CONTRACT_GATHER") or "auto"
+    if v not in ("auto", "on", "off"):
+        raise ValueError(
+            f"RF contract-gather strategy must be auto|on|off, got {v!r}"
+        )
+    return v
 # rows per matmul accumulation chunk: bounds the (C, n_nodes) node-onehot
 # and (C, F*nb) bin-onehot intermediates (C=8192, level 12, F*nb=512:
 # 8192*4096*4 = 128 MB node-onehot is the largest, still < HBM noise)
@@ -88,6 +99,9 @@ class ForestConfig(NamedTuple):
     # in the jit cache key (an env var read inside the traced function
     # would be silently ignored on cache hits).
     hist_strategy: str = "auto"
+    # subset-extraction strategy: "auto" | "on" | "off" (see
+    # resolve_contract_gather); static for the same cache-key reason
+    contract_gather: str = "auto"
 
 
 def max_nodes(max_depth: int) -> int:
@@ -167,13 +181,58 @@ def _impurity(stats: jax.Array, impurity: str) -> jax.Array:
     raise ValueError(f"unknown impurity {impurity!r}")
 
 
-def _chunk_features(d_pad: int, n_nodes: int, n_bins: int, n_stats: int) -> int:
+def _chunk_features(
+    d_pad: int, n_nodes: int, n_bins: int, n_stats: int, budget: int = _HIST_BUDGET
+) -> int:
     """Largest power-of-two feature-chunk keeping the histogram tile in
     budget; d_pad is a power of two, so the chunk always divides it."""
     per_feat = max(1, n_nodes * n_bins * n_stats)
-    f = max(1, _HIST_BUDGET // per_feat)
+    f = max(1, budget // per_feat)
     f = 1 << (f.bit_length() - 1)
     return min(f, d_pad)
+
+
+# ---------------------------------------------------------------------------
+# contraction gather (TPU): per-row feature-subset bin extraction
+# ---------------------------------------------------------------------------
+
+
+def _pack_bins(bins: jax.Array) -> jax.Array:
+    """(n, d) uint8 bins -> (n, d/4) int32, 4 bins per word (d % 4 == 0)."""
+    b32 = bins.astype(jnp.int32)
+    return (
+        b32[:, 0::4]
+        | (b32[:, 1::4] << 8)
+        | (b32[:, 2::4] << 16)
+        | (b32[:, 3::4] << 24)
+    )
+
+
+def _contract_gather(packed: jax.Array, idx: jax.Array) -> jax.Array:
+    """bins[r, idx[r, j]] as a dense compare-select-reduce: (n, k) int32.
+
+    TPU gathers run at ~1e8 elem/s, making ``take_along_axis`` of the
+    per-node sampled columns the single dominant cost of an RF level
+    (measured 25.5 ms of a ~33 ms level at 131k x 256, k=16). Expressed as
+    a word-packed one-hot contraction the same extraction streams on the
+    VPU at ~1.6 ms: compare idx>>2 against the d/4 word lanes, reduce, and
+    shift the byte out. Feature-count sentinels yield bin 0 (see the
+    sentinel invariant note below this function), and the gain search
+    masks those slots exactly like the old clipped-gather path."""
+    words = packed.shape[1]
+    ar_w = jnp.arange(words, dtype=jnp.int32)
+    sel = (idx[:, :, None] >> 2) == ar_w[None, None, :]
+    w = jnp.where(sel, packed[:, None, :], 0).sum(-1)  # (n, k)
+    return (w >> ((idx & 3) * 8)) & 0xFF
+
+
+# Sentinel invariant for _contract_gather: a feature-count sentinel
+# (idx == n_features) either matches NO word (n_features == d_pad) and
+# yields 0, or lands in a zero-filled padding column (n_features < d_pad;
+# binize pads bins with 0) and yields bin 0 — the same value the old
+# clipped take_along_axis produced. Both cases rely on binize's zero fill
+# of columns >= n_features, and the gain search additionally masks every
+# sentinel slot via realf < n_features.
 
 
 # ---------------------------------------------------------------------------
@@ -217,6 +276,26 @@ def _build_tree(
     gains = jnp.zeros((M,), dt)
     node = jnp.zeros((n,), jnp.int32)
 
+    # Word-packed bins for the contraction gather (TPU: per-row gathers run
+    # at ~1e8 elem/s, making take_along_axis ~16x slower than the dense
+    # formulation at d_pad=256; CPU keeps take_along_axis). The contraction
+    # does d_pad/4 word-ops per extracted element (~8.6e10 word-ops/s
+    # measured), so its advantage erodes linearly with width — "auto" caps
+    # it at d_pad<=1024 (4x the measured shape), past which the predicted
+    # win thins and the un-fused intermediate risk grows. Packed once per
+    # tree, outside the level loop.
+    if cfg.contract_gather == "on":
+        use_contract = d_pad % 4 == 0
+    elif cfg.contract_gather == "off":
+        use_contract = False
+    else:
+        use_contract = (
+            jax.default_backend() == "tpu"
+            and d_pad % 4 == 0
+            and d_pad <= 1024
+        )
+    packed = _pack_bins(bins) if use_contract else None
+
     # levels are a static python loop: each level has its own (static) node
     # count and feature-chunk size, so XLA compiles tight fixed-shape kernels
     for level in range(cfg.max_depth + 1):
@@ -258,19 +337,22 @@ def _build_tree(
                 )
             lc0 = jnp.clip(local, 0, n_nodes - 1)
             row_feats = feats[lc0]  # (n, k_pad) real feature ids per row
-            hist_src = jnp.take_along_axis(
-                bins, jnp.clip(row_feats, 0, d_pad - 1), axis=1
-            )  # (n, k_pad) uint8
+            if use_contract:
+                hist_src = _contract_gather(packed, row_feats)  # (n, k_pad) i32
+            else:
+                hist_src = jnp.take_along_axis(
+                    bins, jnp.clip(row_feats, 0, d_pad - 1), axis=1
+                )  # (n, k_pad) uint8
             d_hist = k_pad
         else:
             feats = None
             hist_src = bins
             d_hist = d_pad
 
-        F = _chunk_features(d_hist, n_nodes, nb, S)
-        n_chunks = d_hist // F
-
-        # strategy per level (static): one-hot matmuls on the MXU until the
+        # strategy per level (static). Subset path: the gathered operand is
+        # only k_pad wide, and measured v5e scatter on it is ~2.2 ms/level
+        # FLAT in n_nodes while the one-hot matmul grows past 8 ms — scatter
+        # always wins. No-subset path: one-hot matmuls on the MXU until the
         # 2*n_nodes*nb waste factor exceeds a scatter-add update's cost.
         # "auto" is TPU-only: the trade inverts on CPU, where scatter-adds
         # are cheap and dense one-hot matmuls are pure waste (a CPU run of
@@ -279,11 +361,20 @@ def _build_tree(
             use_matmul = True
         elif cfg.hist_strategy == "scatter":
             use_matmul = False
+        elif subset:
+            use_matmul = False
         else:
             use_matmul = (
                 jax.default_backend() == "tpu"
                 and (2.0 * n_nodes * nb) < _SCATTER_EQ_FLOPS
             )
+
+        # the narrow subset-scatter tile ((k_pad, n_nodes*nb, S): 67 MB at
+        # k=16/depth-13) runs single-chunk under a raised budget — chunking
+        # it only multiplied fixed scatter overheads
+        budget = (1 << 25) if (subset and not use_matmul) else _HIST_BUDGET
+        F = _chunk_features(d_hist, n_nodes, nb, S, budget)
+        n_chunks = d_hist // F
         if use_matmul:
             # the (C, F*nb) bin one-hot is a materialized dot operand; the
             # histogram-tile budget alone lets F reach d_pad at shallow
@@ -469,9 +560,12 @@ def _build_tree(
         # route rows to children; rows whose node became a leaf stay put
         lc = jnp.clip(local, 0, n_nodes - 1)
         row_feat = bf[lc]
-        row_bin = jnp.take_along_axis(
-            bins, jnp.clip(row_feat, 0, d_pad - 1)[:, None], axis=1
-        )[:, 0].astype(jnp.int32)
+        if use_contract:
+            row_bin = _contract_gather(packed, row_feat[:, None])[:, 0]
+        else:
+            row_bin = jnp.take_along_axis(
+                bins, jnp.clip(row_feat, 0, d_pad - 1)[:, None], axis=1
+            )[:, 0].astype(jnp.int32)
         go_right = (row_bin > bb[lc]).astype(jnp.int32)
         child = 2 * node + 1 + go_right
         moves = in_level & do_split[lc]

@@ -388,3 +388,41 @@ def test_subset_gather_histogram_strategies_agree(monkeypatch):
     assert feats.max() < 21  # split features are real (no pad sentinel)
     acc = (m_sc.transform(df)["prediction"] == y).mean()
     assert acc > 0.85
+
+
+def test_contract_gather_matches_take_along_axis(monkeypatch):
+    """The TPU word-packed contraction gather (per-row sampled-feature bin
+    extraction without a hardware gather) must produce a bit-identical
+    forest to the take_along_axis path it replaces — driven on CPU via
+    TPUML_RF_CONTRACT_GATHER=on, which rides the static ForestConfig so
+    the second fit genuinely retraces (a module flag would hit the jit
+    cache and compare the gather path to itself). d=21 exercises the
+    d_pad%4==0 gate (pads to 32) plus sentinel slots from k_pad > k."""
+    import spark_rapids_ml_tpu.ops.tree_kernels as tk
+
+    rng = np.random.default_rng(31)
+    X = rng.normal(size=(700, 21)).astype(np.float32)
+    y = ((X[:, 2] + X[:, 10]) > 0).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+    kw = dict(numTrees=5, maxDepth=5, seed=9, featureSubsetStrategy="sqrt")
+
+    m_gather = RandomForestClassifier(**kw).fit(df)
+    calls = []
+    real_cg = tk._contract_gather
+    monkeypatch.setattr(
+        tk, "_contract_gather",
+        lambda packed, idx: calls.append(1) or real_cg(packed, idx),
+    )
+    monkeypatch.setenv("TPUML_RF_CONTRACT_GATHER", "on")
+    m_contract = RandomForestClassifier(**kw).fit(df)
+    assert calls, "contraction-gather path was not traced"
+
+    np.testing.assert_array_equal(
+        m_contract._features_arr, m_gather._features_arr
+    )
+    np.testing.assert_allclose(
+        m_contract._thresholds_arr, m_gather._thresholds_arr
+    )
+    np.testing.assert_allclose(
+        m_contract._leaf_stats_arr, m_gather._leaf_stats_arr
+    )
