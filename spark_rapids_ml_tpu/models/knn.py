@@ -30,7 +30,7 @@ from ..core import _TpuEstimator, _TpuModel
 from ..data.dataframe import DataFrame
 from ..params import Params, TypeConverters, _TpuParams, _mk
 from ..parallel.mesh import make_mesh, shard_rows
-from ..ops.knn_kernels import ring_knn
+from ..ops.knn_kernels import resolve_knn_topk, ring_knn
 from ..utils.logging import get_logger
 
 _DEFAULT_ID_COL = "unique_id"
@@ -234,7 +234,10 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
         else:
             ids_d, _ = shard_rows(np.arange(n_item_rows, dtype=np.int32), mesh)
 
-        d2, idx = ring_knn(Xq_d, Xi_d, mi_d, ids_d, mesh=mesh, k=k)
+        d2, idx = ring_knn(
+            Xq_d, Xi_d, mi_d, ids_d, mesh=mesh, k=k,
+            topk_impl=resolve_knn_topk(),
+        )
         nq = Xq.shape[0]
         if nproc > 1:
             # this rank's query rows live in its own addressable shards —

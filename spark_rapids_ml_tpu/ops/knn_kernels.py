@@ -38,7 +38,39 @@ _Q_CHUNK = 8192
 _I_CHUNK = 32768
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "k"))
+def resolve_knn_topk() -> str:
+    """Validated tile top-k implementation from TPUML_KNN_TOPK: "auto"
+    (TPU: partial-reduce; else sort), "sort", or "partial". Resolved by
+    CALLERS outside jit and passed as a static arg — an env read inside
+    the traced function would be silently ignored on jit cache hits."""
+    import os
+
+    mode = os.environ.get("TPUML_KNN_TOPK", "auto")
+    if mode not in ("auto", "sort", "partial"):
+        raise ValueError(f"TPUML_KNN_TOPK must be auto|sort|partial, got {mode!r}")
+    return mode
+
+
+def _tile_top_k(neg_d2: jax.Array, k: int, topk_impl: str):
+    """Top-k over a wide distance tile.
+
+    On TPU ("auto"/"partial") this routes through ``lax.approx_max_k``
+    with ``recall_target=1.0`` — the hardware PartialReduce op. At recall
+    1.0 the partial-reduce shrink is disabled, making the result EXACT
+    (the approximation bound collapses; verified on-chip: full distance +
+    id agreement with ``lax.top_k`` at the bench shape, where recall 0.95
+    measurably is not exact).
+    """
+    use_partial = (
+        topk_impl == "partial"
+        or (topk_impl == "auto" and jax.default_backend() == "tpu")
+    )
+    if use_partial:
+        return lax.approx_max_k(neg_d2, k, recall_target=1.0)
+    return lax.top_k(neg_d2, k)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "topk_impl"))
 def ring_knn(
     Xq: jax.Array,     # (Nq_pad, d) queries, dp-sharded
     Xi: jax.Array,     # (Ni_pad, d) items, dp-sharded
@@ -47,15 +79,64 @@ def ring_knn(
     *,
     mesh: Mesh,
     k: int,
+    topk_impl: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (distances (Nq_pad, k) ascending squared-euclidean,
-    indices (Nq_pad, k) global item row ids)."""
+    indices (Nq_pad, k) global item row ids). ``topk_impl`` should come
+    from :func:`resolve_knn_topk` (static: participates in the jit key)."""
     n_dev = mesh.shape[DP_AXIS]
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
+    def _rotate(Xi_cur, mi_cur, idi_cur):
+        """One ring rotation — the single definition both the Pallas and
+        XLA branches use, so permutation semantics cannot diverge."""
+        return (
+            lax.ppermute(Xi_cur, DP_AXIS, perm),
+            lax.ppermute(mi_cur, DP_AXIS, perm),
+            lax.ppermute(idi_cur, DP_AXIS, perm),
+        )
+
     def per_device(Xq_l, Xi_l, mi_l, idi_l):
+        from .knn_pallas import _QB, _IB, knn_pallas_ok, knn_pallas_pass
+
         nq = Xq_l.shape[0]
         ni = Xi_l.shape[0]
+        d = Xq_l.shape[1]
+
+        # fused Pallas path: pad shapes to the kernel's block multiples
+        # (padded queries are sliced off; padded items ride with +inf
+        # score via csq_eff and can never be selected)
+        nq_p = -(-nq // _QB) * _QB
+        ni_p = -(-ni // _IB) * _IB
+        if knn_pallas_ok(nq_p, ni_p, d, k, Xq_l.dtype):
+            Xq_p = jnp.pad(Xq_l, ((0, nq_p - nq), (0, 0)))
+            Xi_p = jnp.pad(Xi_l, ((0, ni_p - ni), (0, 0)))
+            mi_p = jnp.pad(mi_l, ((0, ni_p - ni),))
+            idi_p = jnp.pad(idi_l, ((0, ni_p - ni),))
+            x_sq = (Xq_p * Xq_p).sum(axis=1)
+
+            def pstep(state, _):
+                Xi_cur, mi_cur, idi_cur, td, ti = state
+                csq = (Xi_cur * Xi_cur).sum(axis=1)
+                csq_eff = jnp.where(mi_cur > 0, csq, jnp.inf)[None, :]
+                td, ti = knn_pallas_pass(
+                    Xq_p, Xi_cur, csq_eff, idi_cur[None, :], td, ti
+                )
+                Xi_cur, mi_cur, idi_cur = _rotate(Xi_cur, mi_cur, idi_cur)
+                return (Xi_cur, mi_cur, idi_cur, td, ti), None
+
+            td0 = jnp.full((nq_p, k), jnp.inf, Xq_l.dtype)
+            ti0 = jnp.full((nq_p, k), -1, jnp.int32)
+            (_, _, _, td, ti), _ = lax.scan(
+                pstep, (Xi_p, mi_p, idi_p, td0, ti0), None, length=n_dev
+            )
+            # restore the row-constant ||xq||^2 term and emit ascending
+            d2 = jnp.maximum(td + x_sq[:, None], 0.0)
+            negd, order = lax.top_k(-d2, k)
+            return (
+                (-negd)[:nq],
+                jnp.take_along_axis(ti, order, axis=1)[:nq],
+            )
         # pad the local query shard to a chunk multiple so the scan below
         # always engages; padded query rows are sliced off at the end
         # (their results are garbage but harmless)
@@ -87,15 +168,32 @@ def ring_knn(
                     xi, mi_b, idi_b = blk
                     d2 = pairwise_sq_dists(xq, xi)
                     d2 = jnp.where(mi_b[None, :] > 0, d2, jnp.inf)
-                    cat_d = jnp.concatenate([bd_c, d2], axis=1)
-                    cat_i = jnp.concatenate(
-                        [bi_c, jnp.broadcast_to(idi_b[None, :], d2.shape)],
-                        axis=1,
-                    )
-                    negd, sel = lax.top_k(-cat_d, k)
+                    # top-k the raw tile, THEN merge with the carry at
+                    # width 2k. Concatenating the (qc, ic) tile with the
+                    # carry first costs two extra full-tile HBM
+                    # materializations per block (the cat_d copy and the
+                    # broadcast ids plane) — at 131k x 1M that is ~1 TB of
+                    # avoidable traffic per kneighbors call.
+                    w = d2.shape[1]
+                    if w < k:
+                        # shard narrower than k (tiny item sets over many
+                        # devices): pad with +inf/-1 so top_k stays legal
+                        # and unfilled slots keep the inf/-1 convention
+                        d2 = jnp.pad(
+                            d2, ((0, 0), (0, k - w)),
+                            constant_values=jnp.inf,
+                        )
+                        idi_b = jnp.pad(
+                            idi_b, (0, k - w), constant_values=-1
+                        )
+                    negd, sel = _tile_top_k(-d2, k, topk_impl)  # (qc, k)
+                    blk_ids = idi_b[sel]                     # (qc, k) global
+                    cat_d = jnp.concatenate([bd_c, -negd], axis=1)
+                    cat_i = jnp.concatenate([bi_c, blk_ids], axis=1)
+                    negm, selm = lax.top_k(-cat_d, k)
                     return (
-                        -negd,
-                        jnp.take_along_axis(cat_i, sel, axis=1),
+                        -negm,
+                        jnp.take_along_axis(cat_i, selm, axis=1),
                     ), None
 
                 (bd_c, bi_c), _ = lax.scan(
@@ -110,9 +208,7 @@ def ring_knn(
                 return None, (bd_c, bi_c)
 
             _, (bd, bi) = lax.scan(body, None, (Xq_c, bd, bi))
-            Xi_cur = lax.ppermute(Xi_cur, DP_AXIS, perm)
-            mi_cur = lax.ppermute(mi_cur, DP_AXIS, perm)
-            idi_cur = lax.ppermute(idi_cur, DP_AXIS, perm)
+            Xi_cur, mi_cur, idi_cur = _rotate(Xi_cur, mi_cur, idi_cur)
             return (Xi_cur, mi_cur, idi_cur, bd, bi), None
 
         (_, _, _, bd, bi), _ = lax.scan(

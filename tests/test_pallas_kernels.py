@@ -327,3 +327,53 @@ def test_kmeans_fit_pallas_branch_matches_xla(monkeypatch):
         np.sort(np.asarray(m_xla.clusterCenters()), axis=0),
         rtol=1e-5, atol=1e-5,
     )
+
+
+class TestKnnPallas:
+    def test_fused_pass_matches_xla_ring(self):
+        """The fused Pallas distance+top-k pass (interpret mode) must agree
+        with the XLA tile path on distances and ids, including item padding
+        (ni not a block multiple) and query padding."""
+        import spark_rapids_ml_tpu.ops.knn_pallas as kp
+        import spark_rapids_ml_tpu.ops.knn_kernels as kk
+        from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(5)
+        nq, ni, d, k = 96, 600, 128, 8
+        Xq = jnp.asarray(rng.standard_normal((nq, d)), jnp.float32)
+        Xi = jnp.asarray(rng.standard_normal((ni, d)), jnp.float32)
+        mi = jnp.ones((ni,), jnp.float32).at[-7:].set(0.0)  # masked tail
+        ids = jnp.arange(ni, dtype=jnp.int32) * 3 + 1
+
+        d_ref, i_ref = jax.tree.map(
+            np.asarray, kk.ring_knn(Xq, Xi, mi, ids, mesh=mesh, k=k)
+        )
+        kp.FORCE_INTERPRET = True
+        calls = []
+        real_pass = kp.knn_pallas_pass
+        try:
+            # fresh jit so the pallas gate re-evaluates; spy proves the
+            # fused path was actually traced (not a cache/gate miss)
+            import functools
+
+            def spy(*a, **kw):
+                calls.append(1)
+                return real_pass(*a, **kw)
+
+            kp.knn_pallas_pass = spy
+            fresh = jax.jit(
+                functools.partial(kk.ring_knn.__wrapped__, mesh=mesh, k=k)
+            )
+            d_pal, i_pal = jax.tree.map(np.asarray, fresh(Xq, Xi, mi, ids))
+        finally:
+            kp.FORCE_INTERPRET = False
+            kp.knn_pallas_pass = real_pass
+        assert calls, "fused Pallas kNN pass was not traced"
+
+        np.testing.assert_allclose(d_pal, d_ref, rtol=1e-5, atol=1e-5)
+        # ids may differ only where distances tie; none expected here
+        np.testing.assert_array_equal(i_pal, i_ref)
+        # masked items never appear
+        masked_ids = set(np.asarray(ids[-7:]).tolist())
+        assert not (set(i_pal.ravel().tolist()) & masked_ids)
