@@ -44,44 +44,26 @@ FORCE_INTERPRET = False
 _TILE = 2048
 
 
-# Hardware-lowering probe results, keyed by the lowering-relevant config
-# (d, k_pad, matmul_dtype). Interpret-mode tests exercise the kernel BODY
-# but not Mosaic lowering: round 3 shipped a scalar VMEM store that traced
-# and interpreted fine yet failed only on the real chip, dropping KMeans
-# from the bench capture entirely. Before the first real use of a config on
-# a TPU backend, a one-tile instance with the caller's actual d/k/dtype is
-# compiled; if Mosaic rejects it, that caller falls back to the XLA chunked
-# path instead of crashing the fit. (n does not affect lowering — it only
-# changes the grid length — so one tile suffices.)
+# Hardware-lowering probe results keyed by (d, k_pad, matmul_dtype); the
+# policy lives in ops.linalg.probe_pallas_lowering. (n does not affect
+# lowering — it only changes the grid length — so one tile suffices.)
 _LOWERING_OK: dict = {}
 
 
 def _probe_lowering(d: int, k: int, matmul_dtype) -> bool:
-    key = (d, -(-k // 128) * 128, jnp.dtype(matmul_dtype).name if matmul_dtype else None)
-    if key not in _LOWERING_OK:
-        try:
-            # avals only — the probe may run while an outer fit is tracing,
-            # so no device buffers and nothing the outer trace could capture
-            x = jax.ShapeDtypeStruct((_TILE, d), jnp.float32)
-            m = jax.ShapeDtypeStruct((_TILE,), jnp.float32)
-            c = jax.ShapeDtypeStruct((k, d), jnp.float32)
-            lloyd_step_pallas.lower(x, m, c, matmul_dtype=matmul_dtype).compile()
-            _LOWERING_OK[key] = True
-        except Exception as e:
-            import logging
+    from .linalg import probe_pallas_lowering
 
-            logging.getLogger(__name__).warning(
-                "fused Lloyd Pallas kernel failed to lower for config %s; "
-                "falling back to the XLA chunked step: %s", key, e
-            )
-            # permanently cache only genuine Mosaic rejections; a transient
-            # backend failure (RPC hiccup, HBM pressure) must not pin the
-            # process to the slower XLA path forever
-            msg = str(e)
-            if "Mosaic" in msg or "Not implemented" in msg:
-                _LOWERING_OK[key] = False
-            return False
-    return _LOWERING_OK[key]
+    key = (d, -(-k // 128) * 128, jnp.dtype(matmul_dtype).name if matmul_dtype else None)
+
+    def compile_fn():
+        # avals only — the probe may run while an outer fit is tracing,
+        # so no device buffers and nothing the outer trace could capture
+        x = jax.ShapeDtypeStruct((_TILE, d), jnp.float32)
+        m = jax.ShapeDtypeStruct((_TILE,), jnp.float32)
+        c = jax.ShapeDtypeStruct((k, d), jnp.float32)
+        lloyd_step_pallas.lower(x, m, c, matmul_dtype=matmul_dtype).compile()
+
+    return probe_pallas_lowering(_LOWERING_OK, key, compile_fn, "fused Lloyd")
 
 
 def kmeans_pallas_ok(n_local: int, d: int, k: int, dtype, matmul_dtype=None) -> bool:

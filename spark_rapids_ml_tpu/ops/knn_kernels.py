@@ -105,30 +105,41 @@ def ring_knn(
 
         # fused Pallas path: pad shapes to the kernel's block multiples
         # (padded queries are sliced off; padded items ride with +inf
-        # score via csq_eff and can never be selected)
+        # score via csq_eff and can never be selected). topk_impl="sort"
+        # is the validated escape hatch: it must route around the fused
+        # kernel too, not just the tile top-k.
+        from .knn_pallas import FORCE_INTERPRET as _KNN_INTERPRET
+
         nq_p = -(-nq // _QB) * _QB
         ni_p = -(-ni // _IB) * _IB
-        if knn_pallas_ok(nq_p, ni_p, d, k, Xq_l.dtype):
+        if topk_impl != "sort" and knn_pallas_ok(
+            nq_p, ni_p, d, k, Xq_l.dtype
+        ):
             Xq_p = jnp.pad(Xq_l, ((0, nq_p - nq), (0, 0)))
             Xi_p = jnp.pad(Xi_l, ((0, ni_p - ni), (0, 0)))
             mi_p = jnp.pad(mi_l, ((0, ni_p - ni),))
             idi_p = jnp.pad(idi_l, ((0, ni_p - ni),))
             x_sq = (Xq_p * Xq_p).sum(axis=1)
+            # ||xi||^2 with the mask folded in, computed ONCE: the small
+            # (ni,) vector rotates with the shard instead of re-reading
+            # the (ni, d) matrix every ring step
+            csq0 = jnp.where(
+                mi_p > 0, (Xi_p * Xi_p).sum(axis=1), jnp.inf
+            )
 
             def pstep(state, _):
-                Xi_cur, mi_cur, idi_cur, td, ti = state
-                csq = (Xi_cur * Xi_cur).sum(axis=1)
-                csq_eff = jnp.where(mi_cur > 0, csq, jnp.inf)[None, :]
+                Xi_cur, csq_cur, idi_cur, td, ti = state
                 td, ti = knn_pallas_pass(
-                    Xq_p, Xi_cur, csq_eff, idi_cur[None, :], td, ti
+                    Xq_p, Xi_cur, csq_cur[None, :], idi_cur[None, :],
+                    td, ti, interpret=_KNN_INTERPRET or None,
                 )
-                Xi_cur, mi_cur, idi_cur = _rotate(Xi_cur, mi_cur, idi_cur)
-                return (Xi_cur, mi_cur, idi_cur, td, ti), None
+                Xi_cur, csq_cur, idi_cur = _rotate(Xi_cur, csq_cur, idi_cur)
+                return (Xi_cur, csq_cur, idi_cur, td, ti), None
 
             td0 = jnp.full((nq_p, k), jnp.inf, Xq_l.dtype)
             ti0 = jnp.full((nq_p, k), -1, jnp.int32)
             (_, _, _, td, ti), _ = lax.scan(
-                pstep, (Xi_p, mi_p, idi_p, td0, ti0), None, length=n_dev
+                pstep, (Xi_p, csq0, idi_p, td0, ti0), None, length=n_dev
             )
             # restore the row-constant ||xq||^2 term and emit ascending
             d2 = jnp.maximum(td + x_sq[:, None], 0.0)

@@ -40,37 +40,26 @@ _QB = 256   # query rows per block: (QB, IB) f32 score block = 512 KB VMEM
 _IB = 512   # item cols per block
 
 
-# Hardware-lowering probe results per (d, k) — interpret-mode tests cannot
-# catch Mosaic rejections (round-3 lesson from the Lloyd kernel).
+# Hardware-lowering probe results per (d, k); the probe policy lives in
+# ops.linalg.probe_pallas_lowering.
 _LOWERING_OK: dict = {}
 
 
 def _probe_lowering(d: int, k: int) -> bool:
-    key = (d, k)
-    if key not in _LOWERING_OK:
-        try:
-            args = (
-                jax.ShapeDtypeStruct((_QB, d), jnp.float32),
-                jax.ShapeDtypeStruct((_IB, d), jnp.float32),
-                jax.ShapeDtypeStruct((1, _IB), jnp.float32),
-                jax.ShapeDtypeStruct((1, _IB), jnp.int32),
-                jax.ShapeDtypeStruct((_QB, k), jnp.float32),
-                jax.ShapeDtypeStruct((_QB, k), jnp.int32),
-            )
-            knn_pallas_pass.lower(*args).compile()
-            _LOWERING_OK[key] = True
-        except Exception as e:
-            import logging
+    from .linalg import probe_pallas_lowering
 
-            logging.getLogger(__name__).warning(
-                "fused kNN Pallas pass failed to lower for config %s; "
-                "falling back to the XLA tile path: %s", key, e
-            )
-            msg = str(e)
-            if "Mosaic" in msg or "Not implemented" in msg:
-                _LOWERING_OK[key] = False
-            return False
-    return _LOWERING_OK[key]
+    def compile_fn():
+        args = (
+            jax.ShapeDtypeStruct((_QB, d), jnp.float32),
+            jax.ShapeDtypeStruct((_IB, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, _IB), jnp.float32),
+            jax.ShapeDtypeStruct((1, _IB), jnp.int32),
+            jax.ShapeDtypeStruct((_QB, k), jnp.float32),
+            jax.ShapeDtypeStruct((_QB, k), jnp.int32),
+        )
+        knn_pallas_pass.lower(*args).compile()
+
+    return probe_pallas_lowering(_LOWERING_OK, (d, k), compile_fn, "fused kNN")
 
 
 def knn_pallas_ok(nq: int, ni: int, d: int, k: int, dtype) -> bool:

