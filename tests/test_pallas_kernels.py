@@ -377,3 +377,42 @@ class TestKnnPallas:
         # masked items never appear
         masked_ids = set(np.asarray(ids[-7:]).tolist())
         assert not (set(i_pal.ravel().tolist()) & masked_ids)
+
+    def test_sort_impl_routes_around_fused_kernel(self):
+        """TPUML_KNN_TOPK=sort is the validated escape hatch: it must
+        bypass the fused Pallas pass entirely, not just the tile top-k."""
+        import functools
+
+        import spark_rapids_ml_tpu.ops.knn_kernels as kk
+        import spark_rapids_ml_tpu.ops.knn_pallas as kp
+        from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(9)
+        nq, ni, d, k = 64, 256, 128, 4
+        Xq = jnp.asarray(rng.standard_normal((nq, d)), jnp.float32)
+        Xi = jnp.asarray(rng.standard_normal((ni, d)), jnp.float32)
+        mi = jnp.ones((ni,), jnp.float32)
+        ids = jnp.arange(ni, dtype=jnp.int32)
+
+        kp.FORCE_INTERPRET = True  # pallas gate would otherwise pass
+        calls = []
+        real_pass = kp.knn_pallas_pass
+        try:
+            kp.knn_pallas_pass = lambda *a, **kw: calls.append(1) or real_pass(
+                *a, **kw
+            )
+            fresh = jax.jit(
+                functools.partial(
+                    kk.ring_knn.__wrapped__, mesh=mesh, k=k, topk_impl="sort"
+                )
+            )
+            d_s, i_s = jax.tree.map(np.asarray, fresh(Xq, Xi, mi, ids))
+        finally:
+            kp.FORCE_INTERPRET = False
+            kp.knn_pallas_pass = real_pass
+        assert not calls, "sort impl must not trace the fused Pallas pass"
+        # and it still returns correct neighbors
+        d2 = ((np.asarray(Xq)[:, None, :] - np.asarray(Xi)[None, :, :]) ** 2).sum(-1)
+        oracle = np.sort(d2, axis=1)[:, :k]
+        np.testing.assert_allclose(np.asarray(d_s), oracle, rtol=1e-4, atol=1e-4)
