@@ -36,6 +36,75 @@ from ..parallel.mesh import row_sharding
 # Chunk transfer
 # ---------------------------------------------------------------------------
 
+# host-side backpressure period for streaming loops (chunks between syncs);
+# 0 disables
+import os as _os
+
+_SYNC_EVERY = int(_os.environ.get("TPUML_STREAM_SYNC_EVERY", "4"))
+
+
+class StreamGuard:
+    """Bounds host (and device) memory of a streaming loop.
+
+    ``device_put`` transfers are async and a host decodes parquet chunks
+    far faster than a tunnel-attached device drains them; with nothing in
+    the loop ever synchronizing, pending transfers pin every chunk's host
+    buffer (observed: a 100M-row north-star run was OOM-killed on the HOST
+    at 130 GB RSS mid-pass). On the tunnel backend, dropping the Python
+    references is not enough: the client retains a host-side copy of a
+    transferred buffer until that EXACT buffer is deleted — deleting only
+    an array derived from it (e.g. the on-device f32 upcast of an f16 wire
+    chunk) releases nothing (observed: RSS kept growing at the ingest rate
+    when only derived arrays were deleted). ``put_chunk`` therefore hands
+    the guard the raw transferred arrays under ``"_wire"``.
+
+    Every ``_SYNC_EVERY`` chunks — and at :meth:`flush`, which every loop
+    MUST call at the end (short passes would otherwise never sync at all)
+    — the guard (1) host-fetches one accumulator scalar: the accumulator
+    depends on every chunk folded so far, so the fetch PROVES all enqueued
+    transfers and steps completed (``jax.block_until_ready`` is NOT
+    sufficient on remote backends — it can return at dispatch
+    acknowledgment, see docs/tpu_kernel_notes.md); then (2) ``delete()``s
+    the retired chunk arrays, releasing device buffers and the client's
+    host copies.
+
+    The guard holds strong references to up to ``_SYNC_EVERY`` chunks of
+    device buffers between syncs (they are freed only once proven
+    retired), so the streaming device footprint is ``_SYNC_EVERY`` chunk
+    slabs, not one — sized into the default period below.
+    """
+
+    def __init__(self) -> None:
+        self._pending: list = []
+        self._i = 0
+
+    def _sync_and_release(self, acc) -> None:
+        leaf = jax.tree_util.tree_leaves(acc)[0]
+        np.asarray(jnp.ravel(leaf)[:1])
+        for a in self._pending:
+            try:
+                a.delete()
+            except Exception:
+                pass
+        self._pending.clear()
+
+    def tick(self, dev, acc) -> None:
+        for v in dev.values():
+            if v is None:
+                continue
+            if isinstance(v, (list, tuple)):
+                self._pending.extend(v)
+            else:
+                self._pending.append(v)
+        self._i += 1
+        if _SYNC_EVERY > 0 and self._i % _SYNC_EVERY == 0:
+            self._sync_and_release(acc)
+
+    def flush(self, acc) -> None:
+        """Sync + release the tail; call after every streaming loop."""
+        if self._pending:
+            self._sync_and_release(acc)
+
 
 def put_chunk(chunk: Chunk, mesh, dtype) -> Dict[str, Optional[jax.Array]]:
     """device_put one host chunk row-sharded over dp.  Transfers are async:
@@ -47,8 +116,13 @@ def put_chunk(chunk: Chunk, mesh, dtype) -> Dict[str, Optional[jax.Array]]:
     interconnect (PCIe, or the remote tunnel's ~30 MB/s)."""
     sh = row_sharding(mesh)
     x_host = np.asarray(chunk.X)
+    wire = None
     if x_host.dtype.kind == "f" and x_host.dtype.itemsize < np.dtype(dtype).itemsize:
-        X = jnp.asarray(jax.device_put(x_host, sh), dtype=dtype)
+        # the narrow array below is the buffer the client ACTUALLY
+        # transferred (and retains a host copy of); it rides along under
+        # "_wire" so StreamGuard deletes IT, not just the derived upcast
+        wire = jax.device_put(x_host, sh)
+        X = jnp.asarray(wire, dtype=dtype)
     else:
         X = jax.device_put(np.asarray(x_host, dtype=dtype), sh)
     out: Dict[str, Optional[jax.Array]] = {
@@ -56,6 +130,7 @@ def put_chunk(chunk: Chunk, mesh, dtype) -> Dict[str, Optional[jax.Array]]:
         "mask": jax.device_put(chunk.mask(dtype), sh),
         "y": None,
         "w": None,
+        "_wire": wire,
     }
     if chunk.y is not None:
         out["y"] = jax.device_put(np.asarray(chunk.y, dtype=dtype), sh)
@@ -273,10 +348,13 @@ def streamed_suffstats(
     np_dtype = np.dtype(jnp.dtype(dtype).name)
 
     acc1 = moments1_init(d, dtype, with_y)
+    guard = StreamGuard()
     for chunk in source.iter_chunks(chunk_rows, np_dtype):
         dev = put_chunk(chunk, mesh, dtype)
         rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
         acc1 = moments1_step(acc1, dev["X"], rw, dev["y"] if with_y else None)
+        guard.tick(dev, acc1)
+    guard.flush(acc1)
     # cross-process allreduce of the first-moment partials (the NCCL
     # allreduce analog; identity single-process)
     if with_y:
@@ -294,6 +372,7 @@ def streamed_suffstats(
         mean_y = jnp.zeros((), dtype) if with_y else None
 
     acc2 = gram2_init(d, dtype, with_y)
+    guard = StreamGuard()
     for chunk in source.iter_chunks(chunk_rows, np_dtype):
         dev = put_chunk(chunk, mesh, dtype)
         rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
@@ -301,6 +380,8 @@ def streamed_suffstats(
             acc2, dev["X"], rw, mean_x,
             dev["y"] if with_y else None, mean_y,
         )
+        guard.tick(dev, acc2)
+    guard.flush(acc2)
     if with_y:
         G_h, Xy_h, yy_h = allreduce_sum_host(acc2["G"], acc2["Xy"], acc2["yy"])
     else:
@@ -362,9 +443,12 @@ def streamed_logreg_fit(
 
     # pass 1: n + feature means (partials allreduced across processes)
     acc1 = moments1_init(d, dtype, with_y=False)
+    guard = StreamGuard()
     for chunk in source.iter_chunks(chunk_rows, np_dtype):
         dev = put_chunk(chunk, mesh, dtype)
         acc1 = moments1_step(acc1, dev["X"], dev["mask"])
+        guard.tick(dev, acc1)
+    guard.flush(acc1)
     n_h, sx_h = allreduce_sum_host(acc1["n"], acc1["sum_x"])
     n = float(n_h)
     mean = jnp.asarray(sx_h, dtype) / jnp.asarray(n, dtype)
@@ -373,9 +457,12 @@ def streamed_logreg_fit(
         # pass 2: diagonal second moment -> unbiased variance (n-1), the
         # reference's denominator (``classification.py:1024-1026``)
         vacc = jnp.zeros((d,), dtype)
+        guard = StreamGuard()
         for chunk in source.iter_chunks(chunk_rows, np_dtype):
             dev = put_chunk(chunk, mesh, dtype)
             vacc = var_chunk_step(vacc, dev["X"], dev["mask"], mean)
+            guard.tick(dev, vacc)
+        guard.flush(vacc)
         (vacc_h,) = allreduce_sum_host(vacc)
         var = jnp.asarray(vacc_h, dtype) / max(n - 1.0, 1.0)
         std = jnp.sqrt(jnp.maximum(var, 0.0))
@@ -393,6 +480,7 @@ def streamed_logreg_fit(
     def value_grad(w_np):
         wd = jnp.asarray(w_np, dtype)
         acc = {"f": jnp.zeros((), dtype), "g": jnp.zeros((p,), dtype)}
+        guard = StreamGuard()
         for chunk in source.iter_chunks(chunk_rows, np_dtype):
             dev = put_chunk(chunk, mesh, dtype)
             acc = logreg_chunk_vg_step(
@@ -400,6 +488,8 @@ def streamed_logreg_fit(
                 n_classes=n_classes, multinomial=multinomial,
                 fit_intercept=fit_intercept, use_center=use_center,
             )
+            guard.tick(dev, acc)
+        guard.flush(acc)
         # per-evaluation allreduce of (loss, grad) partials — the QN-loop
         # NCCL allreduce of the reference's distributed L-BFGS; every rank
         # then takes identical optimizer steps
@@ -465,9 +555,12 @@ def streamed_kmeans_lloyd(
             "counts": jnp.zeros((k,), jnp.int32),
             "cost": jnp.zeros((), dtype),
         }
+        guard = StreamGuard()
         for chunk in source.iter_chunks(chunk_rows, np_dtype):
             dev = put_chunk(chunk, mesh, dtype)
             acc = kmeans_chunk_step(acc, dev["X"], dev["mask"], cts, matmul_dtype=mm)
+            guard.tick(dev, acc)
+        guard.flush(acc)
         # per-iteration allreduce of (sums, counts, cost) partials — the
         # Lloyd-loop NCCL allreduce; every rank then updates identically
         s_h, c_h, cost_h = allreduce_sum_host(
@@ -598,6 +691,15 @@ def streamed_min_sq_dists_update(
         d2 = np.asarray(
             chunk_min_sq_dists(dev["X"], dev["mask"], cands_dev), np.float64
         )
+        # the d2 fetch above proves the step completed; release the
+        # chunk's buffers including the raw wire transfer (StreamGuard
+        # rationale — retention otherwise grows with total bytes shipped)
+        for a in dev.values():
+            if a is not None:
+                try:
+                    a.delete()
+                except Exception:
+                    pass
         nv = chunk.n_valid
         np.minimum(out[offset : offset + nv], d2[:nv], out=out[offset : offset + nv])
         offset += nv
@@ -612,7 +714,10 @@ def streamed_count_closest(
     cands_dev = jnp.asarray(cands, dtype)
     counts = jnp.zeros((cands.shape[0],), jnp.int32)
     np_dtype = np.dtype(jnp.dtype(dtype).name)
+    guard = StreamGuard()
     for chunk in source.iter_chunks(chunk_rows, np_dtype):
         dev = put_chunk(chunk, mesh, dtype)
         counts = count_closest_chunk_step(counts, dev["X"], dev["mask"], cands_dev)
+        guard.tick(dev, counts)
+    guard.flush(counts)
     return np.asarray(counts, np.float64)
