@@ -575,3 +575,58 @@ def test_shadowed_disk_column_not_streamed(tmp_path):
     np.testing.assert_allclose(
         m.explained_variance_, res.explained_variance_, rtol=1e-4
     )
+
+
+class TestStreamGuard:
+    def test_put_chunk_exposes_wire_buffer_for_narrow_dtype(self):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.data.chunks import Chunk
+        from spark_rapids_ml_tpu.ops.streaming import put_chunk
+        from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(8)
+        X16 = np.ones((16, 8), np.float16)
+        dev = put_chunk(Chunk(X=X16, n_valid=16), mesh, jnp.float32)
+        assert dev["_wire"] is not None  # the actually-transferred array
+        assert dev["_wire"].dtype == jnp.float16
+        assert dev["X"].dtype == jnp.float32
+        dev32 = put_chunk(
+            Chunk(X=X16.astype(np.float32), n_valid=16), mesh, jnp.float32
+        )
+        assert dev32["_wire"] is None  # no separate wire buffer to track
+
+    def test_guard_flush_releases_all_pending_buffers(self):
+        import jax.numpy as jnp
+
+        import spark_rapids_ml_tpu.ops.streaming as st
+        from spark_rapids_ml_tpu.data.chunks import Chunk
+        from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(8)
+        guard = st.StreamGuard()
+        acc = {"n": jnp.zeros(())}
+        devs = []
+        # fewer chunks than the sync period: only flush() can release
+        # these (pin the period so a TPUML_STREAM_SYNC_EVERY override in
+        # the environment cannot make tick() sync early)
+        monkeypatch = pytest.MonkeyPatch()
+        monkeypatch.setattr(st, "_SYNC_EVERY", 4)
+        for i in range(3):
+            dev = st.put_chunk(
+                Chunk(X=np.ones((16, 8), np.float16), n_valid=16),
+                mesh, jnp.float32,
+            )
+            acc = {"n": acc["n"] + dev["X"].sum()}
+            guard.tick(dev, acc)
+            devs.append(dev)
+        assert guard._pending, "tail chunks must be pending before flush"
+        guard.flush(acc)
+        assert not guard._pending
+        for dev in devs:
+            for a in dev.values():
+                if a is not None:
+                    assert a.is_deleted()
+        # accumulator itself must remain usable
+        assert float(acc["n"]) == len(devs) * 16 * 8
+        monkeypatch.undo()
