@@ -75,6 +75,7 @@ def _worker(args) -> None:
             dev = put_chunk(chunk, mesh, dtype)
             acc = moments1_step(acc, dev["X"], dev["mask"], dev["y"])
             guard.tick(dev, acc)
+        guard.flush(acc)
         np.savez(
             args.out,
             n=np.asarray(acc["n"], np.float64),
@@ -91,6 +92,7 @@ def _worker(args) -> None:
             dev = put_chunk(chunk, mesh, dtype)
             acc = gram2_step(acc, dev["X"], dev["mask"], mean_x, dev["y"], mean_y)
             guard.tick(dev, acc)
+        guard.flush(acc)
         np.savez(
             args.out,
             G=np.asarray(acc["G"], np.float64),

@@ -20,11 +20,25 @@ import numpy as np
 
 
 def log_loss(labels: np.ndarray, probs: np.ndarray, eps: float) -> float:
-    """Sum of -log(p[label]) with probabilities clamped at ``eps``."""
-    if np.any(labels < 0) or np.any(labels > probs.shape[1] - 1):
-        raise ValueError(f"labels must be in the range [0,{probs.shape[1] - 1}]")
+    """Sum of -log(p[label]) with probabilities clamped at ``eps``.
+
+    Validation semantics follow Spark's logLoss contract (same checks the
+    reference performs, ``MulticlassMetrics.py:24-31``): labels within the
+    class range, probabilities within [0, 1]. Labels are read as class
+    indices via int truncation; integrality itself is not checked (nor
+    does the reference check it).
+    """
+    n_classes = probs.shape[1]
+    if np.any(labels < 0) or np.any(labels > n_classes - 1):
+        raise ValueError(
+            f"log_loss: label out of range — every label must lie in "
+            f"[0, {n_classes - 1}] for {n_classes}-column probabilities"
+        )
     if np.any(probs < 0) or np.any(probs > 1.0):
-        raise ValueError("probs must be in the range [0.0, 1.0]")
+        raise ValueError(
+            "log_loss: probability out of range — every entry of probs "
+            "must lie in [0.0, 1.0]"
+        )
     p = probs[np.arange(probs.shape[0]), labels.astype(np.int32)]
     return float(-np.log(np.maximum(p, eps)).sum())
 

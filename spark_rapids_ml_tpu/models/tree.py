@@ -46,7 +46,7 @@ from ..params import (
 from ..parallel.mesh import DP_AXIS
 from ..ops.tree_kernels import (
     resolve_contract_gather,
-        resolve_hist_strategy,
+    resolve_hist_strategy,
     ForestConfig,
     binize,
     build_forest,

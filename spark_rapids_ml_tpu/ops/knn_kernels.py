@@ -39,10 +39,15 @@ _I_CHUNK = 32768
 
 
 def resolve_knn_topk() -> str:
-    """Validated tile top-k implementation from TPUML_KNN_TOPK: "auto"
-    (TPU: partial-reduce; else sort), "sort", or "partial". Resolved by
-    CALLERS outside jit and passed as a static arg — an env read inside
-    the traced function would be silently ignored on jit cache hits."""
+    """Validated tile top-k implementation from TPUML_KNN_TOPK. The three
+    values select three distinct paths on TPU: "auto" = fused Pallas
+    distance+top-k kernel when eligible, else the partial-reduce tile
+    path; "partial" = force the XLA tile path with ``lax.approx_max_k``
+    (routes AROUND the fused kernel — the debugging escape hatch for the
+    Pallas path specifically); "sort" = force the XLA tile path with full
+    ``lax.top_k`` (no PartialReduce at all). Resolved by CALLERS outside
+    jit and passed as a static arg — an env read inside the traced
+    function would be silently ignored on jit cache hits."""
     import os
 
     mode = os.environ.get("TPUML_KNN_TOPK", "auto")
@@ -105,14 +110,16 @@ def ring_knn(
 
         # fused Pallas path: pad shapes to the kernel's block multiples
         # (padded queries are sliced off; padded items ride with +inf
-        # score via csq_eff and can never be selected). topk_impl="sort"
-        # is the validated escape hatch: it must route around the fused
-        # kernel too, not just the tile top-k.
+        # score via csq_eff and can never be selected). Only "auto"
+        # engages the fused kernel: "sort" and "partial" are the validated
+        # escape hatches that force the XLA tile paths (full top_k /
+        # approx_max_k respectively), so each env value names a distinct
+        # implementation.
         from .knn_pallas import FORCE_INTERPRET as _KNN_INTERPRET
 
         nq_p = -(-nq // _QB) * _QB
         ni_p = -(-ni // _IB) * _IB
-        if topk_impl != "sort" and knn_pallas_ok(
+        if topk_impl == "auto" and knn_pallas_ok(
             nq_p, ni_p, d, k, Xq_l.dtype
         ):
             Xq_p = jnp.pad(Xq_l, ((0, nq_p - nq), (0, 0)))

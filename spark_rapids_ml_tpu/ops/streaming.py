@@ -106,14 +106,24 @@ class StreamGuard:
             self._sync_and_release(acc)
 
 
-def put_chunk(chunk: Chunk, mesh, dtype) -> Dict[str, Optional[jax.Array]]:
+def put_chunk(
+    chunk: Chunk, mesh, dtype, *, need_y: bool = True, need_w: bool = True
+) -> Dict[str, Optional[jax.Array]]:
     """device_put one host chunk row-sharded over dp.  Transfers are async:
     the next chunk's H2D overlaps the current chunk's accumulation step.
 
     Wire dtype: a chunk stored in a float NARROWER than the compute dtype
     (e.g. float16 parquet) ships as-is and upcasts ON DEVICE — halving
     host->device traffic, which is the streaming bottleneck on any
-    interconnect (PCIe, or the remote tunnel's ~30 MB/s)."""
+    interconnect (PCIe, or the remote tunnel's ~30 MB/s).
+
+    ``need_y`` / ``need_w``: callers whose accumulation step does not
+    consume the label / weight column MUST pass False — the column is then
+    never transferred. This both saves wire bytes and preserves the
+    StreamGuard invariant that the accumulator fetch proves every enqueued
+    transfer completed: an array the step never reads would otherwise sit
+    in the guard's pending list with nothing proving its transfer retired
+    before ``delete()``."""
     sh = row_sharding(mesh)
     x_host = np.asarray(chunk.X)
     wire = None
@@ -132,9 +142,9 @@ def put_chunk(chunk: Chunk, mesh, dtype) -> Dict[str, Optional[jax.Array]]:
         "w": None,
         "_wire": wire,
     }
-    if chunk.y is not None:
+    if need_y and chunk.y is not None:
         out["y"] = jax.device_put(np.asarray(chunk.y, dtype=dtype), sh)
-    if chunk.w is not None:
+    if need_w and chunk.w is not None:
         out["w"] = jax.device_put(np.asarray(chunk.w, dtype=dtype), sh)
     return out
 
@@ -350,7 +360,7 @@ def streamed_suffstats(
     acc1 = moments1_init(d, dtype, with_y)
     guard = StreamGuard()
     for chunk in source.iter_chunks(chunk_rows, np_dtype):
-        dev = put_chunk(chunk, mesh, dtype)
+        dev = put_chunk(chunk, mesh, dtype, need_y=with_y)
         rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
         acc1 = moments1_step(acc1, dev["X"], rw, dev["y"] if with_y else None)
         guard.tick(dev, acc1)
@@ -374,7 +384,7 @@ def streamed_suffstats(
     acc2 = gram2_init(d, dtype, with_y)
     guard = StreamGuard()
     for chunk in source.iter_chunks(chunk_rows, np_dtype):
-        dev = put_chunk(chunk, mesh, dtype)
+        dev = put_chunk(chunk, mesh, dtype, need_y=with_y)
         rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
         acc2 = gram2_step(
             acc2, dev["X"], rw, mean_x,
@@ -445,7 +455,7 @@ def streamed_logreg_fit(
     acc1 = moments1_init(d, dtype, with_y=False)
     guard = StreamGuard()
     for chunk in source.iter_chunks(chunk_rows, np_dtype):
-        dev = put_chunk(chunk, mesh, dtype)
+        dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
         acc1 = moments1_step(acc1, dev["X"], dev["mask"])
         guard.tick(dev, acc1)
     guard.flush(acc1)
@@ -459,7 +469,7 @@ def streamed_logreg_fit(
         vacc = jnp.zeros((d,), dtype)
         guard = StreamGuard()
         for chunk in source.iter_chunks(chunk_rows, np_dtype):
-            dev = put_chunk(chunk, mesh, dtype)
+            dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
             vacc = var_chunk_step(vacc, dev["X"], dev["mask"], mean)
             guard.tick(dev, vacc)
         guard.flush(vacc)
@@ -482,7 +492,7 @@ def streamed_logreg_fit(
         acc = {"f": jnp.zeros((), dtype), "g": jnp.zeros((p,), dtype)}
         guard = StreamGuard()
         for chunk in source.iter_chunks(chunk_rows, np_dtype):
-            dev = put_chunk(chunk, mesh, dtype)
+            dev = put_chunk(chunk, mesh, dtype, need_w=False)
             acc = logreg_chunk_vg_step(
                 acc, dev["X"], dev["mask"], dev["y"], wd, mean_dev, inv_std,
                 n_classes=n_classes, multinomial=multinomial,
@@ -557,7 +567,7 @@ def streamed_kmeans_lloyd(
         }
         guard = StreamGuard()
         for chunk in source.iter_chunks(chunk_rows, np_dtype):
-            dev = put_chunk(chunk, mesh, dtype)
+            dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
             acc = kmeans_chunk_step(acc, dev["X"], dev["mask"], cts, matmul_dtype=mm)
             guard.tick(dev, acc)
         guard.flush(acc)
@@ -687,7 +697,7 @@ def streamed_min_sq_dists_update(
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     offset = 0
     for chunk in source.iter_chunks(chunk_rows, np_dtype):
-        dev = put_chunk(chunk, mesh, dtype)
+        dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
         d2 = np.asarray(
             chunk_min_sq_dists(dev["X"], dev["mask"], cands_dev), np.float64
         )
@@ -716,7 +726,7 @@ def streamed_count_closest(
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     guard = StreamGuard()
     for chunk in source.iter_chunks(chunk_rows, np_dtype):
-        dev = put_chunk(chunk, mesh, dtype)
+        dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
         counts = count_closest_chunk_step(counts, dev["X"], dev["mask"], cands_dev)
         guard.tick(dev, counts)
     guard.flush(counts)

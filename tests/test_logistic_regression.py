@@ -391,3 +391,33 @@ def test_bf16_objective_places_x_in_bf16():
     assert inputs.X.dtype == jnp.bfloat16
     assert inputs.mask.dtype == jnp.float32
     assert inputs.y.dtype == jnp.float32
+
+
+def test_bf16_objective_end_to_end_quality():
+    """Quality pin for the mixed-precision objective: a full estimator fit
+    with objective_dtype=bfloat16 must match the f32 fit's accuracy and
+    mean log-loss to tight tolerances (the bf16 path rounds A_t and the
+    residuals per dot — this guards the whole bf16 trajectory, not just
+    one kernel step, against future mixed-precision regressions)."""
+    df, X, y = _make_cls(n=2048, d=8, n_classes=2, seed=11)
+    f32_model = LogisticRegression(regParam=1e-3, maxIter=60).fit(df)
+    b16_model = LogisticRegression(
+        regParam=1e-3, maxIter=60, objective_dtype="bfloat16"
+    ).fit(df)
+
+    def acc_and_logloss(model):
+        out = model.transform(df)
+        pred = np.asarray(out["prediction"])
+        probs = np.asarray(out["probability"])
+        p = np.clip(probs[np.arange(len(y)), y.astype(int)], 1e-12, None)
+        return float((pred == y).mean()), float(-np.log(p).mean())
+
+    a32, l32 = acc_and_logloss(f32_model)
+    a16, l16 = acc_and_logloss(b16_model)
+    assert a16 >= a32 - 0.01, (a16, a32)
+    assert l16 <= l32 + 0.02, (l16, l32)
+    # coefficients themselves should track to bf16 rounding noise
+    np.testing.assert_allclose(
+        np.asarray(b16_model.coef_), np.asarray(f32_model.coef_),
+        rtol=0.08, atol=0.03,
+    )
