@@ -1,0 +1,202 @@
+"""Pallas sub-block histogram kernel for the RandomForest deep levels.
+
+The round-3 measurement campaign (docs/rf_performance.md) established
+that every histogram formulation expressible in XLA converges to the same
+~1.2e8 updates/s scatter wall on v5e — including the one-hot matmul
+forms, because XLA pattern-matches dot(one-hot-compare, X) and rewrites
+it back into scatter/select chains. This kernel is the counter-move the
+compiler cannot undo: with rows pre-sorted into node-contiguous order and
+each node's segment padded to a multiple of ``r_sub``, every aligned
+``r_sub``-row sub-block is node-pure, so the node dimension VANISHES from
+the one-hot — the kernel builds per-sub-block histograms with a bin-only
+one-hot and two MXU dots per block, and a cheap segment reduce over
+sub-blocks (they arrive sorted by node) finishes the per-node histogram.
+
+Per block of R rows the kernel does exactly:
+
+  bl  = binq @ E          (R, k*nb)   E[f, f*nb+j] = 1   (static, MXU)
+  oh  = (bl == lane%nb)   (R, k*nb)   bin one-hot        (one VPU compare)
+  out = A @ oh            (L*S, k*nb)                    (MXU)
+
+where A[j*S+s, r] = (r in sub-block j) * sw[r, s] folds the sub-block
+selector (a static band) and the per-row stat weights into the dot's LHS.
+Total per-level cost is one compare + ~3 matmul-equivalents over the
+data — no scatters anywhere.
+
+Numerics: identical to the scatter path for classification (one-hots,
+bin values <= 255 and small-integer bootstrap weights are exact in bf16
+multiplies with f32 accumulation). Variance stats (regression) carry
+real-valued y/y^2 and use Precision.HIGHEST, mirroring
+``tree_kernels._hist_matmul``.
+
+Reference role: replaces the shared-memory atomic histogram kernels cuML's
+decision-tree builder launches per level (the builder behind
+``/root/reference/python/src/spark_rapids_ml/tree.py:269-402``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Test hook (mirrors ops.linalg.FORCE_INTERPRET): run the kernel through
+# the Pallas interpreter on CPU so tests cover the real kernel body.
+FORCE_INTERPRET = False
+
+# Hardware-lowering probe results keyed by (k, nb, S, r_sub, R, variance);
+# policy in ops.linalg.probe_pallas_lowering. The probed instance matches
+# the production call exactly: int32 bins (callers cast before the kernel)
+# and the same variance flag (it switches both dots to HIGHEST emulation,
+# a different Mosaic lowering).
+_LOWERING_OK: dict = {}
+
+
+def _block_rows(k: int, nb: int) -> int:
+    """Rows per grid block: the (R, k*nb) one-hot is the VMEM resident —
+    keep two copies (+ bl) of it under ~40 MB."""
+    W = k * nb
+    for R in (512, 256, 128):
+        if 3 * R * W * 4 <= 40 * 1024 * 1024:
+            return R
+    return 128
+
+
+def rf_hist_pallas_ok(
+    n_pad: int, k: int, nb: int, S: int, r_sub: int, variance: bool = False
+) -> bool:
+    """Trace-time gate: TPU (or interpret), lane-aligned one-hot width,
+    power-of-two sub-blocks dividing the block, block-aligned row count,
+    and a probed lowering."""
+    R = _block_rows(k, nb)
+    ok = (
+        (jax.default_backend() == "tpu" or FORCE_INTERPRET)
+        and (k * nb) % 128 == 0
+        and nb <= 256
+        and 1 <= S <= 16
+        and r_sub >= 1
+        and (r_sub & (r_sub - 1)) == 0
+        and R % r_sub == 0
+        and n_pad % R == 0
+        # Mosaic block rule: the (L*S, W) output block's sublane dim must
+        # be a multiple of 8 once the grid has more than one block
+        and (R // r_sub) * S % 8 == 0
+        and 3 * R * k * nb * 4 <= 40 * 1024 * 1024
+    )
+    if ok and not FORCE_INTERPRET:
+        ok = _probe_lowering(k, nb, S, r_sub, R, variance)
+    return ok
+
+
+def _probe_lowering(
+    k: int, nb: int, S: int, r_sub: int, R: int, variance: bool
+) -> bool:
+    from .linalg import probe_pallas_lowering
+
+    key = (k, nb, S, r_sub, R, variance)
+
+    def compile_fn():
+        # two grid blocks: a single-block probe would let Mosaic accept
+        # output block shapes merely because they EQUAL the array shape,
+        # masking sublane-divisibility rejections the real multi-block
+        # call then hits
+        binq = jax.ShapeDtypeStruct((2 * R, k), jnp.int32)
+        swT = jax.ShapeDtypeStruct((S, 2 * R), jnp.float32)
+        subblock_hist.lower(
+            binq, swT, n_bins=nb, r_sub=r_sub, variance=variance,
+            transposed_sw=True,
+        ).compile()
+
+    return probe_pallas_lowering(
+        _LOWERING_OK, key, compile_fn, "RF sub-block histogram"
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_bins", "r_sub", "variance", "interpret", "transposed_sw"),
+)
+def subblock_hist(
+    binq: jax.Array,   # (n_pad, k) int32 bins in node-contiguous order
+    sw: jax.Array,     # (n_pad, S) f32 stats*weight (0 on padding rows)
+    *,
+    n_bins: int,
+    r_sub: int,
+    variance: bool = False,
+    interpret: bool | None = None,
+    transposed_sw: bool = False,
+) -> jax.Array:
+    """Per-sub-block histograms: (n_pad//r_sub, S, k*n_bins) float32.
+
+    Rows must be node-contiguous with every node's segment padded to a
+    multiple of ``r_sub`` (padding rows carry sw == 0, bins arbitrary).
+    Sub-block j covers rows [j*r_sub, (j+1)*r_sub); summing the
+    sub-blocks of one node — they are consecutive — yields that node's
+    (S, k, n_bins) histogram.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = FORCE_INTERPRET
+    n_pad, k = binq.shape
+    nb = n_bins
+    W = k * nb
+    if transposed_sw:
+        S, _ = sw.shape
+        swT = sw
+    else:
+        _, S = sw.shape
+        swT = sw.T  # (S, n_pad) — lane-major rows per stat
+    R = _block_rows(k, nb)
+    L = R // r_sub
+    n_blocks = n_pad // R
+    prec = lax.Precision.HIGHEST if variance else None
+
+    def kern(b_ref, s_ref, out_ref):
+        # static lane-expansion matrix: E[f, f*nb + j] = 1 (built from
+        # iotas in-kernel; Pallas forbids captured array constants)
+        fi = lax.broadcasted_iota(jnp.int32, (k, W), 0)
+        li = lax.broadcasted_iota(jnp.int32, (k, W), 1)
+        E = (li // nb == fi).astype(jnp.float32)
+        b = b_ref[:].astype(jnp.float32)                   # (R, k)
+        bl = jnp.dot(b, E, precision=prec,
+                     preferred_element_type=jnp.float32)   # (R, W)
+        lane_bin = (
+            lax.broadcasted_iota(jnp.int32, (1, W), 1) % nb
+        ).astype(jnp.float32)
+        oh = (bl == lane_bin).astype(jnp.float32)          # (R, W)
+        # A[j*S+s, r] = (r // r_sub == j) * sw[r, s]
+        a0 = lax.broadcasted_iota(jnp.int32, (L * S, R), 0)
+        r0 = lax.broadcasted_iota(jnp.int32, (L * S, R), 1)
+        band = ((a0 // S) == (r0 // r_sub)).astype(jnp.float32)
+        sw_sel = jnp.zeros((L * S, R), jnp.float32)
+        for s in range(S):
+            sw_sel = sw_sel + jnp.where(
+                a0 % S == s, s_ref[s : s + 1, :], 0.0
+            )
+        A = band * sw_sel
+        out_ref[:] = jnp.dot(
+            A, oh, precision=prec, preferred_element_type=jnp.float32
+        )                                                  # (L*S, W)
+
+    out = pl.pallas_call(
+        kern,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((R, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((S, R), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (L * S, W), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_blocks * L * S, W), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(binq, swT)
+    return out.reshape(n_pad // r_sub, S, W)

@@ -72,11 +72,17 @@ _ROW_CHUNK = 1 << 13
 
 def resolve_hist_strategy() -> str:
     """Validated histogram strategy from the TPUML_RF_FORCE_STRATEGY env
-    var (typos must error, not silently fall back to the heuristic)."""
+    var (typos must error, not silently fall back to the heuristic).
+
+    "compact" forces the node-contiguous Pallas path on every level where
+    its lowering is eligible (TPU, f32 stats, lane-aligned widths) and
+    falls back to scatter on levels where it is not — the fused-kernel
+    analog of knn's "auto", kept as its own name so "auto" can keep
+    meaning "per-level cost model" as strategies evolve."""
     v = _os.environ.get("TPUML_RF_FORCE_STRATEGY") or "auto"
-    if v not in ("auto", "matmul", "scatter"):
+    if v not in ("auto", "matmul", "scatter", "compact"):
         raise ValueError(
-            f"RF histogram strategy must be auto|matmul|scatter, got {v!r}"
+            f"RF histogram strategy must be auto|matmul|scatter|compact, got {v!r}"
         )
     return v
 
@@ -236,6 +242,153 @@ def _contract_gather(packed: jax.Array, idx: jax.Array) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# compact histogram strategy (TPU): node-contiguous Pallas sub-blocks
+# ---------------------------------------------------------------------------
+
+
+def _compact_r_sub(n: int, n_nodes: int, R: int, S: int) -> int:
+    """Per-level sub-block size: ~half the average node width, so the
+    alignment padding stays ~+50% worst-case while sub-block count (and
+    with it the final segment reduce) stays small at shallow levels.
+    Capped so the kernel's (L*S, W) output block keeps a sublane dim
+    that is a multiple of 8 (L = R // r_sub; Mosaic block rule)."""
+    import math
+
+    r = min(512, max(8, next_pow2(max(1, n // (n_nodes * 2)))))
+    # (L*S) % 8 == 0 needs L a multiple of 8/gcd(S, 8)
+    cap = R // (8 // math.gcd(S, 8))
+    return max(1, min(r, cap, R))
+
+
+def _hist_compact(
+    hist_src: jax.Array,  # (n, F) int bin values (subset-gathered)
+    seg: jax.Array,       # (n,) int32 level-local node id; n_nodes = dead
+    sw: jax.Array,        # (n, S) f32 stats*weight
+    *,
+    n_nodes: int,
+    nb: int,
+    r_sub: int,
+    n_pad: int,           # from the caller's eligibility gate: the SAME
+                          # block-aligned padded row count it validated
+    variance: bool,
+    interpret=None,
+):
+    """(F, n_nodes, nb, S) histogram + (n_nodes, S) parent stats via the
+    node-contiguous Pallas path (``ops/rf_pallas.py``).
+
+    One stable sort groups rows by node; every node's run is padded to an
+    ``r_sub`` multiple so each aligned sub-block is node-pure; the Pallas
+    kernel turns each sub-block into a (S, F*nb) histogram with a bin-only
+    one-hot (NO node dimension — the whole point); and one wide-row
+    segment-sum over the node-sorted sub-blocks finishes the per-node
+    histograms. Parent stats fall out of the histogram (bin-sum of the
+    first subset slot — slot 0 is always a real feature), saving the
+    per-level parent scatter the other strategies pay.
+
+    Measured v5e at 131k x 16 x 128 x 2 (level 12): ~41 ms for the
+    scatter strategy's histogram vs ~1 ms kernel + ~4 ms glue here
+    (scripts/rf_deep_microbench*.py).
+    """
+    from .rf_pallas import subblock_hist
+
+    n, F = hist_src.shape
+    S = sw.shape[1]
+    W = F * nb
+    n_sb = n_pad // r_sub
+
+    # stable sort of row ids by node: perm[j] = original row at sorted pos j
+    iota = jnp.arange(n, dtype=jnp.int32)
+    keys_s, perm = lax.sort((seg, iota), num_keys=1)
+    # per-node source runs and r_sub-aligned destination runs
+    starts = jnp.searchsorted(
+        keys_s, jnp.arange(n_nodes + 1, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)                                     # (n_nodes+1,)
+    lens = starts[1:] - starts[:-1]                         # (n_nodes,)
+    plen = -(-lens // r_sub) * r_sub
+    pstart = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(plen)]
+    )                                                       # (n_nodes+1,)
+    # node of each sub-block (sub-blocks are node-pure by construction;
+    # positions past the data resolve to the n_nodes dump slot)
+    sb_pos = jnp.arange(n_sb, dtype=jnp.int32) * r_sub
+    seg_sb = jnp.searchsorted(pstart[1:], sb_pos, side="right").astype(
+        jnp.int32
+    )                                                       # (n_sb,)
+    # per-row source index: ONE small-table row gather at sub-block
+    # granularity (n_sb rows), broadcast to rows — per-row gathers from
+    # the (n_nodes,) tables would cost ~1 ms each at the elementwise
+    # gather wall
+    sbc = jnp.clip(seg_sb, 0, n_nodes - 1)
+    tbl = jnp.stack([starts[:-1], pstart[:-1], lens], axis=1)
+    tbl_rows = jnp.broadcast_to(
+        tbl[sbc][:, None, :], (n_sb, r_sub, 3)
+    ).reshape(n_pad, 3)
+    pos = jnp.arange(n_pad, dtype=jnp.int32)
+    off = pos - tbl_rows[:, 1]
+    src = tbl_rows[:, 0] + off
+    pvalid = (off < tbl_rows[:, 2]) & (
+        jnp.broadcast_to(seg_sb[:, None], (n_sb, r_sub)).reshape(n_pad)
+        < n_nodes
+    )
+    src2 = perm[jnp.clip(src, 0, n - 1)]
+    # int32 bins always (hist_src may arrive uint8 from take_along_axis):
+    # the kernel — and its lowering probe — see exactly one input dtype
+    binq = hist_src[src2].astype(jnp.int32)                 # (n_pad, F)
+    swq = sw[src2] * pvalid[:, None].astype(sw.dtype)       # (n_pad, S)
+
+    partials = subblock_hist(
+        binq, swq, n_bins=nb, r_sub=r_sub, variance=variance,
+        interpret=interpret,
+    )                                                       # (n_sb, S, W)
+    hist_nodes = jax.ops.segment_sum(
+        partials.reshape(n_sb, S * W),
+        jnp.where(seg_sb < n_nodes, seg_sb, n_nodes),
+        num_segments=n_nodes + 1,
+    )[:n_nodes].reshape(n_nodes, S, F, nb)
+    parent = hist_nodes[:, :, 0, :].sum(axis=-1)            # (n_nodes, S)
+    hist = hist_nodes.transpose(2, 0, 3, 1)                 # (F, n_nodes, nb, S)
+    return hist, parent
+
+
+def _best_splits_from_hist(hist, parent, pcount, pimp, realf, nb, cfg):
+    """Best (gain, feature, bin) per node from a histogram block.
+
+    ``hist`` is (F, n_nodes, nb, S); ``realf`` (F, n_nodes) maps block
+    slots to real feature ids (sentinel = cfg.n_features, masked out).
+    Shared by the chunked matmul/scatter strategies and the compact path.
+    """
+    cum = jnp.cumsum(hist, axis=2)
+    left = cum[:, :, :-1, :]                 # threshold = bin b goes left
+    right = parent[None, :, None, :] - left
+    nl = _count(left, cfg.impurity)
+    nr = _count(right, cfg.impurity)
+    il = _impurity(left, cfg.impurity)
+    ir = _impurity(right, cfg.impurity)
+    denom = jnp.maximum(pcount, 1e-12)[None, :, None]
+    gain = pimp[None, :, None] - (nl * il + nr * ir) / denom
+    ok = (nl >= cfg.min_samples_leaf) & (nr >= cfg.min_samples_leaf)
+    ok = ok & (realf < cfg.n_features)[:, :, None]
+    gain = jnp.where(ok, gain, -jnp.inf)
+    # per-(feature, node) best bin with CENTERED tie-breaking: equal
+    # gains form a run across the empty-bin gap between the two row
+    # populations; picking the middle edge approximates the midpoint
+    # threshold exact tree builders use (robust for unseen rows near
+    # the gap, where the first tied edge would hug the left side)
+    m = gain.max(axis=2)                                # (F, n_nodes)
+    tie = gain == m[:, :, None]
+    first = jnp.argmax(tie, axis=2)
+    last = (nb - 2) - jnp.argmax(tie[:, :, ::-1], axis=2)
+    mid = (first + last + 1) // 2
+    midg = jnp.take_along_axis(gain, mid[:, :, None], axis=2)[:, :, 0]
+    bbin = jnp.where(midg == m, mid, first)             # (F, n_nodes)
+    fi = jnp.argmax(m, axis=0)                          # (n_nodes,)
+    g = jnp.take_along_axis(m, fi[None, :], axis=0)[0]
+    f = jnp.take_along_axis(realf, fi[None, :], axis=0)[0]
+    b = jnp.take_along_axis(bbin, fi[None, :], axis=0)[0].astype(jnp.int32)
+    return g, f, b
+
+
+# ---------------------------------------------------------------------------
 # single-tree level-wise builder
 # ---------------------------------------------------------------------------
 
@@ -304,13 +457,15 @@ def _build_tree(
         local = node - offset
         in_level = (local >= 0) & (local < n_nodes)
         seg = jnp.where(in_level, local, n_nodes).astype(jnp.int32)
-        parent = jax.ops.segment_sum(sw, seg, num_segments=n_nodes + 1)[:n_nodes]
-        leaf = leaf.at[offset : offset + n_nodes].set(parent)
         if level == cfg.max_depth:
+            # final level: leaf stats only — the one remaining per-level
+            # parent scatter (the compact path below derives parent from
+            # its histogram on every split level)
+            parent = jax.ops.segment_sum(sw, seg, num_segments=n_nodes + 1)[
+                :n_nodes
+            ]
+            leaf = leaf.at[offset : offset + n_nodes].set(parent)
             break
-
-        pcount = _count(parent, cfg.impurity)
-        pimp = _impurity(parent, cfg.impurity)
 
         # Per-node feature subsampling (cuML max_features semantics): the
         # k_features highest of a per-(node, feature) uniform draw. The
@@ -349,202 +504,231 @@ def _build_tree(
             hist_src = bins
             d_hist = d_pad
 
-        # strategy per level (static). Subset path: the gathered operand is
-        # only k_pad wide, and measured v5e scatter on it is ~2.2 ms/level
-        # FLAT in n_nodes while the one-hot matmul grows past 8 ms — scatter
-        # always wins. No-subset path: one-hot matmuls on the MXU until the
-        # 2*n_nodes*nb waste factor exceeds a scatter-add update's cost.
-        # "auto" is TPU-only: the trade inverts on CPU, where scatter-adds
-        # are cheap and dense one-hot matmuls are pure waste (a CPU run of
-        # the reference forest config went from ~seconds to minutes).
-        if cfg.hist_strategy == "matmul":
-            use_matmul = True
-        elif cfg.hist_strategy == "scatter":
-            use_matmul = False
-        elif subset:
-            use_matmul = False
-        else:
-            use_matmul = (
-                jax.default_backend() == "tpu"
-                and (2.0 * n_nodes * nb) < _SCATTER_EQ_FLOPS
+        # compact strategy (TPU): node-contiguous rows + the Pallas
+        # sub-block kernel (ops/rf_pallas.py). Eligibility is static per
+        # level: f32 stats, lane-aligned one-hot width, a full-level
+        # histogram tile that fits HBM comfortably, and a probed
+        # lowering. Wins by ~8x per level over the scatter wall at the
+        # bench shape (scripts/rf_deep_microbench2.py), on every level —
+        # scatter cost is n-bound, so shallow levels paid it too.
+        from .rf_pallas import _block_rows, rf_hist_pallas_ok
+
+        R_blk = _block_rows(d_hist, nb)
+        r_sub = _compact_r_sub(n, n_nodes, R_blk, S)
+        n_pad_c = -(-(n + (n_nodes + 1) * r_sub) // R_blk) * R_blk
+        use_compact = (
+            cfg.hist_strategy in ("auto", "compact")
+            and dt == jnp.float32
+            and n_nodes * d_hist * nb * S <= (1 << 28)
+            and rf_hist_pallas_ok(
+                n_pad_c, d_hist, nb, S, r_sub,
+                variance=(cfg.impurity == "variance"),
             )
-
-        # the narrow subset-scatter tile ((k_pad, n_nodes*nb, S): 67 MB at
-        # k=16/depth-13) runs single-chunk under a raised budget — chunking
-        # it only multiplied fixed scatter overheads
-        budget = (1 << 25) if (subset and not use_matmul) else _HIST_BUDGET
-        F = _chunk_features(d_hist, n_nodes, nb, S, budget)
-        n_chunks = d_hist // F
-        if use_matmul:
-            # the (C, F*nb) bin one-hot is a materialized dot operand; the
-            # histogram-tile budget alone lets F reach d_pad at shallow
-            # levels (17 GB at d_pad=4096, C=8192, nb=128) — cap F so the
-            # one-hot stays ~256 MB. Extra feature chunks cost nothing:
-            # total matmul flops per level are F-invariant.
-            C_lvl = min(_ROW_CHUNK, n)
-            f_cap = max(1, (1 << 26) // (C_lvl * nb))
-            f_cap = 1 << (f_cap.bit_length() - 1)
-            F = min(F, f_cap)
-            n_chunks = d_hist // F
-
-        def _hist_scatter(binc, *, n_nodes, in_level, local, sw):
-            """(F, n_nodes, nb, S) via segment_sum scatter-adds."""
-            ids = jnp.where(
-                in_level[:, None], local[:, None] * nb + binc, n_nodes * nb
-            )
-            # Small S (regression stats, binary/few-class): one scalar
-            # segment_sum per stat column — vmapping the (n, S) operand
-            # broadcasts it to (F, n, S) with the tiny S minor dim
-            # lane-padded S -> 128 on TPU, a 64x memory expansion at S=2
-            # (16 GB observed at n=131k, F=256); per-stat 1-D operands
-            # keep the broadcast at (F, n), lane-aligned. Wide S (many
-            # classes): padding overhead fades (<= 8x at S >= 16) and S
-            # unrolled scatters would dominate — keep one (n, S) scatter.
-            F = binc.shape[1]
-            if S <= 16:
-                hist = jnp.stack(
-                    [
-                        jax.vmap(
-                            lambda col, c=sw[:, s]: jax.ops.segment_sum(
-                                c, col, num_segments=n_nodes * nb + 1
-                            ),
-                            in_axes=1,
-                        )(ids)                       # (F, n_nodes*nb+1)
-                        for s in range(S)
-                    ],
-                    axis=-1,
-                )                                    # (F, n_nodes*nb+1, S)
-            else:
-                hist = jax.vmap(
-                    lambda col: jax.ops.segment_sum(
-                        sw, col, num_segments=n_nodes * nb + 1
-                    ),
-                    in_axes=1,
-                )(ids)                               # (F, n_nodes*nb+1, S)
-            return hist[:, : n_nodes * nb, :].reshape(F, n_nodes, nb, S)
-
-        def _hist_matmul(binc, *, n_nodes, in_level, local, sw):
-            """(F, n_nodes, nb, S) via MXU one-hot contractions.
-
-            hist[f,nd,b,s] = sum_r N[r,nd] * B[r,f*nb+b] * sw[r,s] with
-            N the (row, node) one-hot (row weight/level mask folded in) and
-            B the (row, feature-bin) one-hot — one (n_nodes, C) x (C, F*nb)
-            matmul per stat per row chunk. Rows are accumulated in chunks
-            so the one-hot intermediates stay bounded; the clamped last
-            chunk masks re-read rows."""
-            F = binc.shape[1]
-            C = min(_ROW_CHUNK, n)
-            nc = -(-n // C)
-            node_ar = jnp.arange(n_nodes, dtype=jnp.int32)
-            bin_ar = jnp.arange(nb, dtype=jnp.int32)
-
-            def row_body(ri, acc):
-                start = jnp.minimum(ri * C, n - C)
-                bc = lax.dynamic_slice(binc, (start, 0), (C, F))
-                loc = lax.dynamic_slice(local, (start,), (C,))
-                lvl = lax.dynamic_slice(in_level, (start,), (C,))
-                swc = lax.dynamic_slice(sw, (start, 0), (C, S))
-                fresh = (start + jnp.arange(C)) >= ri * C  # clamp re-reads
-                Noh = (
-                    (loc[:, None] == node_ar[None, :])
-                    & lvl[:, None]
-                    & fresh[:, None]
-                ).astype(dt)                              # (C, n_nodes)
-                Boh = (bc[:, :, None] == bin_ar[None, None, :]).astype(dt)
-                Boh = Boh.reshape(C, F * nb)              # (C, F*nb)
-                # TPU's default f32 matmul uses bf16 multiplies — exact for
-                # classification (one-hots and small-integer weights are
-                # bf16-representable; accumulation is f32) but NOT for
-                # variance stats carrying y/y^2, where rounding would flip
-                # near-tied splits vs the scatter path. Those pay the
-                # multi-pass HIGHEST f32 emulation.
-                prec = (
-                    lax.Precision.HIGHEST
-                    if cfg.impurity == "variance"
-                    else None
-                )
-                return acc + jnp.stack(
-                    [
-                        jnp.matmul(
-                            (Noh * swc[:, s][:, None]).T, Boh, precision=prec
-                        )
-                        for s in range(S)
-                    ],
-                    axis=-1,
-                )                                         # (n_nodes, F*nb, S)
-
-            acc = lax.fori_loop(
-                0,
-                nc,
-                row_body,
-                jnp.zeros((n_nodes, F * nb, S), dt),
-            )
-            return acc.reshape(n_nodes, F, nb, S).transpose(1, 0, 2, 3)
-
-        def chunk_body(carry, ci, *, n_nodes=n_nodes, parent=parent,
-                       pcount=pcount, pimp=pimp, feats=feats, F=F,
-                       in_level=in_level, local=local, sw=sw,
-                       use_matmul=use_matmul, subset=subset,
-                       hist_src=hist_src):
-            bg, bf, bb = carry
-            binc = lax.dynamic_slice(
-                hist_src, (0, ci * F), (n, F)
-            ).astype(jnp.int32)
-            make = _hist_matmul if use_matmul else _hist_scatter
-            hist = make(
-                binc, n_nodes=n_nodes, in_level=in_level, local=local, sw=sw
-            )
-            cum = jnp.cumsum(hist, axis=2)
-            left = cum[:, :, :-1, :]                 # threshold = bin b goes left
-            right = parent[None, :, None, :] - left
-            nl = _count(left, cfg.impurity)
-            nr = _count(right, cfg.impurity)
-            il = _impurity(left, cfg.impurity)
-            ir = _impurity(right, cfg.impurity)
-            denom = jnp.maximum(pcount, 1e-12)[None, :, None]
-            gain = pimp[None, :, None] - (nl * il + nr * ir) / denom
-            if subset:
-                # real feature id per (virtual feature, node) in this chunk
-                realf = lax.dynamic_slice(
-                    feats, (0, ci * F), (n_nodes, F)
-                ).T                                          # (F, n_nodes)
-            else:
-                realf = jnp.broadcast_to(
-                    (ci * F + jnp.arange(F, dtype=jnp.int32))[:, None],
-                    (F, n_nodes),
-                )
-            ok = (nl >= cfg.min_samples_leaf) & (nr >= cfg.min_samples_leaf)
-            ok = ok & (realf < cfg.n_features)[:, :, None]
-            gain = jnp.where(ok, gain, -jnp.inf)
-            # per-(feature, node) best bin with CENTERED tie-breaking: equal
-            # gains form a run across the empty-bin gap between the two row
-            # populations; picking the middle edge approximates the midpoint
-            # threshold exact tree builders use (robust for unseen rows near
-            # the gap, where the first tied edge would hug the left side)
-            m = gain.max(axis=2)                                # (F, n_nodes)
-            tie = gain == m[:, :, None]
-            first = jnp.argmax(tie, axis=2)
-            last = (nb - 2) - jnp.argmax(tie[:, :, ::-1], axis=2)
-            mid = (first + last + 1) // 2
-            midg = jnp.take_along_axis(gain, mid[:, :, None], axis=2)[:, :, 0]
-            bbin = jnp.where(midg == m, mid, first)             # (F, n_nodes)
-            fi = jnp.argmax(m, axis=0)                          # (n_nodes,)
-            g = jnp.take_along_axis(m, fi[None, :], axis=0)[0]
-            f = jnp.take_along_axis(realf, fi[None, :], axis=0)[0]
-            b = jnp.take_along_axis(bbin, fi[None, :], axis=0)[0].astype(jnp.int32)
-            upd = g > bg
-            return (
-                jnp.where(upd, g, bg),
-                jnp.where(upd, f, bf),
-                jnp.where(upd, b, bb),
-            ), None
-
-        init = (
-            jnp.full((n_nodes,), -jnp.inf, dt),
-            jnp.zeros((n_nodes,), jnp.int32),
-            jnp.zeros((n_nodes,), jnp.int32),
         )
-        (bg, bf, bb), _ = lax.scan(chunk_body, init, jnp.arange(n_chunks))
+        if use_compact:
+            hist_full, parent = _hist_compact(
+                hist_src, seg, sw, n_nodes=n_nodes, nb=nb, r_sub=r_sub,
+                n_pad=n_pad_c, variance=(cfg.impurity == "variance"),
+            )
+        else:
+            parent = jax.ops.segment_sum(sw, seg, num_segments=n_nodes + 1)[
+                :n_nodes
+            ]
+        leaf = leaf.at[offset : offset + n_nodes].set(parent)
+        pcount = _count(parent, cfg.impurity)
+        pimp = _impurity(parent, cfg.impurity)
+
+        if use_compact:
+            if subset:
+                realf_full = feats.T  # (k_pad, n_nodes) real feature ids
+            else:
+                realf_full = jnp.broadcast_to(
+                    jnp.arange(d_hist, dtype=jnp.int32)[:, None],
+                    (d_hist, n_nodes),
+                )
+            bg, bf, bb = _best_splits_from_hist(
+                hist_full, parent, pcount, pimp, realf_full, nb, cfg
+            )
+            # match the chunked paths bit-for-bit: nodes with no finite
+            # gain keep the (0, 0) feature/bin the chunk-scan init carries
+            fin = bg > -jnp.inf
+            bf = jnp.where(fin, bf, 0)
+            bb = jnp.where(fin, bb, 0)
+        else:
+            # strategy per level (static). Subset path: the gathered operand is
+            # only k_pad wide, and measured v5e scatter on it is ~2.2 ms/level
+            # FLAT in n_nodes while the one-hot matmul grows past 8 ms — scatter
+            # always wins. No-subset path: one-hot matmuls on the MXU until the
+            # 2*n_nodes*nb waste factor exceeds a scatter-add update's cost.
+            # "auto" is TPU-only: the trade inverts on CPU, where scatter-adds
+            # are cheap and dense one-hot matmuls are pure waste (a CPU run of
+            # the reference forest config went from ~seconds to minutes).
+            if cfg.hist_strategy == "matmul":
+                use_matmul = True
+            elif cfg.hist_strategy in ("scatter", "compact"):
+                # forced-compact levels that fail the eligibility gate
+                # take scatter, as resolve_hist_strategy documents —
+                # matmul would silently change variance-stat numerics
+                use_matmul = False
+            elif subset:
+                use_matmul = False
+            else:
+                use_matmul = (
+                    jax.default_backend() == "tpu"
+                    and (2.0 * n_nodes * nb) < _SCATTER_EQ_FLOPS
+                )
+
+            # the narrow subset-scatter tile ((k_pad, n_nodes*nb, S): 67 MB at
+            # k=16/depth-13) runs single-chunk under a raised budget — chunking
+            # it only multiplied fixed scatter overheads
+            budget = (1 << 25) if (subset and not use_matmul) else _HIST_BUDGET
+            F = _chunk_features(d_hist, n_nodes, nb, S, budget)
+            n_chunks = d_hist // F
+            if use_matmul:
+                # the (C, F*nb) bin one-hot is a materialized dot operand; the
+                # histogram-tile budget alone lets F reach d_pad at shallow
+                # levels (17 GB at d_pad=4096, C=8192, nb=128) — cap F so the
+                # one-hot stays ~256 MB. Extra feature chunks cost nothing:
+                # total matmul flops per level are F-invariant.
+                C_lvl = min(_ROW_CHUNK, n)
+                f_cap = max(1, (1 << 26) // (C_lvl * nb))
+                f_cap = 1 << (f_cap.bit_length() - 1)
+                F = min(F, f_cap)
+                n_chunks = d_hist // F
+
+            def _hist_scatter(binc, *, n_nodes, in_level, local, sw):
+                """(F, n_nodes, nb, S) via segment_sum scatter-adds."""
+                ids = jnp.where(
+                    in_level[:, None], local[:, None] * nb + binc, n_nodes * nb
+                )
+                # Small S (regression stats, binary/few-class): one scalar
+                # segment_sum per stat column — vmapping the (n, S) operand
+                # broadcasts it to (F, n, S) with the tiny S minor dim
+                # lane-padded S -> 128 on TPU, a 64x memory expansion at S=2
+                # (16 GB observed at n=131k, F=256); per-stat 1-D operands
+                # keep the broadcast at (F, n), lane-aligned. Wide S (many
+                # classes): padding overhead fades (<= 8x at S >= 16) and S
+                # unrolled scatters would dominate — keep one (n, S) scatter.
+                F = binc.shape[1]
+                if S <= 16:
+                    hist = jnp.stack(
+                        [
+                            jax.vmap(
+                                lambda col, c=sw[:, s]: jax.ops.segment_sum(
+                                    c, col, num_segments=n_nodes * nb + 1
+                                ),
+                                in_axes=1,
+                            )(ids)                       # (F, n_nodes*nb+1)
+                            for s in range(S)
+                        ],
+                        axis=-1,
+                    )                                    # (F, n_nodes*nb+1, S)
+                else:
+                    hist = jax.vmap(
+                        lambda col: jax.ops.segment_sum(
+                            sw, col, num_segments=n_nodes * nb + 1
+                        ),
+                        in_axes=1,
+                    )(ids)                               # (F, n_nodes*nb+1, S)
+                return hist[:, : n_nodes * nb, :].reshape(F, n_nodes, nb, S)
+
+            def _hist_matmul(binc, *, n_nodes, in_level, local, sw):
+                """(F, n_nodes, nb, S) via MXU one-hot contractions.
+
+                hist[f,nd,b,s] = sum_r N[r,nd] * B[r,f*nb+b] * sw[r,s] with
+                N the (row, node) one-hot (row weight/level mask folded in) and
+                B the (row, feature-bin) one-hot — one (n_nodes, C) x (C, F*nb)
+                matmul per stat per row chunk. Rows are accumulated in chunks
+                so the one-hot intermediates stay bounded; the clamped last
+                chunk masks re-read rows."""
+                F = binc.shape[1]
+                C = min(_ROW_CHUNK, n)
+                nc = -(-n // C)
+                node_ar = jnp.arange(n_nodes, dtype=jnp.int32)
+                bin_ar = jnp.arange(nb, dtype=jnp.int32)
+
+                def row_body(ri, acc):
+                    start = jnp.minimum(ri * C, n - C)
+                    bc = lax.dynamic_slice(binc, (start, 0), (C, F))
+                    loc = lax.dynamic_slice(local, (start,), (C,))
+                    lvl = lax.dynamic_slice(in_level, (start,), (C,))
+                    swc = lax.dynamic_slice(sw, (start, 0), (C, S))
+                    fresh = (start + jnp.arange(C)) >= ri * C  # clamp re-reads
+                    Noh = (
+                        (loc[:, None] == node_ar[None, :])
+                        & lvl[:, None]
+                        & fresh[:, None]
+                    ).astype(dt)                              # (C, n_nodes)
+                    Boh = (bc[:, :, None] == bin_ar[None, None, :]).astype(dt)
+                    Boh = Boh.reshape(C, F * nb)              # (C, F*nb)
+                    # TPU's default f32 matmul uses bf16 multiplies — exact for
+                    # classification (one-hots and small-integer weights are
+                    # bf16-representable; accumulation is f32) but NOT for
+                    # variance stats carrying y/y^2, where rounding would flip
+                    # near-tied splits vs the scatter path. Those pay the
+                    # multi-pass HIGHEST f32 emulation.
+                    prec = (
+                        lax.Precision.HIGHEST
+                        if cfg.impurity == "variance"
+                        else None
+                    )
+                    return acc + jnp.stack(
+                        [
+                            jnp.matmul(
+                                (Noh * swc[:, s][:, None]).T, Boh, precision=prec
+                            )
+                            for s in range(S)
+                        ],
+                        axis=-1,
+                    )                                         # (n_nodes, F*nb, S)
+
+                acc = lax.fori_loop(
+                    0,
+                    nc,
+                    row_body,
+                    jnp.zeros((n_nodes, F * nb, S), dt),
+                )
+                return acc.reshape(n_nodes, F, nb, S).transpose(1, 0, 2, 3)
+
+            def chunk_body(carry, ci, *, n_nodes=n_nodes, parent=parent,
+                           pcount=pcount, pimp=pimp, feats=feats, F=F,
+                           in_level=in_level, local=local, sw=sw,
+                           use_matmul=use_matmul, subset=subset,
+                           hist_src=hist_src):
+                bg, bf, bb = carry
+                binc = lax.dynamic_slice(
+                    hist_src, (0, ci * F), (n, F)
+                ).astype(jnp.int32)
+                make = _hist_matmul if use_matmul else _hist_scatter
+                hist = make(
+                    binc, n_nodes=n_nodes, in_level=in_level, local=local, sw=sw
+                )
+                if subset:
+                    # real feature id per (virtual feature, node), this chunk
+                    realf = lax.dynamic_slice(
+                        feats, (0, ci * F), (n_nodes, F)
+                    ).T                                      # (F, n_nodes)
+                else:
+                    realf = jnp.broadcast_to(
+                        (ci * F + jnp.arange(F, dtype=jnp.int32))[:, None],
+                        (F, n_nodes),
+                    )
+                g, f, b = _best_splits_from_hist(
+                    hist, parent, pcount, pimp, realf, nb, cfg
+                )
+                upd = g > bg
+                return (
+                    jnp.where(upd, g, bg),
+                    jnp.where(upd, f, bf),
+                    jnp.where(upd, b, bb),
+                ), None
+
+            init = (
+                jnp.full((n_nodes,), -jnp.inf, dt),
+                jnp.zeros((n_nodes,), jnp.int32),
+                jnp.zeros((n_nodes,), jnp.int32),
+            )
+            (bg, bf, bb), _ = lax.scan(chunk_body, init, jnp.arange(n_chunks))
 
         do_split = (
             jnp.isfinite(bg)

@@ -426,3 +426,59 @@ def test_contract_gather_matches_take_along_axis(monkeypatch):
     np.testing.assert_allclose(
         m_contract._leaf_stats_arr, m_gather._leaf_stats_arr
     )
+
+
+def test_compact_pallas_strategy_matches_scatter(monkeypatch):
+    """The node-contiguous Pallas histogram path (TPUML_RF_FORCE_STRATEGY=
+    compact, interpret-forced on CPU) must produce a bit-identical forest
+    to the scatter strategy: identical split features, thresholds, and
+    leaf stats for classification (integer stats are exact under every
+    summation order), and a matching regression fit to rounding noise."""
+    import jax
+
+    import spark_rapids_ml_tpu.ops.rf_pallas as rfp
+
+    rng = np.random.default_rng(41)
+    X = rng.normal(size=(900, 24)).astype(np.float32)
+    y = ((X[:, 3] - X[:, 7] + 0.5 * X[:, 11]) > 0).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+
+    kw = dict(numTrees=4, maxDepth=5, seed=5, featureSubsetStrategy="sqrt")
+    monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "scatter")
+    m_sc = RandomForestClassifier(**kw).fit(df)
+    monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "compact")
+    monkeypatch.setattr(rfp, "FORCE_INTERPRET", True)
+    # spy: "compact" falls back silently on ineligible levels, so this
+    # test must prove the Pallas kernel actually ran (else it would
+    # compare scatter against scatter and pass vacuously)
+    calls = []
+    real_subblock_hist = rfp.subblock_hist
+
+    def spying_subblock_hist(*args, **kwargs):
+        calls.append(1)
+        return real_subblock_hist(*args, **kwargs)
+
+    monkeypatch.setattr(rfp, "subblock_hist", spying_subblock_hist)
+    try:
+        m_cp = RandomForestClassifier(**kw).fit(df)
+        assert calls, "compact strategy never engaged the Pallas kernel"
+        np.testing.assert_array_equal(m_cp._features_arr, m_sc._features_arr)
+        np.testing.assert_allclose(m_cp._thresholds_arr, m_sc._thresholds_arr)
+        np.testing.assert_allclose(m_cp._leaf_stats_arr, m_sc._leaf_stats_arr)
+
+        # regression (variance stats use Precision.HIGHEST in the kernel):
+        yr = (X[:, 1] * 0.7 - X[:, 5]).astype(np.float32)
+        dfr = DataFrame({"features": X, "label": yr})
+        kwr = dict(numTrees=3, maxDepth=4, seed=7)
+        monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "scatter")
+        r_sc = RandomForestRegressor(**kwr).fit(dfr)
+        monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "compact")
+        r_cp = RandomForestRegressor(**kwr).fit(dfr)
+        p_sc = np.asarray(r_sc.transform(dfr)["prediction"])
+        p_cp = np.asarray(r_cp.transform(dfr)["prediction"])
+        # split decisions may flip on near-ties (summation order); the
+        # fitted function must stay equivalent
+        corr = np.corrcoef(p_sc, p_cp)[0, 1]
+        assert corr > 0.999, corr
+    finally:
+        jax.clear_caches()
