@@ -245,11 +245,29 @@ def bench_pca(X, mask, mesh, n_chips):
         fit_body,
         lambda rep: (X, mask * jnp.float32(1.0 + rep * 1e-6)),
     )
+    # transform path (reference reports fit AND transform per workload,
+    # ``benchmark/base.py:241-270``): one centered projection sweep at
+    # k=3 — the exact compute of PCAModel.transform
+    W = jnp.asarray(
+        np.random.default_rng(5).standard_normal((3, N_COLS)), jnp.float32
+    )
+    mu = jnp.asarray(
+        np.random.default_rng(6).standard_normal(N_COLS), jnp.float32
+    )
+
+    def tr_body(eps, X, m):
+        return _checksum((X - mu[None, :] * (1.0 + eps)) @ W.T)
+
+    t_tr = _time_scanned_fits(
+        tr_body, lambda rep: (X, mask * jnp.float32(1.0 + rep * 1e-6))
+    )
     n = N_ROWS
     flops = 2.0 * n * N_COLS * N_COLS  # Gram dominates
     return {
         "samples_per_sec_per_chip": n / t / n_chips,
         "fit_seconds": t,
+        "transform_seconds": t_tr,
+        "transform_samples_per_sec_per_chip": n / t_tr / n_chips,
         "inner_fits_per_dispatch": INNER_FITS,
         "flops_model": flops,
         "baseline_samples_per_sec": 1.1e8,
@@ -290,12 +308,34 @@ def bench_kmeans(X, mask, mesh, n_chips):
         lambda rep: (X, mask, centers0 + jnp.float32((rep + 1) * 1e-6)),
         timed,
     )
+    # transform path: one chunked assignment pass (argmin over pairwise
+    # distances) — the exact compute of KMeansModel.transform
+    from spark_rapids_ml_tpu.ops.kmeans_kernels import pairwise_sq_dists
+
+    def tr_body(eps, X, m, c):
+        nchunks = X.shape[0] // csize
+
+        def chunk(i, acc):
+            xc = jax.lax.dynamic_slice(X, (i * csize, 0), (csize, N_COLS))
+            d2 = pairwise_sq_dists(xc, c * (1.0 + eps), matmul_dtype=mm)
+            return acc + jnp.argmin(d2, axis=1).astype(jnp.float32).sum()
+
+        return jnp.stack(
+            [jax.lax.fori_loop(0, nchunks, chunk, jnp.float32(0.0)),
+             jnp.float32(0.0)]
+        )
+
+    t_tr = _time_scanned_fits(
+        tr_body, lambda rep: (X, mask, centers0 + jnp.float32(rep * 1e-6))
+    )
     # FLOPs are spent on padded rows; throughput counts real samples only
     flops = 2.0 * X.shape[0] * KMEANS_K * N_COLS * iters
     n = N_ROWS
     return {
         "samples_per_sec_per_chip": n * iters / t / n_chips,
         "fit_seconds": t,
+        "transform_seconds": t_tr,
+        "transform_samples_per_sec_per_chip": n / t_tr / n_chips,
         "iters": iters,
         "matmul_dtype": km_dtype,
         "flops_model": flops,
@@ -386,12 +426,34 @@ def bench_logreg(X, mask, y, mesh, n_chips):
         ),
         timed,
     )
+    # transform path: one decision sweep (X @ w > 0) — the compute of
+    # LogisticRegressionModel.transform's prediction column
+    w_t = jnp.asarray(
+        np.random.default_rng(9).standard_normal(N_COLS), jnp.float32
+    )
+
+    def tr_body(eps, X, m, y):
+        z = X @ (w_t * (1.0 + eps))
+        return _checksum((z > 0).astype(jnp.float32) * m)
+
+    t_tr = _time_scanned_fits(
+        tr_body,
+        lambda rep: (Xb, mb * jnp.float32(1.0 + rep * 1e-6), yb),
+    )
     # ~2 objective evals/iter (step + line search), fwd+grad = 4*n*d each
     flops = 8.0 * n_rows * N_COLS * iters
     return {
+        # throughput is PER ITERATION (samples x iters / s): the
+        # reference benchmark runs maxIter=200 tol=1e-30
+        # (run_benchmark.sh:126-135) while this leg runs 20 iterations —
+        # per-iter normalization makes the numbers comparable, and
+        # per_iter=true in the JSON says so explicitly
         "samples_per_sec_per_chip": n_rows * iters / t / n_chips,
         "fit_seconds": t,
+        "transform_seconds": t_tr,
+        "transform_samples_per_sec_per_chip": n_rows / t_tr / n_chips,
         "iters": iters,
+        "per_iter": True,
         "rows": n_rows,
         "objective_dtype": obj_dtype,
         "flops_model": flops,
@@ -423,11 +485,25 @@ def bench_linreg(X, mask, y, mesh, n_chips):
         fit_body,
         lambda rep: (X, mask * jnp.float32(1.0 + rep * 1e-6), y),
     )
+    # transform path: one prediction sweep (X @ w + b)
+    w_t = jnp.asarray(
+        np.random.default_rng(9).standard_normal(N_COLS), jnp.float32
+    )
+
+    def tr_body(eps, X, m, y):
+        return _checksum(X @ (w_t * (1.0 + eps)))
+
+    t_tr = _time_scanned_fits(
+        tr_body,
+        lambda rep: (X, mask * jnp.float32(1.0 + rep * 1e-6), y),
+    )
     n = N_ROWS
     flops = 2.0 * n * N_COLS * N_COLS
     return {
         "samples_per_sec_per_chip": n / t / n_chips,
         "fit_seconds": t,
+        "transform_seconds": t_tr,
+        "transform_samples_per_sec_per_chip": n / t_tr / n_chips,
         "inner_fits_per_dispatch": INNER_FITS,
         "flops_model": flops,
         "baseline_samples_per_sec": 1.1e8,
@@ -566,6 +642,46 @@ def bench_rf(X, mask, y, mesh, n_chips):
             break
     t = min(times)
     n_trees = trees_per_dev * n_dp
+    # transform path: batched level-synchronous descent + leaf-probability
+    # vote over the FULL forest width (one built group's trees tiled to
+    # n_trees — apply cost is content-independent). Raw thresholds come
+    # from the same edges lookup the model applies.
+    from spark_rapids_ml_tpu.ops.tree_kernels import rf_classify
+
+    grp = jax.jit(
+        lambda b, m, s, kg: build_forest(b, m, s, kg, mesh=mesh, cfg=cfg)
+    )(bins, ms, stats, warm_keys)
+    feat_g = grp["feature"].reshape(-1, grp["feature"].shape[-1])
+    thr_b = grp["threshold_bin"].reshape(feat_g.shape)
+    leafs = grp["leaf_stats"].reshape(feat_g.shape + (2,))
+    reps_t = -(-n_trees // feat_g.shape[0])
+
+    def prep(feat_g, thr_b, leafs, edges):
+        fi = jnp.clip(feat_g, 0, edges.shape[0] - 1)
+        bi = jnp.clip(thr_b, 0, edges.shape[1] - 1)
+        thr = jnp.take_along_axis(
+            edges[fi].reshape(fi.shape + (-1,)), bi[..., None], axis=-1
+        )[..., 0]
+        prob = leafs / jnp.maximum(leafs.sum(-1, keepdims=True), 1e-12)
+        tile = lambda a: jnp.tile(a, (reps_t,) + (1,) * (a.ndim - 1))[:n_trees]
+        return tile(feat_g), tile(thr), tile(prob)
+
+    feat_t, thr_t, prob_t = jax.jit(prep)(feat_g, thr_b, leafs, edges)
+    jax.block_until_ready((feat_t, thr_t, prob_t))
+
+    def tr_fn(Xs, feat_t, thr_t, prob_t):
+        return _checksum(
+            rf_classify(Xs, feat_t, thr_t, prob_t, max_depth=RF_DEPTH)[0]
+        )
+
+    tr_timed = jax.jit(tr_fn)
+    np.asarray(tr_timed(Xs, feat_t, thr_t, prob_t))  # compile
+    t_tr, _ = _best_time(
+        lambda rep: (
+            Xs * jnp.float32(1.0 + (rep + 1) * 1e-6), feat_t, thr_t, prob_t
+        ),
+        tr_timed,
+    )
     # updates model: one histogram update per (row, sampled feature, stat,
     # level) — both sides of the comparison pay k_features per node, so
     # the A10G atomics baseline divides by the same per-sample cost
@@ -573,6 +689,8 @@ def bench_rf(X, mask, y, mesh, n_chips):
     return {
         "samples_per_sec_per_chip": n_rf * n_trees / t / n_chips,
         "fit_seconds": t,
+        "transform_seconds": t_tr,
+        "transform_samples_per_sec_per_chip": n_rf / t_tr / n_chips,
         "trees": n_trees,
         "rows": n_rf,
         "k_features": k_feat,
@@ -592,7 +710,10 @@ def bench_knn(X, mask, mesh, n_chips):
 
     Baseline model: brute-force knn is matmul-bound (2*nq*ni*d FLOPs);
     A10G ~15 TFLOP/s effective -> 15e12 / (2*1e6*256) ~= 2.9e4
-    queries/sec/GPU at these shapes."""
+    queries/sec/GPU at these shapes. The model credits the GPU the FULL
+    matmul rate and charges it NOTHING for its own top-k/merge passes —
+    i.e. the baseline is optimistic-for-the-GPU, so vs_baseline here is
+    a FLOOR on the true ratio (recorded as baseline_kind)."""
     import jax
     import jax.numpy as jnp
 
@@ -628,6 +749,82 @@ def bench_knn(X, mask, mesh, n_chips):
         "queries": nq,
         "flops_model": flops,
         "baseline_samples_per_sec": 15e12 / (2.0 * ni * N_COLS),
+        "baseline_kind": "gpu-optimistic-floor",
+    }
+
+
+UMAP_ROWS = int(os.environ.get("BENCH_UMAP_ROWS", 65_536))
+UMAP_NEIGHBORS = 15
+
+
+def bench_umap(mesh, n_chips):
+    """UMAP end-to-end through the estimator (the reference benchmarks
+    UMAP the same way and scores trustworthiness:
+    ``python/benchmark/benchmark/bench_umap.py``).
+
+    Pipeline timed: brute-force kNN graph (device) -> fuzzy simplicial
+    set (host symmetrization of n*k entries) -> spectral init ->
+    negative-sampling SGD (device). Data is host-side blobs (~64 MB at
+    64k x 256) — the one entry where ingest rides inside fit, as it does
+    in the reference's Spark flow; at these sizes the transfer is a few
+    seconds of the multi-ten-second fit.
+
+    Baseline model: cuML UMAP on A10G completes datasets of this size
+    (64k x 256, NN-descent + SGD) in roughly 5-10 s in published RAPIDS
+    benchmarks -> ~1e4 samples/s/GPU. This is a coarse measured-ratio
+    PROXY, not a roofline — recorded as baseline_kind="proxy".
+
+    flops_model counts the brute kNN graph (2*n^2*d), the dominant
+    device compute of this implementation; MFU is indicative only.
+    """
+    from sklearn.manifold import trustworthiness
+
+    from spark_rapids_ml_tpu.data import DataFrame as TDF
+    from spark_rapids_ml_tpu.umap import UMAP
+
+    n, d = UMAP_ROWS, N_COLS
+    rng = np.random.default_rng(3)
+    centers = rng.normal(size=(32, d)).astype(np.float32) * 4.0
+    lab = rng.integers(0, 32, size=n)
+    Xh = (centers[lab] + rng.normal(size=(n, d))).astype(np.float32)
+    df = TDF({"features": Xh})
+
+    est = UMAP(n_neighbors=UMAP_NEIGHBORS, random_state=42)
+    # warm pass at FULL size first: the kNN-graph/SGD executables are
+    # shape-specialized, so only a same-shape fit excludes compile time
+    # from the timed pass (every other leg warms the same way);
+    # BENCH_UMAP_WARM=0 skips when wall-clock budget is tight
+    if os.environ.get("BENCH_UMAP_WARM", "1") != "0":
+        est.fit(df)
+    t0 = time.perf_counter()
+    model = est.fit(df)
+    t_fit = time.perf_counter() - t0
+    emb = np.asarray(model.embedding_)
+
+    model.transform(df)  # warm transform executables
+    t0 = time.perf_counter()
+    out = model.transform(df)
+    emb_t = np.asarray(out["embedding"])
+    t_tr = time.perf_counter() - t0
+    assert emb_t.shape[0] == n
+
+    # quality: trustworthiness on a subsample (the reference's score;
+    # exact trust is O(sub^2) host work)
+    sub = rng.choice(n, size=min(4096, n), replace=False)
+    trust = float(
+        trustworthiness(Xh[sub], emb[sub], n_neighbors=UMAP_NEIGHBORS)
+    )
+
+    return {
+        "samples_per_sec_per_chip": n / t_fit / n_chips,
+        "fit_seconds": t_fit,
+        "transform_seconds": t_tr,
+        "transform_samples_per_sec_per_chip": n / t_tr / n_chips,
+        "rows": n,
+        "trustworthiness": round(trust, 4),
+        "flops_model": 2.0 * float(n) * n * d,
+        "baseline_samples_per_sec": 1.0e4,
+        "baseline_kind": "proxy",
     }
 
 
@@ -684,6 +881,69 @@ def bench_pca_stream(mesh, n_chips):
     t0 = time.perf_counter()
     run(rows)
     t = time.perf_counter() - t0
+
+    # Decomposition (round-3 verdict: the artifact alone must distinguish
+    # "tunnel-bound" from "streaming kernels are slow"):
+    # (a) device math only — fold ONE device-resident chunk repeatedly
+    #     through both passes' steps (no H2D inside the timed loop);
+    # (b) ingest only — stream + transfer every chunk but fold it with a
+    #     trivial (read-proving) step.
+    # overlap_efficiency = (a + b - total) / min(a, b), clipped to [0, 1]:
+    # 1.0 means the slower leg fully hides the faster one.
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.data.chunks import Chunk
+    from spark_rapids_ml_tpu.ops.streaming import (
+        StreamGuard, gram2_init, gram2_step, moments1_init, moments1_step,
+        put_chunk,
+    )
+
+    n_chunks = max(1, rows // chunk_rows)
+    dev = put_chunk(Chunk(X=block, n_valid=chunk_rows), mesh, np.float32)
+    jax.block_until_ready([v for v in dev.values() if v is not None])
+    mean0 = jnp.zeros((d,), jnp.float32)
+
+    def math_pass():
+        acc1 = moments1_init(d, jnp.float32, False)
+        for _ in range(n_chunks):
+            acc1 = moments1_step(acc1, dev["X"], dev["mask"])
+        np.asarray(jnp.ravel(acc1["sum_x"])[:1])
+        acc2 = gram2_init(d, jnp.float32, False)
+        for _ in range(n_chunks):
+            acc2 = gram2_step(acc2, dev["X"], dev["mask"], mean0)
+        np.asarray(jnp.ravel(acc2["G"])[:1])
+
+    math_pass()  # compile
+    t0 = time.perf_counter()
+    math_pass()
+    t_math = time.perf_counter() - t0
+
+    import functools
+
+    import jax as _jax
+
+    @functools.partial(_jax.jit, donate_argnums=(0,))
+    def _touch(acc, Xc, m):
+        return acc + (Xc[0, :8].astype(jnp.float32) * m[:8]).sum()
+
+    def ingest_pass():
+        src = GeneratorChunkSource(gen, rows, d)
+        for _pass in range(2):
+            acc = jnp.float32(0.0)
+            guard = StreamGuard()
+            for chunk in src.iter_chunks(chunk_rows, np.float32):
+                devc = put_chunk(chunk, mesh, np.float32)
+                acc = _touch(acc, devc["X"], devc["mask"])
+                guard.tick(devc, acc)
+            guard.flush(acc)
+
+    t0 = time.perf_counter()
+    ingest_pass()
+    t_ingest = time.perf_counter() - t0
+    overlap = max(
+        0.0, min(1.0, (t_math + t_ingest - t) / max(min(t_math, t_ingest), 1e-9))
+    )
+
     flops = 2.0 * rows * d * d  # pass-2 Gram dominates
     stream_gb = rows * d * 4 * 2 / 1e9  # 2 passes
     # The stream fit ingests host data every chunk; when the effective
@@ -696,6 +956,10 @@ def bench_pca_stream(mesh, n_chips):
         "rows": rows,
         "stream_gb": round(stream_gb, 2),
         "ingest_gbps": round(ingest_gbps, 3),
+        "device_math_seconds": round(t_math, 4),
+        "device_math_samples_per_sec": round(rows / max(t_math, 1e-9), 1),
+        "ingest_seconds": round(t_ingest, 4),
+        "overlap_efficiency": round(overlap, 3),
         "flops_model": flops,
         "baseline_samples_per_sec": 1.1e8,
         "tunnel_bound": ingest_gbps < 1.0,
@@ -784,7 +1048,9 @@ def main() -> None:
         # the caller pinned a size explicitly
         N_ROWS = min(N_ROWS, 50_000)
         CSIZE = _csize(N_ROWS)
-        global RF_ROWS, RF_TREES, RF_DEPTH, KNN_QUERIES, KNN_ITEMS
+        global RF_ROWS, RF_TREES, RF_DEPTH, KNN_QUERIES, KNN_ITEMS, UMAP_ROWS
+        if "BENCH_UMAP_ROWS" not in os.environ:
+            UMAP_ROWS = 2048
         if "BENCH_KNN_QUERIES" not in os.environ:
             KNN_QUERIES = 512
         if "BENCH_KNN_ITEMS" not in os.environ:
@@ -818,6 +1084,7 @@ def main() -> None:
         "linreg": lambda: bench_linreg(X, mask, y, mesh, n_chips),
         "rf": lambda: bench_rf(X, mask, y, mesh, n_chips),
         "knn": lambda: bench_knn(X, mask, mesh, n_chips),
+        "umap": lambda: bench_umap(mesh, n_chips),
         "pca_stream": lambda: bench_pca_stream(mesh, n_chips),
     }
     # BENCH_ONLY=rf,kmeans : run a subset (tuning loops); full runs only
@@ -932,9 +1199,13 @@ def _emit_line(results, meta, watchdog_tripped):
     # provenance scalars each entry may carry (configuration that actually
     # ran — dtype fallbacks, tree counts, dispatch amortization)
     _extras = (
-        "iters", "trees", "rows", "queries", "objective_dtype",
+        "iters", "per_iter", "trees", "rows", "queries", "objective_dtype",
         "matmul_dtype", "inner_fits_per_dispatch", "ingest_gbps",
         "stream_gb", "overlapped_abandoned", "k_features",
+        "device_math_seconds", "device_math_samples_per_sec",
+        "ingest_seconds", "overlap_efficiency",
+        "transform_seconds", "transform_samples_per_sec_per_chip",
+        "trustworthiness", "baseline_kind",
     )
     for name, r in results.items():
         line[name] = {

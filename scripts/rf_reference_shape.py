@@ -61,13 +61,21 @@ def main() -> None:
     from spark_rapids_ml_tpu.parallel.mesh import make_mesh
 
     n, d, nb = args.rows, args.cols, args.bins
-    d_pad = next_pow2(d)
+    # lane-aligned (not pow2) feature padding: the compact+subset build
+    # path only needs d_pad % 4 == 0 (word packing) and clipping room for
+    # take_along_axis; 3000 -> 3072 instead of 4096 keeps the resident
+    # binned matrix at 3.2 GB instead of 4.3 GB — the tunnel chip exposes
+    # only ~8 GB HBM (probed round 4), and the pow2 pad OOMed the fit
+    d_pad = -(-d // 256) * 256
     k = _resolve_k_features("auto", d, True)
     mesh = make_mesh(len(jax.devices()))
     n_dp = mesh.shape["dp"]
     sh = NamedSharding(mesh, P("dp"))
 
-    rows_per_chunk = 65_536
+    # small generation chunks: the (chunk, 3000) f32 block plus the i32
+    # searchsorted output are ~800 MB at 16k rows — transients must fit
+    # beside the 3.2 GB binned matrix in ~8 GB visible HBM
+    rows_per_chunk = 16_384
     gchunk = rows_per_chunk * n_dp
     n_pad = ((n + gchunk - 1) // gchunk) * gchunk
     w_true = jnp.asarray(
@@ -84,33 +92,52 @@ def main() -> None:
 
     t0 = time.perf_counter()
 
-    def gen_binized(key, w):
-        """Chunked generate -> binize -> discard raw rows."""
+    # Chunked generate -> binize -> place, as SEPARATE small programs
+    # with a DONATED placement buffer. A single fori-loop program holds
+    # the (n_pad, d_pad) carry double-buffered — at 1M x 3072 that is
+    # 2 x 3.1 GB the tunnel backend then keeps resident into the fit,
+    # which OOMed the ~8 GB visible HBM (round-4 bisection; each stage
+    # runs alone, gen-then-fit faulted). Donation keeps the peak at one
+    # binned matrix + one 16k-row piece. NOTE: every device array the
+    # jits touch rides as an ARGUMENT — a jit-captured device constant
+    # (the original `edges` closure) deterministically faulted this
+    # backend.
+    import functools
 
-        def body(i, carry):
-            bins_all, stats_all = carry
-            blk = jax.random.normal(
-                jax.random.fold_in(key, i), (gchunk, d), jnp.float32
-            )
-            y = (blk @ w > 0).astype(jnp.float32)
-            b = jnp.searchsorted(edges, blk, side="right").astype(jnp.uint8)
-            b = jnp.pad(b, ((0, 0), (0, d_pad - d)))
-            st = jnp.stack([1.0 - y, y], axis=1)
-            return (
-                lax.dynamic_update_slice_in_dim(bins_all, b, i * gchunk, 0),
-                lax.dynamic_update_slice_in_dim(stats_all, st, i * gchunk, 0),
-            )
-
-        bins_all = jnp.zeros((n_pad, d_pad), jnp.uint8)
-        stats_all = jnp.zeros((n_pad, 2), jnp.float32)
-        bins_all, stats_all = lax.fori_loop(
-            0, n_pad // gchunk, body, (bins_all, stats_all)
+    def _piece(key, i, w, edges):
+        blk = jax.random.normal(
+            jax.random.fold_in(key, i), (gchunk, d), jnp.float32
         )
-        mask = (jnp.arange(n_pad) < n).astype(jnp.float32)
-        return bins_all, stats_all * mask[:, None], mask
+        y = (blk @ w > 0).astype(jnp.float32)
+        b = jnp.searchsorted(edges, blk, side="right").astype(jnp.uint8)
+        b = jnp.pad(b, ((0, 0), (0, d_pad - d)))
+        return b, jnp.stack([1.0 - y, y], axis=1)
 
-    gen = jax.jit(gen_binized, out_shardings=(sh, sh, sh))
-    bins, stats, mask = gen(jax.random.key(11), w_true)
+    gen_piece = jax.jit(_piece, out_shardings=(sh, sh))
+
+    @functools.partial(jax.jit, donate_argnums=(0,), out_shardings=sh)
+    def place(ba, piece, i):
+        return lax.dynamic_update_slice_in_dim(ba, piece, i * gchunk, 0)
+
+    zeros_u8 = jax.jit(
+        lambda: jnp.zeros((n_pad, d_pad), jnp.uint8), out_shardings=sh
+    )
+    zeros_f32 = jax.jit(
+        lambda: jnp.zeros((n_pad, 2), jnp.float32), out_shardings=sh
+    )
+    bins, stats = zeros_u8(), zeros_f32()
+    key0 = jax.random.key(11)
+    for i in range(n_pad // gchunk):
+        b, st = gen_piece(key0, jnp.int32(i), w_true, edges)
+        bins = place(bins, b, jnp.int32(i))
+        stats = place(stats, st, jnp.int32(i))
+    mask_fn = jax.jit(
+        lambda: (jnp.arange(n_pad) < n).astype(jnp.float32), out_shardings=sh
+    )
+    mask = mask_fn()
+    stats = jax.jit(
+        lambda s, m: s * m[:, None], donate_argnums=(0,), out_shardings=sh
+    )(stats, mask)
     jax.block_until_ready(bins)
     t_gen = time.perf_counter() - t0
     print(f"[rf-demo] binned data ready in {t_gen:.1f}s "

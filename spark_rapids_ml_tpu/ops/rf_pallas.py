@@ -54,14 +54,16 @@ FORCE_INTERPRET = False
 _LOWERING_OK: dict = {}
 
 
+# Rows per grid block — FIXED so callers can size padded row counts
+# independently of the (chunked) feature width. The (R, k*nb) one-hot and
+# bl residents cap at ~48 MB at the max supported W (k*nb <= 8192,
+# enforced by rf_hist_pallas_ok; wider levels must feature-chunk), inside
+# the 100 MB vmem budget; the probe has the final word per shape.
+BLOCK_ROWS = 512
+
+
 def _block_rows(k: int, nb: int) -> int:
-    """Rows per grid block: the (R, k*nb) one-hot is the VMEM resident —
-    keep two copies (+ bl) of it under ~40 MB."""
-    W = k * nb
-    for R in (512, 256, 128):
-        if 3 * R * W * 4 <= 40 * 1024 * 1024:
-            return R
-    return 128
+    return BLOCK_ROWS
 
 
 def rf_hist_pallas_ok(
@@ -83,7 +85,8 @@ def rf_hist_pallas_ok(
         # Mosaic block rule: the (L*S, W) output block's sublane dim must
         # be a multiple of 8 once the grid has more than one block
         and (R // r_sub) * S % 8 == 0
-        and 3 * R * k * nb * 4 <= 40 * 1024 * 1024
+        # one-hot width cap: wider levels feature-chunk down to this
+        and k * nb <= 8192
     )
     if ok and not FORCE_INTERPRET:
         ok = _probe_lowering(k, nb, S, r_sub, R, variance)

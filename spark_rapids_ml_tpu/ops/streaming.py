@@ -106,6 +106,83 @@ class StreamGuard:
             self._sync_and_release(acc)
 
 
+def prefetch_chunks(it, depth: Optional[int] = None):
+    """Background-thread chunk prefetch (double buffering).
+
+    The streaming loops alternate host work (parquet decode / synthetic
+    gen in ``iter_chunks``) with device work (transfer + step) and
+    periodic StreamGuard syncs that BLOCK the host. Without prefetch the
+    host sits idle during those waits and the device sits idle during
+    decode — serial. A bounded producer thread decodes chunk i+1 (and
+    i+2, ...) while the main thread transfers/folds chunk i, so wall
+    time approaches max(decode, device) instead of their sum
+    (asserted by ``tests/test_streaming.py`` on a synthetic slow source).
+
+    ``depth`` bounds look-ahead (host memory: depth chunk buffers).
+    TPUML_STREAM_PREFETCH=0 disables (returns ``it`` unchanged); the
+    env value otherwise sets the default depth (2).
+
+    Early consumer exit (exception mid-loop) sets a cancel flag the
+    producer polls between puts, so the daemon thread cannot wedge on a
+    full queue holding the source open.
+    """
+    if depth is None:
+        raw = _os.environ.get("TPUML_STREAM_PREFETCH", "2")
+        try:
+            depth = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"TPUML_STREAM_PREFETCH must be an integer, got {raw!r}"
+            )
+    if depth <= 0:
+        yield from it
+        return
+
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    end = object()
+    cancel = threading.Event()
+    err: list = []
+
+    def worker():
+        try:
+            for c in it:
+                while not cancel.is_set():
+                    try:
+                        q.put(c, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if cancel.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised on consumer
+            err.append(e)
+        finally:
+            while not cancel.is_set():
+                try:
+                    q.put(end, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    th = threading.Thread(
+        target=worker, name="tpuml-chunk-prefetch", daemon=True
+    )
+    th.start()
+    try:
+        while True:
+            c = q.get()
+            if c is end:
+                break
+            yield c
+        if err:
+            raise err[0]
+    finally:
+        cancel.set()
+
+
 def put_chunk(
     chunk: Chunk, mesh, dtype, *, need_y: bool = True, need_w: bool = True
 ) -> Dict[str, Optional[jax.Array]]:
@@ -359,7 +436,7 @@ def streamed_suffstats(
 
     acc1 = moments1_init(d, dtype, with_y)
     guard = StreamGuard()
-    for chunk in source.iter_chunks(chunk_rows, np_dtype):
+    for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
         dev = put_chunk(chunk, mesh, dtype, need_y=with_y)
         rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
         acc1 = moments1_step(acc1, dev["X"], rw, dev["y"] if with_y else None)
@@ -383,7 +460,7 @@ def streamed_suffstats(
 
     acc2 = gram2_init(d, dtype, with_y)
     guard = StreamGuard()
-    for chunk in source.iter_chunks(chunk_rows, np_dtype):
+    for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
         dev = put_chunk(chunk, mesh, dtype, need_y=with_y)
         rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
         acc2 = gram2_step(
@@ -454,7 +531,7 @@ def streamed_logreg_fit(
     # pass 1: n + feature means (partials allreduced across processes)
     acc1 = moments1_init(d, dtype, with_y=False)
     guard = StreamGuard()
-    for chunk in source.iter_chunks(chunk_rows, np_dtype):
+    for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
         dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
         acc1 = moments1_step(acc1, dev["X"], dev["mask"])
         guard.tick(dev, acc1)
@@ -468,7 +545,7 @@ def streamed_logreg_fit(
         # reference's denominator (``classification.py:1024-1026``)
         vacc = jnp.zeros((d,), dtype)
         guard = StreamGuard()
-        for chunk in source.iter_chunks(chunk_rows, np_dtype):
+        for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
             dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
             vacc = var_chunk_step(vacc, dev["X"], dev["mask"], mean)
             guard.tick(dev, vacc)
@@ -491,7 +568,7 @@ def streamed_logreg_fit(
         wd = jnp.asarray(w_np, dtype)
         acc = {"f": jnp.zeros((), dtype), "g": jnp.zeros((p,), dtype)}
         guard = StreamGuard()
-        for chunk in source.iter_chunks(chunk_rows, np_dtype):
+        for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
             dev = put_chunk(chunk, mesh, dtype, need_w=False)
             acc = logreg_chunk_vg_step(
                 acc, dev["X"], dev["mask"], dev["y"], wd, mean_dev, inv_std,
@@ -566,7 +643,7 @@ def streamed_kmeans_lloyd(
             "cost": jnp.zeros((), dtype),
         }
         guard = StreamGuard()
-        for chunk in source.iter_chunks(chunk_rows, np_dtype):
+        for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
             dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
             acc = kmeans_chunk_step(acc, dev["X"], dev["mask"], cts, matmul_dtype=mm)
             guard.tick(dev, acc)
@@ -696,7 +773,7 @@ def streamed_min_sq_dists_update(
     )
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     offset = 0
-    for chunk in source.iter_chunks(chunk_rows, np_dtype):
+    for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
         dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
         d2 = np.asarray(
             chunk_min_sq_dists(dev["X"], dev["mask"], cands_dev), np.float64
@@ -725,7 +802,7 @@ def streamed_count_closest(
     counts = jnp.zeros((cands.shape[0],), jnp.int32)
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     guard = StreamGuard()
-    for chunk in source.iter_chunks(chunk_rows, np_dtype):
+    for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
         dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
         counts = count_closest_chunk_step(counts, dev["X"], dev["mask"], cands_dev)
         guard.tick(dev, counts)

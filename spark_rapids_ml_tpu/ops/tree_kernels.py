@@ -270,6 +270,7 @@ def _hist_compact(
     r_sub: int,
     n_pad: int,           # from the caller's eligibility gate: the SAME
                           # block-aligned padded row count it validated
+    f_chunk: int,         # feature-chunk width (gate-validated, divides F)
     variance: bool,
     interpret=None,
 ):
@@ -336,15 +337,30 @@ def _hist_compact(
     binq = hist_src[src2].astype(jnp.int32)                 # (n_pad, F)
     swq = sw[src2] * pvalid[:, None].astype(sw.dtype)       # (n_pad, S)
 
-    partials = subblock_hist(
-        binq, swq, n_bins=nb, r_sub=r_sub, variance=variance,
-        interpret=interpret,
-    )                                                       # (n_sb, S, W)
-    hist_nodes = jax.ops.segment_sum(
-        partials.reshape(n_sb, S * W),
-        jnp.where(seg_sb < n_nodes, seg_sb, n_nodes),
-        num_segments=n_nodes + 1,
-    )[:n_nodes].reshape(n_nodes, S, F, nb)
+    # feature-chunked kernel+reduce: the (n_sb, S, Fc*nb) partials are
+    # the big transient (1.3 GB at the 1M x 3000 reference shape in one
+    # shot) — bound them to ~256 MB; the gathers above happen ONCE and
+    # chunks just slice binq
+    seg_red = jnp.where(seg_sb < n_nodes, seg_sb, n_nodes)
+    Fc = f_chunk
+    hist_parts = []
+    for c0 in range(0, F, Fc):
+        partials = subblock_hist(
+            binq[:, c0 : c0 + Fc], swq, n_bins=nb, r_sub=r_sub,
+            variance=variance, interpret=interpret,
+        )                                                   # (n_sb, S, Fc*nb)
+        hist_parts.append(
+            jax.ops.segment_sum(
+                partials.reshape(n_sb, S * Fc * nb),
+                seg_red,
+                num_segments=n_nodes + 1,
+            )[:n_nodes].reshape(n_nodes, S, Fc, nb)
+        )
+    hist_nodes = (
+        hist_parts[0]
+        if len(hist_parts) == 1
+        else jnp.concatenate(hist_parts, axis=2)
+    )                                                       # (n_nodes, S, F, nb)
     parent = hist_nodes[:, :, 0, :].sum(axis=-1)            # (n_nodes, S)
     hist = hist_nodes.transpose(2, 0, 3, 1)                 # (F, n_nodes, nb, S)
     return hist, parent
@@ -511,24 +527,35 @@ def _build_tree(
         # lowering. Wins by ~8x per level over the scatter wall at the
         # bench shape (scripts/rf_deep_microbench2.py), on every level —
         # scatter cost is n-bound, so shallow levels paid it too.
-        from .rf_pallas import _block_rows, rf_hist_pallas_ok
+        from .rf_pallas import BLOCK_ROWS, rf_hist_pallas_ok
 
-        R_blk = _block_rows(d_hist, nb)
-        r_sub = _compact_r_sub(n, n_nodes, R_blk, S)
-        n_pad_c = -(-(n + (n_nodes + 1) * r_sub) // R_blk) * R_blk
+        r_sub = _compact_r_sub(n, n_nodes, BLOCK_ROWS, S)
+        n_pad_c = -(-(n + (n_nodes + 1) * r_sub) // BLOCK_ROWS) * BLOCK_ROWS
+        n_sb_c = n_pad_c // r_sub
+        # feature chunk: largest power of two satisfying the kernel's
+        # one-hot width cap (Fc*nb <= 8192) AND a ~256 MB partials
+        # transient budget (the 1M x 3000 reference shape OOMed a ~7 GB
+        # tunnel chip with single-shot partials); must divide d_hist
+        Fc = 1 << max(0, min(d_hist, 8192 // nb).bit_length() - 1)
+        while Fc > 1 and (
+            d_hist % Fc != 0 or n_sb_c * S * Fc * nb * 4 > (256 << 20)
+        ):
+            Fc //= 2
         use_compact = (
             cfg.hist_strategy in ("auto", "compact")
             and dt == jnp.float32
+            and d_hist % Fc == 0
             and n_nodes * d_hist * nb * S <= (1 << 28)
             and rf_hist_pallas_ok(
-                n_pad_c, d_hist, nb, S, r_sub,
+                n_pad_c, Fc, nb, S, r_sub,
                 variance=(cfg.impurity == "variance"),
             )
         )
         if use_compact:
             hist_full, parent = _hist_compact(
                 hist_src, seg, sw, n_nodes=n_nodes, nb=nb, r_sub=r_sub,
-                n_pad=n_pad_c, variance=(cfg.impurity == "variance"),
+                n_pad=n_pad_c, f_chunk=Fc,
+                variance=(cfg.impurity == "variance"),
             )
         else:
             parent = jax.ops.segment_sum(sw, seg, num_segments=n_nodes + 1)[
@@ -546,14 +573,29 @@ def _build_tree(
                     jnp.arange(d_hist, dtype=jnp.int32)[:, None],
                     (d_hist, n_nodes),
                 )
-            bg, bf, bb = _best_splits_from_hist(
-                hist_full, parent, pcount, pimp, realf_full, nb, cfg
-            )
-            # match the chunked paths bit-for-bit: nodes with no finite
-            # gain keep the (0, 0) feature/bin the chunk-scan init carries
-            fin = bg > -jnp.inf
-            bf = jnp.where(fin, bf, 0)
-            bb = jnp.where(fin, bb, 0)
+            # gain search in feature-slot chunks: holding the full
+            # (F, n_nodes, nb, S) histogram once is fine, but the
+            # cumsum/left/right/gain chain materializes several copies of
+            # the tile — ~1.5 GB of transients at the reference shape on
+            # a tunnel chip with ~8 GB visible HBM. Chunk merging uses
+            # the same init and strict-> update as the chunk-scan path,
+            # so results (including the (0, 0) feature/bin of no-gain
+            # nodes and first-slot tie-breaking) stay bit-identical.
+            Fc = d_hist
+            while Fc > 1 and Fc * n_nodes * nb * S > 4 * _HIST_BUDGET:
+                Fc //= 2
+            bg = jnp.full((n_nodes,), -jnp.inf, dt)
+            bf = jnp.zeros((n_nodes,), jnp.int32)
+            bb = jnp.zeros((n_nodes,), jnp.int32)
+            for c0 in range(0, d_hist, Fc):
+                g, f, b = _best_splits_from_hist(
+                    hist_full[c0 : c0 + Fc], parent, pcount, pimp,
+                    realf_full[c0 : c0 + Fc], nb, cfg,
+                )
+                upd = g > bg
+                bg = jnp.where(upd, g, bg)
+                bf = jnp.where(upd, f, bf)
+                bb = jnp.where(upd, b, bb)
         else:
             # strategy per level (static). Subset path: the gathered operand is
             # only k_pad wide, and measured v5e scatter on it is ~2.2 ms/level

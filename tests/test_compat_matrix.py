@@ -1,0 +1,222 @@
+"""Cross-product compat matrix: every algorithm family smoke-fitted over
+feature_type {vector, multi_cols} x dtype {f32, f64} x {resident,
+streaming} (streaming where the estimator supports it).
+
+The reference crosses its per-algorithm suites over feature_type x dtype
+x batch sizes (e.g. ``/root/reference/python/tests/test_pca.py:297-302``,
+``test_logistic_regression.py:427-437``); the per-algorithm suites here
+carry the deep oracles while this module guarantees every family accepts
+every input configuration and produces sane output — the combinations a
+single-path suite silently never exercises.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import (
+    LogisticRegression,
+    RandomForestClassifier,
+)
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.knn import NearestNeighbors
+from spark_rapids_ml_tpu.regression import LinearRegression, RandomForestRegressor
+from spark_rapids_ml_tpu.umap import UMAP
+
+N, D = 384, 8
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    basis = rng.normal(size=(3, D))
+    X = rng.normal(size=(N, 3)) @ basis + 0.05 * rng.normal(size=(N, D))
+    w_true = rng.normal(size=D)
+    y_reg = X @ w_true + 0.05 * rng.normal(size=N)
+    y_cls = (y_reg > np.median(y_reg)).astype(np.float64)
+    return X, y_reg, y_cls
+
+
+def _frame(X, y, feature_type, np_dtype, n_partitions=2):
+    """DataFrame in the requested layout; returns (df, features arg)."""
+    Xc = X.astype(np_dtype)
+    cols = {}
+    if feature_type == "vector":
+        cols["features"] = Xc
+        feat = "features"
+    else:
+        feat = [f"f{i}" for i in range(D)]
+        for i, c in enumerate(feat):
+            cols[c] = Xc[:, i].copy()
+    if y is not None:
+        cols["label"] = y.astype(np_dtype)
+    return DataFrame(cols, n_partitions), feat
+
+
+def _feat_kwargs(est, feat):
+    if isinstance(feat, list):
+        est.setFeaturesCols(feat)
+    else:
+        est.setFeaturesCol(feat)
+    return est
+
+
+MATRIX = [
+    (ft, dt, mode)
+    for ft in ("vector", "multi_cols")
+    for dt in (np.float32, np.float64)
+    for mode in ("resident", "streaming")
+    # streaming requires a single vector features column
+    if not (mode == "streaming" and ft == "multi_cols")
+]
+_IDS = [
+    f"{ft}-{np.dtype(dt).name}-{mode}" for ft, dt, mode in MATRIX
+]
+
+
+def _framework_kwargs(dt, mode):
+    kw = {"num_workers": 2, "float32_inputs": dt == np.float32}
+    if mode == "streaming":
+        kw.update(streaming=True, stream_chunk_rows=96)
+    return kw
+
+
+@pytest.mark.compat
+@pytest.mark.parametrize("ft,dt,mode", MATRIX, ids=_IDS)
+def test_pca_matrix(ft, dt, mode):
+    X, _, _ = _data(1)
+    df, feat = _frame(X, None, ft, dt)
+    est = _feat_kwargs(PCA(k=3, **_framework_kwargs(dt, mode)), feat)
+    model = est.fit(df)
+    assert np.asarray(model.components_).shape == (3, D)
+    assert sum(model.explained_variance_ratio_) > 0.95  # low-rank data
+    out = model.transform(df)
+    emb = np.asarray(out[model.getOutputCol()])
+    assert emb.shape == (N, 3) and np.isfinite(emb).all()
+
+
+@pytest.mark.compat
+@pytest.mark.parametrize("ft,dt,mode", MATRIX, ids=_IDS)
+def test_linreg_matrix(ft, dt, mode):
+    X, y, _ = _data(2)
+    df, feat = _frame(X, y, ft, dt)
+    est = _feat_kwargs(
+        LinearRegression(regParam=1e-6, **_framework_kwargs(dt, mode)), feat
+    )
+    model = est.fit(df)
+    pred = np.asarray(model.transform(df)["prediction"])
+    r2 = 1 - ((pred - y) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+    assert r2 > 0.99, r2
+
+
+@pytest.mark.compat
+@pytest.mark.parametrize("ft,dt,mode", MATRIX, ids=_IDS)
+def test_logreg_matrix(ft, dt, mode):
+    X, _, y = _data(3)
+    df, feat = _frame(X, y, ft, dt)
+    est = _feat_kwargs(
+        LogisticRegression(maxIter=40, **_framework_kwargs(dt, mode)), feat
+    )
+    model = est.fit(df)
+    acc = (np.asarray(model.transform(df)["prediction"]) == y).mean()
+    assert acc > 0.9, acc
+
+
+@pytest.mark.compat
+@pytest.mark.parametrize("ft,dt,mode", MATRIX, ids=_IDS)
+def test_kmeans_matrix(ft, dt, mode):
+    rng = np.random.default_rng(4)
+    centers = rng.normal(size=(4, D)) * 6
+    lab = rng.integers(0, 4, size=N)
+    X = centers[lab] + 0.3 * rng.normal(size=(N, D))
+    df, feat = _frame(X, None, ft, dt)
+    est = _feat_kwargs(
+        KMeans(k=4, seed=1, **_framework_kwargs(dt, mode)), feat
+    )
+    model = est.fit(df)
+    pred = np.asarray(model.transform(df)["prediction"]).astype(int)
+    # clustering must reproduce the generating partition up to relabeling
+    agree = 0
+    for c in range(4):
+        vals, counts = np.unique(pred[lab == c], return_counts=True)
+        agree += counts.max()
+    assert agree / N > 0.98
+
+
+RESIDENT = [(ft, dt) for ft in ("vector", "multi_cols") for dt in (np.float32, np.float64)]
+_RIDS = [f"{ft}-{np.dtype(dt).name}" for ft, dt in RESIDENT]
+
+
+@pytest.mark.compat
+@pytest.mark.parametrize("ft,dt", RESIDENT, ids=_RIDS)
+def test_rf_classifier_matrix(ft, dt):
+    X, _, y = _data(5)
+    df, feat = _frame(X, y, ft, dt)
+    est = _feat_kwargs(
+        RandomForestClassifier(
+            numTrees=8, maxDepth=5, seed=2,
+            **_framework_kwargs(dt, "resident"),
+        ),
+        feat,
+    )
+    model = est.fit(df)
+    acc = (np.asarray(model.transform(df)["prediction"]) == y).mean()
+    assert acc > 0.9, acc
+
+
+@pytest.mark.compat
+@pytest.mark.parametrize("ft,dt", RESIDENT, ids=_RIDS)
+def test_rf_regressor_matrix(ft, dt):
+    X, y, _ = _data(6)
+    df, feat = _frame(X, y, ft, dt)
+    est = _feat_kwargs(
+        RandomForestRegressor(
+            numTrees=8, maxDepth=6, seed=3,
+            **_framework_kwargs(dt, "resident"),
+        ),
+        feat,
+    )
+    model = est.fit(df)
+    pred = np.asarray(model.transform(df)["prediction"])
+    r2 = 1 - ((pred - y) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+    assert r2 > 0.8, r2
+
+
+@pytest.mark.compat
+@pytest.mark.parametrize("dt", [np.float32, np.float64], ids=["float32", "float64"])
+def test_knn_matrix(dt):
+    # kNN takes a single vector column (featuresCols unsupported, as in
+    # the reference's NearestNeighbors)
+    X, _, _ = _data(7)
+    df, feat = _frame(X, None, "vector", dt)
+    est = NearestNeighbors(k=4, num_workers=2, float32_inputs=dt == np.float32)
+    model = est.fit(df)  # default features column (setFeaturesCol is not
+    # part of the reference NearestNeighbors surface either)
+    _, _, knn_df = model.kneighbors(df)
+    d_arr = np.stack(list(np.asarray(knn_df["distances"])))
+    i_arr = np.stack(list(np.asarray(knn_df["indices"])))
+    assert d_arr.shape == (N, 4) and (np.diff(d_arr, axis=1) >= -1e-6).all()
+    # self-neighbor at ~0 distance (the ||x||^2 - 2xy expansion leaves
+    # f32 cancellation residue proportional to ||x||^2)
+    assert np.allclose(d_arr[:, 0], 0.0, atol=1e-2)
+    assert (i_arr[:, 0] == np.arange(N)).mean() > 0.99
+
+
+@pytest.mark.compat
+@pytest.mark.parametrize("ft,dt", RESIDENT, ids=_RIDS)
+def test_umap_matrix(ft, dt):
+    rng = np.random.default_rng(8)
+    centers = rng.normal(size=(3, D)) * 8
+    lab = rng.integers(0, 3, size=N)
+    X = centers[lab] + 0.3 * rng.normal(size=(N, D))
+    df, feat = _frame(X, None, ft, dt)
+    est = UMAP(
+        n_neighbors=10, random_state=0, init="random",
+        num_workers=1, float32_inputs=dt == np.float32,
+    )
+    _feat_kwargs(est, feat)
+    model = est.fit(df)
+    emb = np.asarray(model.embedding_)
+    assert emb.shape == (N, 2) and np.isfinite(emb).all()
+    out = np.asarray(model.transform(df)["embedding"])
+    assert out.shape == (N, 2) and np.isfinite(out).all()

@@ -630,3 +630,81 @@ class TestStreamGuard:
         # accumulator itself must remain usable
         assert float(acc["n"]) == len(devs) * 16 * 8
         monkeypatch.undo()
+
+
+class TestPrefetchChunks:
+    def test_prefetch_overlaps_slow_producer_with_slow_consumer(self):
+        """With a producer that takes P seconds/chunk and a consumer that
+        takes C seconds/chunk, the prefetched loop must finish in
+        ~max(P, C) * n + ramp, decisively under the serial (P + C) * n."""
+        import time as _time
+
+        from spark_rapids_ml_tpu.ops.streaming import prefetch_chunks
+
+        # 50 ms sleeps leave ~190 ms of scheduling headroom under the
+        # 0.8x bound on an oversubscribed CI host
+        n_chunks, delay = 8, 0.05
+
+        def slow_source():
+            for i in range(n_chunks):
+                _time.sleep(delay)
+                yield i
+
+        t0 = _time.perf_counter()
+        seen = []
+        for c in prefetch_chunks(slow_source(), depth=2):
+            _time.sleep(delay)  # consumer-side work per chunk
+            seen.append(c)
+        wall = _time.perf_counter() - t0
+        assert seen == list(range(n_chunks))
+        serial = 2 * delay * n_chunks
+        assert wall < 0.8 * serial, (wall, serial)
+
+    def test_prefetch_disabled_and_order(self, monkeypatch):
+        from spark_rapids_ml_tpu.ops.streaming import prefetch_chunks
+
+        assert list(prefetch_chunks(iter(range(5)), depth=0)) == list(range(5))
+        monkeypatch.setenv("TPUML_STREAM_PREFETCH", "0")
+        assert list(prefetch_chunks(iter(range(5)))) == list(range(5))
+        monkeypatch.setenv("TPUML_STREAM_PREFETCH", "junk")
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="TPUML_STREAM_PREFETCH"):
+            next(prefetch_chunks(iter(range(5))))
+
+    def test_prefetch_propagates_producer_error(self):
+        from spark_rapids_ml_tpu.ops.streaming import prefetch_chunks
+
+        def bad():
+            yield 1
+            raise RuntimeError("decode failed")
+
+        out = []
+        try:
+            for c in prefetch_chunks(bad(), depth=2):
+                out.append(c)
+            raised = False
+        except RuntimeError as e:
+            raised = "decode failed" in str(e)
+        assert out == [1] and raised
+
+    def test_prefetch_early_exit_does_not_wedge(self):
+        import threading
+
+        from spark_rapids_ml_tpu.ops.streaming import prefetch_chunks
+
+        def src():
+            for i in range(100):
+                yield i
+
+        g = prefetch_chunks(src(), depth=1)
+        assert next(g) == 0
+        g.close()  # consumer abandons mid-stream
+        import time as _time
+
+        _time.sleep(0.3)
+        wedged = [
+            t for t in threading.enumerate()
+            if t.name == "tpuml-chunk-prefetch" and t.is_alive()
+        ]
+        assert not wedged, wedged
