@@ -788,6 +788,11 @@ def bench_umap(mesh, n_chips):
     lab = rng.integers(0, 32, size=n)
     Xh = (centers[lab] + rng.normal(size=(n, d))).astype(np.float32)
     df = TDF({"features": Xh})
+    # warm-pass data is PERTURBED vs the timed pass: identical
+    # (executable, buffers) pairs may be memoized by a remote backend
+    # (module docstring; observed round 1) — the timed fit must see
+    # fresh buffers
+    df_warm = TDF({"features": Xh * np.float32(1.0 + 1e-6)})
 
     est = UMAP(n_neighbors=UMAP_NEIGHBORS, random_state=42)
     # warm pass at FULL size first: the kNN-graph/SGD executables are
@@ -795,13 +800,13 @@ def bench_umap(mesh, n_chips):
     # from the timed pass (every other leg warms the same way);
     # BENCH_UMAP_WARM=0 skips when wall-clock budget is tight
     if os.environ.get("BENCH_UMAP_WARM", "1") != "0":
-        est.fit(df)
+        est.fit(df_warm)
     t0 = time.perf_counter()
     model = est.fit(df)
     t_fit = time.perf_counter() - t0
     emb = np.asarray(model.embedding_)
 
-    model.transform(df)  # warm transform executables
+    model.transform(df_warm)  # warm transform executables (fresh buffers)
     t0 = time.perf_counter()
     out = model.transform(df)
     emb_t = np.asarray(out["embedding"])
@@ -937,6 +942,15 @@ def bench_pca_stream(mesh, n_chips):
                 guard.tick(devc, acc)
             guard.flush(acc)
 
+    # warm: the first _touch call pays jit trace+compile (several tunnel
+    # round trips) — keep that out of the measured ingest leg, matching
+    # the math leg's warm pass
+    src_w = GeneratorChunkSource(gen, chunk_rows, d)
+    accw = jnp.float32(0.0)
+    for chunk in src_w.iter_chunks(chunk_rows, np.float32):
+        devw = put_chunk(chunk, mesh, np.float32)
+        accw = _touch(accw, devw["X"], devw["mask"])
+    np.asarray(accw)
     t0 = time.perf_counter()
     ingest_pass()
     t_ingest = time.perf_counter() - t0

@@ -1,0 +1,92 @@
+/* tpuml.h — public C ABI of libtpuml.so.
+ *
+ * TPU-native re-implementation of the four native entry points the
+ * reference exposes through JNI (reference:
+ * jvm/src/main/java/com/nvidia/rapids/ml/JniRAPIDSML.java:64-77 binding
+ * jvm/src/main/cpp/src/rapidsml_jni.cu). The reference's JVM layer is
+ * descoped in this image (no JDK); this header IS the binding surface a
+ * JVM user would target instead — JNA/Panama bind C symbols directly, so
+ * everything Scala's RAPIDSML facade needs is declared here. Python
+ * callers bind the same symbols through ctypes
+ * (spark_rapids_ml_tpu/native/__init__.py).
+ *
+ * Conventions: row-major matrices, int64 shapes, plain-C types only.
+ * Thread safety: tpuml_set_blas is one-shot process-global; the compute
+ * entry points are reentrant and hold no global state beyond the bound
+ * BLAS handles.
+ *
+ * JNA sketch (compileable against this header's symbols):
+ *
+ *   public interface TpuML extends Library {
+ *     TpuML I = Native.load("tpuml", TpuML.class);
+ *     int  tpuml_set_blas(String path);
+ *     int  tpuml_blas_bits();
+ *     void tpuml_gram_f64(double[] X, long n, long d, double[] out);
+ *     void tpuml_gram_f32(float[] X, long n, long d, double[] out);
+ *     void tpuml_colsum_f32(float[] X, long n, long d, double[] out);
+ *     void tpuml_sign_flip(double[] components, long k, long d);
+ *     int  tpuml_eig_cov(double[] cov, long d, long k, double scale,
+ *                        double[] components, double[] eig, double[] sing);
+ *     void tpuml_gemm_transform_f32(float[] X, long n, long d,
+ *                                   double[] components, long k, float[] out);
+ *     int  tpuml_version();
+ *   }
+ */
+
+#ifndef TPUML_H_
+#define TPUML_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Bind a CBLAS implementation by shared-object path (e.g. scipy's
+ * libscipy_openblas). Returns the integer width of the adopted ABI
+ * (32 or 64), -1 if the library cannot be loaded, -2 if it exposes no
+ * recognizable dsyrk/dgemm. One-shot: later calls return the first
+ * binding. Without a bound BLAS every entry point falls back to
+ * OpenMP-blocked loops — slower, same results. */
+int tpuml_set_blas(const char* path);
+
+/* 0 while unbound, else the bound ABI's int width (32/64). */
+int tpuml_blas_bits(void);
+
+/* out(d,d) += X^T X for a row-major (n,d) batch; f64 accumulation.
+ * (reference analog: dgemmWithRowMajor driving the Gram accumulation,
+ * rapidsml_jni.cu) */
+void tpuml_gram_f64(const double* X, int64_t n, int64_t d, double* out);
+
+/* Same contract for f32 input, widened blockwise to f64 before the
+ * accumulation — full f64 precision guarantee. */
+void tpuml_gram_f32(const float* X, int64_t n, int64_t d, double* out);
+
+/* out(d) += column sums of a row-major (n,d) f32 batch (f64 accum). */
+void tpuml_colsum_f32(const float* X, int64_t n, int64_t d, double* out);
+
+/* In-place largest-|entry|-positive sign convention on (k,d) row-major
+ * components (the calSVD/signFlip contract, rapidsml_jni.cu:215-268). */
+void tpuml_sign_flip(double* components, int64_t k, int64_t d);
+
+/* Top-k principal components of a symmetric (d,d) covariance:
+ *   components  (k,d) row-major
+ *   eigenvalues (k)   descending
+ *   singular    (k)   sqrt(max(eig,0) * scale)
+ * Returns 0 on success, nonzero on eigensolver failure. */
+int tpuml_eig_cov(const double* cov, int64_t d, int64_t k, double scale,
+                  double* components, double* eigenvalues, double* singular);
+
+/* out(n,k) = X(n,d) @ components(k,d)^T, f32 in/out with f64 inner
+ * accumulation (the JNI transform, rapidsml_jni.cu:75-107). */
+void tpuml_gemm_transform_f32(const float* X, int64_t n, int64_t d,
+                              const double* components, int64_t k, float* out);
+
+/* ABI version of this header/library pair. */
+int tpuml_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUML_H_ */

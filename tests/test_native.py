@@ -141,3 +141,64 @@ def test_native_pca_no_mean_centering():
     # without centering the top component points at the mean offset
     mean_dir = X.mean(axis=0) / np.linalg.norm(X.mean(axis=0))
     assert abs(np.dot(model.components_[0], mean_dir)) > 0.99
+
+
+def test_header_declares_abi_and_links():
+    """native/include/tpuml.h is the published C ABI (the JNA-bindable
+    surface standing in for the reference's JniRAPIDSML.java). A C
+    program written against the header must compile, link against the
+    built libtpuml.so, and run — and the header must declare every
+    exported tpuml_* symbol."""
+    import os
+    import re
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    header = os.path.join(repo, "native", "include", "tpuml.h")
+    assert os.path.exists(header)
+    so_path = native.build_native()
+
+    # every symbol exported by the .so's C ABI appears in the header
+    syms = subprocess.run(
+        ["nm", "-D", "--defined-only", so_path],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    exported = sorted(
+        m for m in re.findall(r"\b(tpuml_\w+)\b", syms)
+    )
+    hdr_text = open(header).read()
+    missing = [s for s in exported if s not in hdr_text]
+    assert exported and not missing, (exported, missing)
+
+    prog = r"""
+    #include <stdio.h>
+    #include "tpuml.h"
+    int main(void) {
+      double X[6] = {1, 2, 3, 4, 5, 6};      /* (3, 2) row-major */
+      double G[4] = {0, 0, 0, 0};
+      tpuml_gram_f64(X, 3, 2, G);
+      if (G[0] != 35.0 || G[3] != 56.0 || G[1] != G[2]) return 7;
+      printf("version=%d\n", tpuml_version());
+      return 0;
+    }
+    """
+    with tempfile.TemporaryDirectory() as td:
+        src = os.path.join(td, "probe.c")
+        exe = os.path.join(td, "probe")
+        with open(src, "w") as f:
+            f.write(prog)
+        subprocess.run(
+            [
+                "gcc", src, "-o", exe,
+                "-I", os.path.join(repo, "native", "include"),
+                so_path, f"-Wl,-rpath,{os.path.dirname(so_path)}",
+            ],
+            check=True,
+        )
+        out = subprocess.run([exe], capture_output=True, text=True, check=True)
+        # >= the loader's floor, not a literal: the loader accepts newer
+        # ABIs (native/__init__.py checks tpuml_version() < _ABI_VERSION),
+        # and a hard pin here would be a third place encoding the version
+        got = int(out.stdout.strip().removeprefix("version="))
+        assert got >= native._ABI_VERSION, (got, native._ABI_VERSION)
