@@ -203,3 +203,176 @@ def subblock_hist(
         interpret=interpret,
     )(binq, swT)
     return out.reshape(n_pad // r_sub, S, W)
+
+
+# ---------------------------------------------------------------------------
+# fused-selection variant: per-node feature subset selected IN KERNEL
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_bins", "r_sub", "variance", "interpret"),
+)
+def subblock_hist_sel(
+    bq: jax.Array,      # (n_pad, d_pad) uint8 FULL bins, node-sorted
+    featsq: jax.Array,  # (n_sb, k) int32 selected feature ids per sub-block
+    swT: jax.Array,     # (S, n_pad) f32 stats*weight (0 on padding rows)
+    *,
+    n_bins: int,
+    r_sub: int,
+    variance: bool = False,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Per-sub-block histograms with IN-KERNEL feature-subset selection:
+    (n_pad//r_sub, S, k*n_bins) float32.
+
+    The pre-gathered variant (``subblock_hist``) needs hist_src =
+    bins[row, feats[node[row]]] built OUTSIDE the kernel — a per-row
+    k-column gather that costs ~780 ms/level at the reference's
+    1M x 3000 shape (measured round 4; TPU element gathers run ~1e8/s).
+    Node-contiguous rows turn that gather into dense MXU work: every
+    ``r_sub``-aligned sub-block is node-pure, so its k selected columns
+    are ONE static set — a (d_pad, k) one-hot built from the sub-block's
+    feature-id row and contracted against the raw uint8 rows:
+
+        selected = rows(r_sub, d_pad) @ sel(d_pad, k)     (MXU)
+        bl       = selected @ E(k, k*nb)                  (lane expand)
+        oh       = (bl == lane % nb)                      (bin one-hot)
+        out_j    = swT_j(S, r_sub) @ oh                   (stat reduce)
+
+    The full-bins operand arrives by ONE row gather of whole rows
+    (~93 GB/s measured — wide contiguous rows, not element access).
+    Sentinel feature ids (== n_features) hit a zero-padded or absent
+    column and produce bin 0, the same invariant the gather paths keep.
+    Exact for classification: u8 bins and one-hots are bf16-exact, f32
+    accumulation; variance stats force Precision.HIGHEST.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = FORCE_INTERPRET
+    n_pad, d_pad = bq.shape
+    n_sb, k = featsq.shape
+    S = swT.shape[0]
+    nb = n_bins
+    W = k * nb
+    R = BLOCK_ROWS
+    L = R // r_sub
+    n_blocks = n_pad // R
+    prec = lax.Precision.HIGHEST if variance else None
+    # feature ids are lane-padded to a 128 multiple (padding value d_pad
+    # matches no d-iota, so padded slots select nothing and die in E —
+    # their fi >= k), and the block keeps L >= 8 sublanes (the gate caps
+    # r_sub at R/8) so the (L, k_lanes) block satisfies Mosaic's (8, 128)
+    # block rule, which rejected the raw (L, k) shape on every real
+    # configuration.
+    k_lanes = -(-k // 128) * 128
+    fq = jnp.pad(
+        featsq, ((0, 0), (0, k_lanes - k)), constant_values=d_pad
+    )                                                      # (n_sb, k_lanes)
+
+    def kern(b_ref, f_ref, s_ref, out_ref):
+        # Mosaic has no direct u8->f32 cast; hop through int32
+        rows_all = (
+            b_ref[:].astype(jnp.int32).astype(jnp.float32)
+        )                                                  # (R, d_pad)
+        lane_bin = (
+            lax.broadcasted_iota(jnp.int32, (1, W), 1) % nb
+        ).astype(jnp.float32)
+        # E maps selection slot f (< k) to its nb output lanes; padded
+        # slots f >= k match no output lane
+        fi = lax.broadcasted_iota(jnp.int32, (k_lanes, W), 0)
+        li = lax.broadcasted_iota(jnp.int32, (k_lanes, W), 1)
+        E = (li // nb == fi).astype(jnp.float32)
+        d_iota = lax.broadcasted_iota(jnp.int32, (d_pad, k_lanes), 0)
+        for j in range(L):
+            rows = rows_all[j * r_sub : (j + 1) * r_sub]   # (r_sub, d_pad)
+            f_row = f_ref[j : j + 1, :]                    # (1, k_lanes)
+            sel = (d_iota == f_row).astype(jnp.float32)    # (d_pad, k_lanes)
+            selected = jnp.dot(
+                rows, sel, precision=prec,
+                preferred_element_type=jnp.float32,
+            )                                              # (r_sub, k_lanes)
+            bl = jnp.dot(
+                selected, E, precision=prec,
+                preferred_element_type=jnp.float32,
+            )                                              # (r_sub, W)
+            oh = (bl == lane_bin).astype(jnp.float32)      # (r_sub, W)
+            swj = s_ref[:, j * r_sub : (j + 1) * r_sub]    # (S, r_sub)
+            out_ref[j * S : (j + 1) * S, :] = jnp.dot(
+                swj, oh, precision=prec,
+                preferred_element_type=jnp.float32,
+            )                                              # (S, W)
+
+    out = pl.pallas_call(
+        kern,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((R, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (L, k_lanes), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((S, R), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (L * S, W), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_blocks * L * S, W), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(bq, fq, swT)
+    return out.reshape(n_pad // r_sub, S, W)
+
+
+# probe results for the fused-selection variant, keyed by
+# (d_pad, k, nb, S, r_sub, variance)
+_SEL_LOWERING_OK: dict = {}
+
+
+def rf_hist_sel_ok(
+    n_pad: int, d_pad: int, k: int, nb: int, S: int, r_sub: int,
+    variance: bool = False,
+) -> bool:
+    """Gate for the fused-selection kernel: subblock_hist's rules plus a
+    lane-aligned full-bins width and its VMEM residency."""
+    R = BLOCK_ROWS
+    ok = (
+        (jax.default_backend() == "tpu" or FORCE_INTERPRET)
+        and (k * nb) % 128 == 0
+        and nb <= 256
+        and 1 <= S <= 16
+        and r_sub >= 1
+        and (r_sub & (r_sub - 1)) == 0
+        and R % r_sub == 0
+        and n_pad % R == 0
+        and (R // r_sub) * S % 8 == 0
+        # the (L, k_lanes) feature-id block needs >= 8 sublanes
+        and R // r_sub >= 8
+        and k * nb <= 8192
+        and d_pad % 128 == 0
+        # (R, d_pad) f32 rows + (r_sub, W) transients + sel, x2 buffers
+        and (R * d_pad * 4 + r_sub * k * nb * 4 + d_pad * k * 4) * 2
+        <= 80 * 1024 * 1024
+    )
+    if ok and not FORCE_INTERPRET:
+        key = (d_pad, k, nb, S, r_sub, variance)
+
+        def compile_fn():
+            bq = jax.ShapeDtypeStruct((2 * R, d_pad), jnp.uint8)
+            fq = jax.ShapeDtypeStruct((2 * (R // r_sub), k), jnp.int32)
+            sT = jax.ShapeDtypeStruct((S, 2 * R), jnp.float32)
+            subblock_hist_sel.lower(
+                bq, fq, sT, n_bins=nb, r_sub=r_sub, variance=variance
+            ).compile()
+
+        from .linalg import probe_pallas_lowering
+
+        ok = probe_pallas_lowering(
+            _SEL_LOWERING_OK, key, compile_fn, "RF fused-selection histogram"
+        )
+    return ok

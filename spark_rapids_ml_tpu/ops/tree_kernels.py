@@ -53,6 +53,13 @@ _HIST_BUDGET = 1 << 22
 import os as _os
 
 _SCATTER_EQ_FLOPS = float(_os.environ.get("TPUML_RF_SCATTER_EQ_FLOPS", 5e5))
+
+# minimum feature width for the fused-selection histogram kernel: below
+# this the word-packed contraction gather is already cheap (~1.6 ms per
+# level) and the fused kernel's full-row reads + lane padding cost more
+# than they save (measured either way on v5e, round 4). Tests lower it
+# to exercise the fused path at interpret-friendly sizes.
+_SEL_MIN_DPAD = 1024
 def resolve_contract_gather() -> str:
     """Validated subset-extraction strategy from TPUML_RF_CONTRACT_GATHER:
     "auto" (TPU at moderate widths), "on", or "off". Rides the static
@@ -255,13 +262,16 @@ def _compact_r_sub(n: int, n_nodes: int, R: int, S: int) -> int:
     import math
 
     r = min(512, max(8, next_pow2(max(1, n // (n_nodes * 2)))))
-    # (L*S) % 8 == 0 needs L a multiple of 8/gcd(S, 8)
-    cap = R // (8 // math.gcd(S, 8))
+    # (L*S) % 8 == 0 needs L a multiple of 8/gcd(S, 8); the fused-
+    # selection kernel additionally needs L >= 8 for its feature-id
+    # block, so cap at R/8 (costs a few extra sub-blocks per level at
+    # shallow depths — sub-ms in the segment reduce)
+    cap = min(R // (8 // math.gcd(S, 8)), R // 8)
     return max(1, min(r, cap, R))
 
 
 def _hist_compact(
-    hist_src: jax.Array,  # (n, F) int bin values (subset-gathered)
+    hist_src,             # (n, F) int bin values, or None with full_bins
     seg: jax.Array,       # (n,) int32 level-local node id; n_nodes = dead
     sw: jax.Array,        # (n, S) f32 stats*weight
     *,
@@ -272,6 +282,8 @@ def _hist_compact(
                           # block-aligned padded row count it validated
     f_chunk: int,         # feature-chunk width (gate-validated, divides F)
     variance: bool,
+    full_bins=None,       # (n, d_pad) uint8 + feats => fused-selection
+    feats=None,           # (n_nodes, F) int32 per-node feature ids
     interpret=None,
 ):
     """(F, n_nodes, nb, S) histogram + (n_nodes, S) parent stats via the
@@ -290,9 +302,13 @@ def _hist_compact(
     scatter strategy's histogram vs ~1 ms kernel + ~4 ms glue here
     (scripts/rf_deep_microbench*.py).
     """
-    from .rf_pallas import subblock_hist
+    from .rf_pallas import subblock_hist, subblock_hist_sel
 
-    n, F = hist_src.shape
+    if full_bins is not None:
+        n = full_bins.shape[0]
+        F = feats.shape[1]
+    else:
+        n, F = hist_src.shape
     S = sw.shape[1]
     W = F * nb
     n_sb = n_pad // r_sub
@@ -332,35 +348,57 @@ def _hist_compact(
         < n_nodes
     )
     src2 = perm[jnp.clip(src, 0, n - 1)]
-    # int32 bins always (hist_src may arrive uint8 from take_along_axis):
-    # the kernel — and its lowering probe — see exactly one input dtype
-    binq = hist_src[src2].astype(jnp.int32)                 # (n_pad, F)
     swq = sw[src2] * pvalid[:, None].astype(sw.dtype)       # (n_pad, S)
-
-    # feature-chunked kernel+reduce: the (n_sb, S, Fc*nb) partials are
-    # the big transient (1.3 GB at the 1M x 3000 reference shape in one
-    # shot) — bound them to ~256 MB; the gathers above happen ONCE and
-    # chunks just slice binq
     seg_red = jnp.where(seg_sb < n_nodes, seg_sb, n_nodes)
-    Fc = f_chunk
-    hist_parts = []
-    for c0 in range(0, F, Fc):
-        partials = subblock_hist(
-            binq[:, c0 : c0 + Fc], swq, n_bins=nb, r_sub=r_sub,
+
+    if full_bins is not None:
+        # fused-selection path: ONE whole-row gather of the uint8 bins
+        # (~93 GB/s — wide contiguous rows) + per-sub-block feature ids;
+        # the kernel selects each node's k columns with an MXU one-hot
+        # dot, replacing the per-row k-column gather that costs ~780 ms
+        # per level at the reference 1M x 3000 shape. Dump sub-blocks
+        # get garbage feature rows but zero weights — they contribute
+        # nothing and reduce into the dropped slot.
+        bq = full_bins[src2]                                # (n_pad, d_pad)
+        featsq = feats[sbc]                                 # (n_sb, F)
+        partials = subblock_hist_sel(
+            bq, featsq, swq.T, n_bins=nb, r_sub=r_sub,
             variance=variance, interpret=interpret,
-        )                                                   # (n_sb, S, Fc*nb)
-        hist_parts.append(
-            jax.ops.segment_sum(
-                partials.reshape(n_sb, S * Fc * nb),
-                seg_red,
-                num_segments=n_nodes + 1,
-            )[:n_nodes].reshape(n_nodes, S, Fc, nb)
-        )
-    hist_nodes = (
-        hist_parts[0]
-        if len(hist_parts) == 1
-        else jnp.concatenate(hist_parts, axis=2)
-    )                                                       # (n_nodes, S, F, nb)
+        )                                                   # (n_sb, S, F*nb)
+        hist_nodes = jax.ops.segment_sum(
+            partials.reshape(n_sb, S * F * nb),
+            seg_red,
+            num_segments=n_nodes + 1,
+        )[:n_nodes].reshape(n_nodes, S, F, nb)
+    else:
+        # int32 bins always (hist_src may arrive uint8 from
+        # take_along_axis): the kernel — and its lowering probe — see
+        # exactly one input dtype
+        binq = hist_src[src2].astype(jnp.int32)             # (n_pad, F)
+
+        # feature-chunked kernel+reduce: the (n_sb, S, Fc*nb) partials
+        # are the big transient (1.3 GB at the 1M x 3000 reference shape
+        # in one shot) — bound them to ~256 MB; the gathers above happen
+        # ONCE and chunks just slice binq
+        Fc = f_chunk
+        hist_parts = []
+        for c0 in range(0, F, Fc):
+            partials = subblock_hist(
+                binq[:, c0 : c0 + Fc], swq, n_bins=nb, r_sub=r_sub,
+                variance=variance, interpret=interpret,
+            )                                               # (n_sb, S, Fc*nb)
+            hist_parts.append(
+                jax.ops.segment_sum(
+                    partials.reshape(n_sb, S * Fc * nb),
+                    seg_red,
+                    num_segments=n_nodes + 1,
+                )[:n_nodes].reshape(n_nodes, S, Fc, nb)
+            )
+        hist_nodes = (
+            hist_parts[0]
+            if len(hist_parts) == 1
+            else jnp.concatenate(hist_parts, axis=2)
+        )                                                   # (n_nodes, S, F, nb)
     parent = hist_nodes[:, :, 0, :].sum(axis=-1)            # (n_nodes, S)
     hist = hist_nodes.transpose(2, 0, 3, 1)                 # (F, n_nodes, nb, S)
     return hist, parent
@@ -506,19 +544,24 @@ def _build_tree(
                     ((0, 0), (0, k_pad - cfg.k_features)),
                     constant_values=cfg.n_features,
                 )
-            lc0 = jnp.clip(local, 0, n_nodes - 1)
-            row_feats = feats[lc0]  # (n, k_pad) real feature ids per row
-            if use_contract:
-                hist_src = _contract_gather(packed, row_feats)  # (n, k_pad) i32
-            else:
-                hist_src = jnp.take_along_axis(
-                    bins, jnp.clip(row_feats, 0, d_pad - 1), axis=1
-                )  # (n, k_pad) uint8
             d_hist = k_pad
         else:
             feats = None
-            hist_src = bins
             d_hist = d_pad
+
+        def make_hist_src(feats=feats, local=local):
+            """Per-row subset bin extraction — only materialized by the
+            strategies that need it (the fused-selection kernel selects
+            in-kernel and skips this entirely)."""
+            if not subset:
+                return bins
+            lc0 = jnp.clip(local, 0, n_nodes - 1)
+            row_feats = feats[lc0]  # (n, k_pad) real feature ids per row
+            if use_contract:
+                return _contract_gather(packed, row_feats)  # (n, k_pad) i32
+            return jnp.take_along_axis(
+                bins, jnp.clip(row_feats, 0, d_pad - 1), axis=1
+            )  # (n, k_pad) uint8
 
         # compact strategy (TPU): node-contiguous rows + the Pallas
         # sub-block kernel (ops/rf_pallas.py). Eligibility is static per
@@ -527,10 +570,28 @@ def _build_tree(
         # lowering. Wins by ~8x per level over the scatter wall at the
         # bench shape (scripts/rf_deep_microbench2.py), on every level —
         # scatter cost is n-bound, so shallow levels paid it too.
-        from .rf_pallas import BLOCK_ROWS, rf_hist_pallas_ok
+        from .rf_pallas import BLOCK_ROWS, rf_hist_pallas_ok, rf_hist_sel_ok
 
         r_sub = _compact_r_sub(n, n_nodes, BLOCK_ROWS, S)
-        n_pad_c = -(-(n + (n_nodes + 1) * r_sub) // BLOCK_ROWS) * BLOCK_ROWS
+        # Pad with the DEEPEST split level's node count when that waste
+        # is small relative to n: r_sub converges to its cap at scale,
+        # so one padded row count then serves every level and the Pallas
+        # kernels compile ONCE per tree config instead of once per level
+        # (measured ~107 s Mosaic compile for the fused-selection kernel
+        # at the 1M x 3072 shape — 13 per-level compiles would cost
+        # ~20 min). At small n the uniform pad would triple the kernel's
+        # row count (observed: bench rf 4.5 s -> 10.4 s), so fall back
+        # to per-level padding there — those shapes compile in seconds.
+        n_nodes_max = 1 << max(0, cfg.max_depth - 1)
+        if (n_nodes_max + 1) * r_sub * 3 <= n:
+            n_pad_c = (
+                -(-(n + (n_nodes_max + 1) * r_sub) // BLOCK_ROWS)
+                * BLOCK_ROWS
+            )
+        else:
+            n_pad_c = (
+                -(-(n + (n_nodes + 1) * r_sub) // BLOCK_ROWS) * BLOCK_ROWS
+            )
         n_sb_c = n_pad_c // r_sub
         # feature chunk: largest power of two satisfying the kernel's
         # one-hot width cap (Fc*nb <= 8192) AND a ~256 MB partials
@@ -541,20 +602,51 @@ def _build_tree(
             d_hist % Fc != 0 or n_sb_c * S * Fc * nb * 4 > (256 << 20)
         ):
             Fc //= 2
-        use_compact = (
+        compact_shape_ok = (
             cfg.hist_strategy in ("auto", "compact")
             and dt == jnp.float32
             and d_hist % Fc == 0
             and n_nodes * d_hist * nb * S <= (1 << 28)
+        )
+        # fused-selection variant: in-kernel per-node column selection
+        # over node-sorted FULL bins rows — skips the per-row subset
+        # gather entirely (the single dominant cost at wide d: ~780 ms
+        # per level at 1M x 3000). Single-shot (no feature chunking), so
+        # its partials transient gets its own cap — 2 GB alongside the bins
+        # + gathered-rows residents still fits the 15.75 GB chip at the
+        # reference shape, and chunking would force the path off exactly
+        # at the deep levels where skipping the subset gather matters
+        use_sel = (
+            compact_shape_ok
+            and subset
+            # only where the per-row subset gather is the dominant cost
+            # (see _SEL_MIN_DPAD; at bench d_pad=256 fused engagement
+            # SLOWED rf 4.5 -> 10.4 s)
+            and d_pad > _SEL_MIN_DPAD
+            and n_sb_c * S * d_hist * nb * 4 <= (1 << 31)
+            and rf_hist_sel_ok(
+                n_pad_c, d_pad, d_hist, nb, S, r_sub,
+                variance=(cfg.impurity == "variance"),
+            )
+        )
+        use_compact = use_sel or (
+            compact_shape_ok
             and rf_hist_pallas_ok(
                 n_pad_c, Fc, nb, S, r_sub,
                 variance=(cfg.impurity == "variance"),
             )
         )
-        if use_compact:
+        if use_sel:
             hist_full, parent = _hist_compact(
-                hist_src, seg, sw, n_nodes=n_nodes, nb=nb, r_sub=r_sub,
+                None, seg, sw, n_nodes=n_nodes, nb=nb, r_sub=r_sub,
                 n_pad=n_pad_c, f_chunk=Fc,
+                variance=(cfg.impurity == "variance"),
+                full_bins=bins, feats=feats,
+            )
+        elif use_compact:
+            hist_full, parent = _hist_compact(
+                make_hist_src(), seg, sw, n_nodes=n_nodes, nb=nb,
+                r_sub=r_sub, n_pad=n_pad_c, f_chunk=Fc,
                 variance=(cfg.impurity == "variance"),
             )
         else:
@@ -623,6 +715,7 @@ def _build_tree(
             # the narrow subset-scatter tile ((k_pad, n_nodes*nb, S): 67 MB at
             # k=16/depth-13) runs single-chunk under a raised budget — chunking
             # it only multiplied fixed scatter overheads
+            hist_src = make_hist_src()
             budget = (1 << 25) if (subset and not use_matmul) else _HIST_BUDGET
             F = _chunk_features(d_hist, n_nodes, nb, S, budget)
             n_chunks = d_hist // F

@@ -482,3 +482,90 @@ def test_compact_pallas_strategy_matches_scatter(monkeypatch):
         assert corr > 0.999, corr
     finally:
         jax.clear_caches()
+
+
+def test_fused_selection_strategy_matches_scatter(monkeypatch):
+    """The fused-selection kernel (in-kernel per-node column selection,
+    TPUML_RF_FORCE_STRATEGY=compact at a lane-aligned d_pad) must produce
+    a bit-identical classification forest to the scatter strategy. A spy
+    proves the sel kernel actually ran (d_pad=128 makes it eligible;
+    the plain compact test's d_pad=32 exercises the pre-gathered path)."""
+    import jax
+
+    import spark_rapids_ml_tpu.ops.rf_pallas as rfp
+    import spark_rapids_ml_tpu.ops.tree_kernels as tk
+
+    # production gates the fused path to d_pad > 1024 (where the subset
+    # gather dominates); lower the floor so an interpret-friendly size
+    # exercises it
+    monkeypatch.setattr(tk, "_SEL_MIN_DPAD", 0)
+
+    rng = np.random.default_rng(43)
+    X = rng.normal(size=(800, 128)).astype(np.float32)
+    y = ((X[:, 3] - X[:, 70] + 0.5 * X[:, 111]) > 0).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+
+    kw = dict(numTrees=3, maxDepth=4, seed=5, featureSubsetStrategy="sqrt")
+    monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "scatter")
+    m_sc = RandomForestClassifier(**kw).fit(df)
+
+    monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "compact")
+    monkeypatch.setattr(rfp, "FORCE_INTERPRET", True)
+    calls = []
+    real = rfp.subblock_hist_sel
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(rfp, "subblock_hist_sel", spy)
+    try:
+        m_f = RandomForestClassifier(**kw).fit(df)
+        assert calls, "fused-selection kernel never engaged"
+        np.testing.assert_array_equal(m_f._features_arr, m_sc._features_arr)
+        np.testing.assert_allclose(m_f._thresholds_arr, m_sc._thresholds_arr)
+        np.testing.assert_allclose(m_f._leaf_stats_arr, m_sc._leaf_stats_arr)
+    finally:
+        jax.clear_caches()
+
+
+def test_fused_selection_regressor_matches_scatter(monkeypatch):
+    """Variance-stat coverage for the fused-selection kernel: a regressor
+    fit through it (Precision.HIGHEST on all three dots) must match the
+    scatter strategy's fitted function — near-tied splits may flip with
+    summation order, so predictions are compared, not split tables."""
+    import jax
+
+    import spark_rapids_ml_tpu.ops.rf_pallas as rfp
+    import spark_rapids_ml_tpu.ops.tree_kernels as tk
+
+    monkeypatch.setattr(tk, "_SEL_MIN_DPAD", 0)
+    rng = np.random.default_rng(47)
+    X = rng.normal(size=(600, 128)).astype(np.float32)
+    y = (X[:, 10] * 0.8 - X[:, 90] + 0.3 * X[:, 40]).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+    kw = dict(numTrees=2, maxDepth=4, seed=9, featureSubsetStrategy="sqrt")
+
+    monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "scatter")
+    p_sc = np.asarray(
+        RandomForestRegressor(**kw).fit(df).transform(df)["prediction"]
+    )
+    monkeypatch.setenv("TPUML_RF_FORCE_STRATEGY", "compact")
+    monkeypatch.setattr(rfp, "FORCE_INTERPRET", True)
+    calls = []
+    real = rfp.subblock_hist_sel
+
+    def spy(*a, **k):
+        calls.append(k.get("variance"))
+        return real(*a, **k)
+
+    monkeypatch.setattr(rfp, "subblock_hist_sel", spy)
+    try:
+        p_f = np.asarray(
+            RandomForestRegressor(**kw).fit(df).transform(df)["prediction"]
+        )
+        assert calls and all(calls), "variance branch never engaged"
+        corr = np.corrcoef(p_sc, p_f)[0, 1]
+        assert corr > 0.999, corr
+    finally:
+        jax.clear_caches()
