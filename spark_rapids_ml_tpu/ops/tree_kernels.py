@@ -595,8 +595,9 @@ def _build_tree(
         n_sb_c = n_pad_c // r_sub
         # feature chunk: largest power of two satisfying the kernel's
         # one-hot width cap (Fc*nb <= 8192) AND a ~256 MB partials
-        # transient budget (the 1M x 3000 reference shape OOMed a ~7 GB
-        # tunnel chip with single-shot partials); must divide d_hist
+        # transient budget (single-shot partials OOMed the 1M x 3000
+        # reference shape alongside its other residents); must divide
+        # d_hist
         Fc = 1 << max(0, min(d_hist, 8192 // nb).bit_length() - 1)
         while Fc > 1 and (
             d_hist % Fc != 0 or n_sb_c * S * Fc * nb * 4 > (256 << 20)
@@ -612,10 +613,20 @@ def _build_tree(
         # over node-sorted FULL bins rows — skips the per-row subset
         # gather entirely (the single dominant cost at wide d: ~780 ms
         # per level at 1M x 3000). Single-shot (no feature chunking), so
-        # its partials transient gets its own cap — 2 GB alongside the bins
-        # + gathered-rows residents still fits the 15.75 GB chip at the
-        # reference shape, and chunking would force the path off exactly
-        # at the deep levels where skipping the subset gather matters
+        # its transients are gated against an HBM budget instead: the
+        # probe compiles a tiny instance and cannot see HBM pressure,
+        # and a runtime OOM here has no fallback. Residents counted:
+        # bins + the row-gathered copy (both n-scale uint8), partials,
+        # two histogram tiles, and the binq/sort small arrays.
+        sel_resident = (
+            n * d_pad                      # bins (uint8)
+            + n_pad_c * d_pad              # gathered node-sorted copy
+            + n_sb_c * S * d_hist * nb * 4  # partials (f32)
+            + 2 * n_nodes * S * d_hist * nb * 4  # hist + transpose
+        )
+        sel_budget = float(
+            _os.environ.get("TPUML_RF_SEL_HBM_BUDGET", 12e9)
+        )
         use_sel = (
             compact_shape_ok
             and subset
@@ -623,7 +634,7 @@ def _build_tree(
             # (see _SEL_MIN_DPAD; at bench d_pad=256 fused engagement
             # SLOWED rf 4.5 -> 10.4 s)
             and d_pad > _SEL_MIN_DPAD
-            and n_sb_c * S * d_hist * nb * 4 <= (1 << 31)
+            and sel_resident <= sel_budget
             and rf_hist_sel_ok(
                 n_pad_c, d_pad, d_hist, nb, S, r_sub,
                 variance=(cfg.impurity == "variance"),
