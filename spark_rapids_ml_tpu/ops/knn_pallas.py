@@ -36,8 +36,15 @@ from jax import lax
 # Test hook (mirrors ops.kmeans_pallas.FORCE_INTERPRET).
 FORCE_INTERPRET = False
 
-_QB = 256   # query rows per block: (QB, IB) f32 score block = 512 KB VMEM
-_IB = 512   # item cols per block
+# Block sizes trade grid overhead + item-matrix re-reads against VMEM:
+# the item shard is swept once per QUERY block, so HBM traffic scales as
+# (nq/_QB) * ni * d * 4 — at the bench shape (131k x 1M x 256) the
+# original 256-row query blocks cost 512 GB of Xi re-reads (and a ~1M
+# step grid); 2048-row blocks cut that to 64 GB / 62k steps. The
+# (QB, IB) f32 score tile and its while-carry copies stay ~8 MB each,
+# well inside the 100 MB budget.
+_QB = 2048  # query rows per block
+_IB = 1024  # item cols per block
 
 
 # Hardware-lowering probe results per (d, k); the probe policy lives in

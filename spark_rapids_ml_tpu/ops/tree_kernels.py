@@ -54,6 +54,26 @@ import os as _os
 
 _SCATTER_EQ_FLOPS = float(_os.environ.get("TPUML_RF_SCATTER_EQ_FLOPS", 5e5))
 
+# HBM budget for the fused-selection path's residents. Resolved ONCE at
+# import (the _SCATTER_EQ_FLOPS pattern — a per-trace env read would be
+# silently ignored on jit cache hits): env override, else 3/4 of the
+# device's reported memory, else a 16 GB-class default. Device memory is
+# process-stable, so deriving it at first use cannot go stale.
+_SEL_HBM_BUDGET_ENV = _os.environ.get("TPUML_RF_SEL_HBM_BUDGET")
+
+
+def _sel_hbm_budget() -> float:
+    if _SEL_HBM_BUDGET_ENV:
+        return float(_SEL_HBM_BUDGET_ENV)
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return 0.75 * float(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 12e9
+
+
 # minimum feature width for the fused-selection histogram kernel: below
 # this the word-packed contraction gather is already cheap (~1.6 ms per
 # level) and the fused kernel's full-row reads + lane padding cost more
@@ -617,16 +637,15 @@ def _build_tree(
         # probe compiles a tiny instance and cannot see HBM pressure,
         # and a runtime OOM here has no fallback. Residents counted:
         # bins + the row-gathered copy (both n-scale uint8), partials,
-        # two histogram tiles, and the binq/sort small arrays.
+        # and two histogram tiles; the sort/index arrays are a few
+        # percent of these and deliberately ignored.
         sel_resident = (
             n * d_pad                      # bins (uint8)
             + n_pad_c * d_pad              # gathered node-sorted copy
             + n_sb_c * S * d_hist * nb * 4  # partials (f32)
             + 2 * n_nodes * S * d_hist * nb * 4  # hist + transpose
         )
-        sel_budget = float(
-            _os.environ.get("TPUML_RF_SEL_HBM_BUDGET", 12e9)
-        )
+        sel_budget = _sel_hbm_budget()
         use_sel = (
             compact_shape_ok
             and subset
