@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Run the reference workload sweep on the provisioned TPU VM — the analog
+# of databricks/run_benchmark.sh (which spark-submits the benchmark
+# runner per algorithm). The sweep itself is the repo's root
+# ./run_benchmark.sh (the same hyperparameters CI smokes and the
+# reference methodology prescribes — numTrees/maxDepth/maxBins, kmeans
+# k/max_iter/tol, ...); this wrapper only adds provisioning + the
+# multi-host rendezvous env.
+#
+# Multi-host slices: every worker gets TPUML_COORDINATOR (worker 0's
+# internal IP), TPUML_NUM_PROCS, and its TPUML_PROC_ID (from the TPU VM
+# metadata's agent-worker-number) — the same rendezvous contract
+# run_benchmark_multihost.sh exercises locally with a 2-process world.
+#
+# Required env: PROJECT, ZONE, TPU_NAME
+# Optional:    ROWS (default 1000000), COLS (default 3000)
+set -euo pipefail
+
+: "${PROJECT:?set PROJECT}"
+: "${ZONE:?set ZONE}"
+: "${TPU_NAME:?set TPU_NAME}"
+ROWS="${ROWS:-1000000}"
+COLS="${COLS:-3000}"
+
+mapfile -t IPS < <(gcloud compute tpus tpu-vm describe "${TPU_NAME}" \
+  --project="${PROJECT}" --zone="${ZONE}" \
+  --format='value(networkEndpoints[].ipAddress)' | tr ';' '\n')
+N_PROCS="${#IPS[@]}"
+COORD="${IPS[0]}:12355"
+
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
+  --project="${PROJECT}" --zone="${ZONE}" --worker=all --command="
+set -e; cd ~/spark-rapids-ml-tpu
+if [ ${N_PROCS} -gt 1 ]; then
+  export TPUML_COORDINATOR='${COORD}'
+  export TPUML_NUM_PROCS=${N_PROCS}
+  export TPUML_PROC_ID=\$(curl -s -H 'Metadata-Flavor: Google' \
+    http://metadata.google.internal/computeMetadata/v1/instance/attributes/agent-worker-number)
+fi
+./run_benchmark.sh tpu ${ROWS} ${COLS} benchmark_report.csv
+"
+echo "Sweep done; benchmark_report.csv is on each worker."

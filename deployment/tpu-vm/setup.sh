@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Install the framework on every worker of the TPU VM — the analog of the
+# reference's per-CSP init scripts (dataproc/init_benchmark.sh,
+# databricks/init-pip-cuda-11.8.sh), which pip-install spark-rapids-ml
+# and its RAPIDS stack on each executor node.
+#
+# Required env: PROJECT, ZONE, TPU_NAME (as in start_cluster.sh)
+# Optional:    REPO_URL (git remote to clone; defaults to rsyncing the
+#              local checkout), JAX_VERSION pin.
+set -euo pipefail
+
+: "${PROJECT:?set PROJECT}"
+: "${ZONE:?set ZONE}"
+: "${TPU_NAME:?set TPU_NAME}"
+REPO_DIR="$(cd "$(dirname "$0")/../.." && pwd)"
+
+run_all() {
+  gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
+    --project="${PROJECT}" --zone="${ZONE}" --worker=all --command="$1"
+}
+
+if [ -n "${REPO_URL:-}" ]; then
+  run_all "rm -rf ~/spark-rapids-ml-tpu && git clone ${REPO_URL} ~/spark-rapids-ml-tpu"
+else
+  # ship the local checkout (scp to every worker). Remove any previous
+  # copy first: scp into an EXISTING directory nests the new tree inside
+  # it and pip would silently reinstall the stale code.
+  run_all "rm -rf ~/spark-rapids-ml-tpu"
+  gcloud compute tpus tpu-vm scp --recurse "${REPO_DIR}" \
+    "${TPU_NAME}":~/spark-rapids-ml-tpu \
+    --project="${PROJECT}" --zone="${ZONE}" --worker=all
+fi
+
+run_all "pip install -q 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html"
+run_all "cd ~/spark-rapids-ml-tpu && pip install -q -e . && python -c 'import jax; print(jax.devices())'"
+echo "Setup complete on all workers."

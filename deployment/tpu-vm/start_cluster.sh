@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Provision a Cloud TPU VM (single host or pod slice) for benchmarking —
+# the analog of the reference's dataproc/start_cluster.sh (which creates
+# a Dataproc cluster with GPU workers + the spark-rapids plugin).
+#
+# Required env:
+#   PROJECT, ZONE           gcloud project/zone
+#   TPU_NAME                name for the TPU VM
+# Optional:
+#   ACCELERATOR_TYPE        default v5litepod-8 (one host, 8 chips);
+#                           v5litepod-16+ provisions a multi-host slice
+#   RUNTIME_VERSION         default v2-alpha-tpuv5-lite
+set -euo pipefail
+
+: "${PROJECT:?set PROJECT}"
+: "${ZONE:?set ZONE}"
+: "${TPU_NAME:?set TPU_NAME}"
+ACCELERATOR_TYPE="${ACCELERATOR_TYPE:-v5litepod-8}"
+RUNTIME_VERSION="${RUNTIME_VERSION:-v2-alpha-tpuv5-lite}"
+
+gcloud compute tpus tpu-vm create "${TPU_NAME}" \
+  --project="${PROJECT}" \
+  --zone="${ZONE}" \
+  --accelerator-type="${ACCELERATOR_TYPE}" \
+  --version="${RUNTIME_VERSION}"
+
+echo "TPU VM ${TPU_NAME} (${ACCELERATOR_TYPE}) ready."
+echo "Next: ./setup.sh to install the framework on every worker."
