@@ -31,6 +31,7 @@ else
     --project="${PROJECT}" --zone="${ZONE}" --worker=all
 fi
 
-run_all "pip install -q 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html"
+JAX_SPEC="jax[tpu]${JAX_VERSION:+==${JAX_VERSION}}"
+run_all "pip install -q '${JAX_SPEC}' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html"
 run_all "cd ~/spark-rapids-ml-tpu && pip install -q -e . && python -c 'import jax; print(jax.devices())'"
 echo "Setup complete on all workers."
