@@ -975,30 +975,58 @@ def build_forest(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth",))
+@functools.partial(jax.jit, static_argnames=("max_depth", "use_contract"))
 def forest_apply(
     X: jax.Array,        # (n, d)
     feat: jax.Array,     # (T, M) int32, -1 = leaf
     thr: jax.Array,      # (T, M) raw-space thresholds (x >= thr -> right)
     *,
     max_depth: int,
+    use_contract: bool | None = None,  # None = backend/width heuristic;
+                                       # explicit value for cross-branch tests
 ) -> jax.Array:
-    """Leaf index per (tree, row): vectorized level-synchronous descent."""
+    """Leaf index per (tree, row): vectorized level-synchronous descent.
+
+    Per (tree, level) the descent needs two per-row values from the node
+    tables (split feature, threshold) and one from X. TPU element
+    gathers run ~1e8/s while ROW gathers are width-flat, so the tables
+    ride as one (M, 2) f32 table gathered whole rows (feature ids < 2^24
+    are f32-exact), and the X lookup becomes a dense lane contraction at
+    moderate d. Measured v5e at 131k rows x 56 trees x depth 13:
+    4.6 s -> 0.72 s (scripts history, round 4)."""
     n, d = X.shape
 
-    def one_tree(f, t):
+    # dense X-lane contraction beats take_along_axis up to ~1k features
+    # (n*d compare-select work vs n serialized element gathers); fall
+    # back to the gather past that, and everywhere off-TPU
+    if use_contract is None:
+        use_contract = jax.default_backend() == "tpu" and d <= 1024
+    iota_d = jnp.arange(d, dtype=jnp.int32)
+
+    def one_tree(tb):
         def body(_, node):
-            nf = f[node]
-            xv = jnp.take_along_axis(
-                X, jnp.clip(nf, 0, d - 1)[:, None], axis=1
-            )[:, 0]
-            go_right = (xv >= t[node]).astype(jnp.int32)
+            g = tb[node]                         # (n, 2) one row gather
+            nf = g[:, 0].astype(jnp.int32)
+            tv = g[:, 1]
+            if use_contract:
+                sel = nf[:, None] == iota_d[None, :]
+                xv = jnp.where(sel, X, 0.0).sum(axis=1)
+            else:
+                xv = jnp.take_along_axis(
+                    X, jnp.clip(nf, 0, d - 1)[:, None], axis=1
+                )[:, 0]
+            go_right = (xv >= tv).astype(jnp.int32)
             child = 2 * node + 1 + go_right
             return jnp.where(nf < 0, node, child)
 
         return lax.fori_loop(0, max_depth, body, jnp.zeros((n,), jnp.int32))
 
-    return jax.vmap(one_tree)(feat, thr)
+    # table dtype: at least f32 (feature ids are exact only below 256 in
+    # bf16 / 2048 in f16 — narrow thresholds widen losslessly instead),
+    # and f64 thresholds stay f64 so boundary decisions are unperturbed
+    tdt = jnp.promote_types(thr.dtype, jnp.float32)
+    tbl = jnp.stack([feat.astype(tdt), thr.astype(tdt)], axis=-1)
+    return jax.vmap(one_tree)(tbl)
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))

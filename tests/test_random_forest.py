@@ -569,3 +569,30 @@ def test_fused_selection_regressor_matches_scatter(monkeypatch):
         assert corr > 0.999, corr
     finally:
         jax.clear_caches()
+
+
+def test_forest_apply_contract_matches_gather():
+    """The TPU lane-contraction descent and the take_along_axis fallback
+    must agree exactly — pinned on CPU by forcing both branches (the
+    contract branch is otherwise unreachable off-TPU), including bf16
+    inputs whose feature ids must survive the table packing (> 256)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.tree_kernels import forest_apply, max_nodes
+
+    rng = np.random.default_rng(3)
+    n, d, T, depth = 500, 300, 5, 6
+    M = max_nodes(depth)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    feat = rng.integers(-1, d, size=(T, M)).astype(np.int32)
+    thr = rng.normal(size=(T, M)).astype(np.float32)
+    for xdt in (jnp.float32, jnp.bfloat16):
+        Xd = jnp.asarray(X, xdt)
+        td = jnp.asarray(thr, xdt)
+        a = np.asarray(forest_apply(
+            Xd, jnp.asarray(feat), td, max_depth=depth, use_contract=True
+        ))
+        b = np.asarray(forest_apply(
+            Xd, jnp.asarray(feat), td, max_depth=depth, use_contract=False
+        ))
+        np.testing.assert_array_equal(a, b)
