@@ -1,0 +1,133 @@
+"""A/B the UMAP SGD epoch formulations on the real chip at the bench shape.
+
+Variants:
+  aos      — (R,K)/(R,K,neg,c) AoS math (round-5 first version, 36 ms)
+  soa      — flat (S,) SoA math, per-component gathers (47 ms)
+  aos_nopow— aos with x**b replaced by x (isolates pow cost)
+  aos_noneg— aos without the repulsive term (isolates negative-path cost)
+  aos_notile — aos with negatives read as strided slices of embP (no tile)
+  aos_bf16pow — aos with pow computed in bf16
+"""
+import os
+import sys
+import time
+import functools
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_ml_tpu.models.umap import knn_brute
+from spark_rapids_ml_tpu.ops.umap_kernels import (
+    build_row_adjacency, find_ab_params, fuzzy_simplicial_set)
+
+N_EPOCHS = 50  # enough to time; not used for quality here
+
+
+def clip4(x):
+    return jnp.clip(x, -4.0, 4.0)
+
+
+def make_aos(pow_fn=None, use_neg=True, use_tile=True, a=1.58, b=0.9):
+    if pow_fn is None:
+        pow_fn = lambda x, p: x ** p
+
+    @functools.partial(jax.jit, static_argnames=())
+    def run(emb0, row_heads, tails_pad, p_pad, key):
+        R, K = tails_pad.shape
+        n_head, c = emb0.shape
+        neg = 5
+        tot = R * K * neg
+        reps = -(-tot // n_head)
+
+        def epoch(e, emb):
+            k1, k2 = jax.random.split(jax.random.fold_in(key, e))
+            alpha = 1.0 * (1.0 - e / N_EPOCHS)
+            active = (jax.random.uniform(k1, (R, K)) < p_pad).astype(emb.dtype)
+            h = emb[row_heads]
+            t = emb[tails_pad]
+            diff = h[:, None, :] - t
+            d2 = (diff * diff).sum(axis=2)
+            ac = (-2.0 * a * b * pow_fn(d2, b - 1.0)) / (a * pow_fn(d2, b) + 1.0)
+            ac = jnp.where(d2 > 0.0, ac, 0.0) * active
+            grad = clip4(ac[..., None] * diff) * 2.0
+            if use_neg:
+                perm = jax.random.permutation(k2, n_head)
+                embP = emb[perm]
+                if use_tile:
+                    tn = jnp.tile(embP, (reps, 1))[:tot].reshape(R, K, neg, c)
+                else:
+                    m = R * K
+                    r2 = -(-m // n_head)
+                    base = jnp.tile(embP, (r2, 1))[:m].reshape(R, K, c)
+                    tn = jnp.stack(
+                        [jnp.roll(base, s * 977, axis=0) for s in range(neg)],
+                        axis=2,
+                    )
+                diff_n = h[:, None, None, :] - tn
+                d2n = (diff_n * diff_n).sum(axis=3)
+                rc = (2.0 * b) / ((0.001 + d2n) * (a * pow_fn(d2n, b) + 1.0))
+                rc = jnp.where(d2n > 0.0, rc, 0.0) * active[..., None]
+                grad = grad + clip4(rc[..., None] * diff_n).sum(axis=2)
+            row_upd = grad.sum(axis=1)
+            upd = jax.ops.segment_sum(
+                row_upd, row_heads, num_segments=n_head,
+                indices_are_sorted=True)
+            return emb + alpha * upd
+
+        return lax.fori_loop(0, N_EPOCHS, epoch, emb0)
+
+    return run
+
+
+def bf16pow(x, p):
+    return (x.astype(jnp.bfloat16) ** p).astype(jnp.float32)
+
+
+def main():
+    n, d, k = 65536, 256, 15
+    rng = np.random.default_rng(3)
+    centers = rng.normal(size=(32, d)).astype(np.float32) * 4.0
+    lab = rng.integers(0, 32, size=n)
+    Xh = (centers[lab] + rng.normal(size=(n, d))).astype(np.float32)
+    Xd = jnp.asarray(Xh)
+    dists, idx = knn_brute(Xd, Xd, k=k + 1)
+    idx_np = np.asarray(idx)
+    dists_np = np.asarray(dists)
+    self_mask = idx_np == np.arange(n)[:, None]
+    drop = np.where(self_mask.any(1), self_mask.argmax(1), k)
+    keep = np.ones_like(self_mask)
+    keep[np.arange(n), drop] = False
+    knn_i = idx_np[keep].reshape(n, k)
+    knn_d = dists_np[keep].reshape(n, k)
+    heads, tails, w = fuzzy_simplicial_set(knn_i, knn_d, 1.0, 1.0)
+    rh, tp, pp = build_row_adjacency(heads, tails, w, n, K=32)
+    a, b = find_ab_params(1.0, 0.1)
+    emb0 = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+    args = (jnp.asarray(rh), jnp.asarray(tp), jnp.asarray(pp),
+            jax.random.PRNGKey(0))
+
+    variants = {
+        "aos": make_aos(a=a, b=b),
+        "aos_nopow": make_aos(pow_fn=lambda x, p: x, a=a, b=b),
+        "aos_noneg": make_aos(use_neg=False, a=a, b=b),
+        "aos_notile": make_aos(use_tile=False, a=a, b=b),
+        "aos_bf16pow": make_aos(pow_fn=bf16pow, a=a, b=b),
+    }
+    for name, fn in variants.items():
+        out = jax.block_until_ready(fn(emb0, *args))
+        best = 1e30
+        for r in range(2):
+            e0 = emb0 * jnp.float32(1 + (r + 1) * 1e-6)
+            t0 = time.perf_counter()
+            np.asarray(fn(e0, *args))
+            best = min(best, time.perf_counter() - t0)
+        print(f"{name:12s}: {best/N_EPOCHS*1e3:.1f} ms/epoch")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Probe: does Mosaic tpu.dynamic_gather (take_along_axis axis=0) compile for
+a VMEM-resident embedding table, and at what rate?
+
+Shapes tried: (65536, 8) f32, (8192, 128) f32. Grid >= 2 blocks per the
+probe discipline (memory: block-shape violations slip through on 1 block).
+"""
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def probe(n, c, steps=64):
+    """Kernel: per grid step, gather the whole (n, c) table by a step-varying
+    index array and accumulate. Measures gather of n rows x c lanes."""
+
+    def kernel(idx_ref, emb_ref, out_ref):
+        s = pl.program_id(0)
+
+        @pl.when(s == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        # step-dependent index perturbation (cheap, keeps steps distinct)
+        idx = (idx_ref[...] + s) % n
+        g = jnp.take_along_axis(emb_ref[...], idx, axis=0)
+        out_ref[...] += g
+
+    rng = np.random.default_rng(0)
+    emb = jnp.asarray(rng.normal(size=(n, c)).astype(np.float32))
+    idx = jnp.asarray(
+        np.broadcast_to(
+            rng.integers(0, n, size=(n, 1)).astype(np.int32), (n, c)
+        ).copy()
+    )
+
+    fn = pl.pallas_call(
+        kernel,
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec((n, c), lambda s: (0, 0)),
+            pl.BlockSpec((n, c), lambda s: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((n, c), lambda s: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, c), jnp.float32),
+    )
+    jitted = jax.jit(fn)
+    out = np.asarray(jitted(idx, emb))  # compile + run
+    t0 = time.perf_counter()
+    out = np.asarray(jitted((idx + 1) % n, emb))
+    t = time.perf_counter() - t0
+    per = t / steps
+    print(
+        f"dynamic_gather ({n},{c}): {per*1e6:.0f} us/gather of {n} rows "
+        f"-> {n/per/1e6:.0f}M rows/s, {n*c*4/per/1e9:.1f} GB/s"
+    )
+    return out
+
+
+def main():
+    for n, c in [(65536, 8), (8192, 128), (65536, 128)]:
+        try:
+            probe(n, c)
+        except Exception as e:
+            msg = str(e).split("\n")[0][:160]
+            print(f"({n},{c}) FAILED: {type(e).__name__}: {msg}")
+
+
+if __name__ == "__main__":
+    main()

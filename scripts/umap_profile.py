@@ -1,0 +1,97 @@
+"""Stage-by-stage timing of the UMAP fit at the bench shape (65k x 256).
+
+Run on the real TPU:  python scripts/umap_profile.py
+Stages: knn graph -> self-drop -> fuzzy set -> spectral init -> row
+adjacency -> SGD (``optimize_embedding_rows``). Round-5 reference
+timings: knn ~1 s, fuzzy 0.4 s warm, spectral 0.25 s, SGD 2.9 s.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.models.umap import knn_brute
+from spark_rapids_ml_tpu.ops.knn_kernels import resolve_knn_topk
+from spark_rapids_ml_tpu.ops.umap_kernels import (
+    build_row_adjacency,
+    default_n_epochs,
+    find_ab_params,
+    fuzzy_simplicial_set,
+    optimize_embedding_rows,
+    spectral_init,
+)
+
+
+def main():
+    n = int(os.environ.get("UMAP_PROF_ROWS", 65536))
+    d = 256
+    k = 15
+    rng = np.random.default_rng(3)
+    centers = rng.normal(size=(32, d)).astype(np.float32) * 4.0
+    lab = rng.integers(0, 32, size=n)
+    Xh = (centers[lab] + rng.normal(size=(n, d))).astype(np.float32)
+    topk = resolve_knn_topk()
+
+    t0 = time.perf_counter()
+    Xd = jnp.asarray(Xh)
+    dists, idx = knn_brute(Xd, Xd, k=k + 1, topk_impl=topk)
+    np.asarray(dists)
+    t_compile = time.perf_counter() - t0
+    Xd2 = jnp.asarray(Xh * np.float32(1 + 1e-6))
+    t0 = time.perf_counter()
+    dists, idx = knn_brute(Xd2, Xd2, k=k + 1, topk_impl=topk)
+    idx_np = np.asarray(idx)
+    dists_np = np.asarray(dists)
+    t_knn = time.perf_counter() - t0
+    print(f"knn: compile+run {t_compile:.2f}s warm(incl fetch) {t_knn:.2f}s")
+
+    t0 = time.perf_counter()
+    self_mask = idx_np == np.arange(n)[:, None]
+    has_self = self_mask.any(axis=1)
+    drop_col = np.where(has_self, self_mask.argmax(axis=1), k)
+    keep = np.ones_like(self_mask)
+    keep[np.arange(n), drop_col] = False
+    knn_i = idx_np[keep].reshape(n, k)
+    knn_d = dists_np[keep].reshape(n, k)
+    print(f"self-drop {time.perf_counter() - t0:.2f}s")
+
+    t0 = time.perf_counter()
+    heads, tails, weights = fuzzy_simplicial_set(knn_i, knn_d, 1.0, 1.0)
+    print(f"fuzzy set {time.perf_counter() - t0:.2f}s  edges={len(heads)}")
+
+    t0 = time.perf_counter()
+    emb0 = spectral_init(heads, tails, weights, n, 2, 42)
+    print(f"spectral init {time.perf_counter() - t0:.2f}s")
+
+    t0 = time.perf_counter()
+    rh, tp, pp = build_row_adjacency(heads, tails, weights, n, K=32)
+    print(f"row adjacency {time.perf_counter() - t0:.2f}s  R={len(rh)}")
+
+    a, b = find_ab_params(1.0, 0.1)
+    n_epochs = default_n_epochs(n)
+    args = (
+        jnp.asarray(emb0), jnp.asarray(emb0), jnp.asarray(rh),
+        jnp.asarray(tp), jnp.asarray(pp), jax.random.PRNGKey(42),
+    )
+    kw = dict(n_epochs=n_epochs, a=float(a), b=float(b), gamma=1.0,
+              initial_alpha=1.0, negative_sample_rate=5, self_table=True)
+    t0 = time.perf_counter()
+    emb = np.asarray(optimize_embedding_rows(*args, **kw))
+    t_cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    emb = np.asarray(
+        optimize_embedding_rows(args[0] * jnp.float32(1 + 1e-6), *args[1:], **kw)
+    )
+    t_sgd = time.perf_counter() - t0
+    print(f"sgd: cold {t_cold:.2f}s warm {t_sgd:.2f}s "
+          f"({n_epochs} epochs -> {t_sgd / n_epochs * 1e3:.1f} ms/epoch)")
+
+
+if __name__ == "__main__":
+    main()

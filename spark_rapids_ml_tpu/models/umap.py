@@ -38,22 +38,33 @@ from ..params import (
     _mk,
 )
 from ..ops.kmeans_kernels import pairwise_sq_dists
+from ..ops.knn_kernels import _tile_top_k, resolve_knn_topk
 from ..parallel.mesh import allgather_ragged_rows
 from ..ops.umap_kernels import (
+    build_row_adjacency,
     categorical_simplicial_set_intersection,
     default_n_epochs,
     find_ab_params,
     fuzzy_simplicial_set,
     membership_strengths,
-    optimize_embedding,
+    optimize_embedding_rows,
     smooth_knn_dist,
     spectral_init,
 )
 
 
-@functools.partial(jax.jit, static_argnames=("k", "qchunk"))
-def knn_brute(X: jax.Array, Xq: jax.Array, *, k: int, qchunk: int = 4096):
-    """Single-host brute-force kNN: (dists ascending, indices), (nq, k)."""
+@functools.partial(jax.jit, static_argnames=("k", "qchunk", "topk_impl"))
+def knn_brute(
+    X: jax.Array, Xq: jax.Array, *, k: int, qchunk: int = 4096,
+    topk_impl: str = "auto",
+):
+    """Single-host brute-force kNN: (dists ascending, indices), (nq, k).
+
+    Top-k selection routes through ``ops.knn_kernels._tile_top_k`` so the
+    ``TPUML_KNN_TOPK`` escape hatch applies here too (callers pass
+    ``topk_impl=resolve_knn_topk()``); the default PartialReduce path at
+    recall_target=1.0 is exact and much faster than full-sort ``top_k``.
+    """
     nq = Xq.shape[0]
     pad = (-nq) % qchunk
     Xqp = jnp.pad(Xq, ((0, pad), (0, 0)))
@@ -61,7 +72,7 @@ def knn_brute(X: jax.Array, Xq: jax.Array, *, k: int, qchunk: int = 4096):
 
     def body(_, xc):
         d2 = pairwise_sq_dists(xc, X)
-        negd, idx = lax.top_k(-d2, k)
+        negd, idx = _tile_top_k(-d2, k, topk_impl)
         return None, (-negd, idx)
 
     _, (d2, idx) = lax.scan(body, None, chunks)
@@ -254,7 +265,7 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         # the tie run, so dropping column 0 would discard a real neighbor
         # and keep a self-loop
         Xd = jnp.asarray(X)
-        dists, idx = knn_brute(Xd, Xd, k=k + 1)
+        dists, idx = knn_brute(Xd, Xd, k=k + 1, topk_impl=resolve_knn_topk())
         idx_np = np.asarray(idx)
         dists_np = np.asarray(dists)
         self_mask = idx_np == np.arange(n)[:, None]
@@ -296,41 +307,33 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
                 .astype(np.float32)
             )
 
-        # 4) SGD. The edge count is data-dependent, so an unpadded call
-        # recompiles the jitted epoch loop on EVERY fit (~60 s measured
-        # at the 64k bench shape — as long as the SGD itself). Bucket the
-        # edge list to a 64k multiple: zero-weight padding edges have
-        # p_edge 0 and never activate (head/tail 0 is a valid index with
-        # an identically-zero gradient), so results are unchanged while
-        # same-bucket fits reuse the compiled program.
-        m_edges = len(heads)
-        if m_edges < 65536:
-            # graduated bucket below the quantum: a 64k floor would make
-            # small fits spend most SGD work on inert padding
-            m_pad = 1 << max(10, (max(m_edges, 1) - 1).bit_length())
-        else:
-            m_pad = -(-m_edges // 65536) * 65536
-        if m_pad > m_edges:
-            pad = m_pad - m_edges
-            heads = np.concatenate([heads, np.zeros(pad, heads.dtype)])
-            tails = np.concatenate([tails, np.zeros(pad, tails.dtype)])
-            weights = np.concatenate([weights, np.zeros(pad, weights.dtype)])
+        # 4) SGD over CSR-padded rows (``build_row_adjacency``): head-only
+        # updates with cuML's directed-symmetric semantics; the row count
+        # is bucketed inside the builder so same-bucket fits reuse the
+        # compiled epoch loop (an unpadded call recompiles on EVERY fit —
+        # ~60 s measured at the 64k bench shape, as long as the SGD).
+        # Graduate the row bucket for small fits so they don't spend most
+        # SGD work on inert padding.
+        row_bucket = 4096 if n >= 4096 else 256
+        row_heads, tails_pad, p_pad = build_row_adjacency(
+            heads, tails, weights, n, K=32, row_bucket=row_bucket
+        )
         n_epochs = self._tpu_params.get("n_epochs") or default_n_epochs(n)
-        emb = optimize_embedding(
-            jnp.asarray(emb0),
-            jnp.asarray(emb0),
-            jnp.asarray(heads),
-            jnp.asarray(tails),
-            jnp.asarray(weights),
+        emb0 = jnp.asarray(emb0)
+        emb = optimize_embedding_rows(
+            emb0,
+            emb0,
+            jnp.asarray(row_heads),
+            jnp.asarray(tails_pad),
+            jnp.asarray(p_pad),
             jax.random.PRNGKey(seed),
             n_epochs=int(n_epochs),
-            n_vertices=n,
             a=float(a),
             b=float(b),
             gamma=float(self._tpu_params.get("repulsion_strength", 1.0)),
             initial_alpha=float(self._tpu_params.get("learning_rate", 1.0)),
             negative_sample_rate=int(self._tpu_params.get("negative_sample_rate", 5)),
-            move_other=True,
+            self_table=True,
         )
 
         model = UMAPModel(
@@ -393,29 +396,32 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
 
         def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
             nq = Xb.shape[0]
-            dists, idx = knn_brute(train_X, jnp.asarray(Xb, jnp.float32), k=k)
+            dists, idx = knn_brute(
+                train_X, jnp.asarray(Xb, jnp.float32), k=k,
+                topk_impl=resolve_knn_topk(),
+            )
             rho, sigma = smooth_knn_dist(dists, lc)
             w = membership_strengths(dists, rho, sigma)       # (nq, k)
             wn = w / jnp.maximum(w.sum(axis=1, keepdims=True), 1e-12)
             emb0 = jnp.einsum("qk,qkc->qc", wn, train_emb[idx])
-            heads = jnp.repeat(jnp.arange(nq, dtype=jnp.int32), k)
-            tails = idx.reshape(-1).astype(jnp.int32)
-            weights = w.reshape(-1)
-            emb = optimize_embedding(
+            # query q's row adjacency is exactly its k membership edges:
+            # already CSR-padded shape (nq, k), one row per query
+            row_heads = jnp.arange(nq, dtype=jnp.int32)
+            p_pad = w / jnp.maximum(w.max(), 1e-12)
+            emb = optimize_embedding_rows(
                 emb0,
                 train_emb,
-                heads,
-                tails,
-                weights,
+                row_heads,
+                idx.astype(jnp.int32),
+                p_pad,
                 jax.random.PRNGKey(seed),
                 n_epochs=refine,
-                n_vertices=int(train_emb.shape[0]),
                 a=a,
                 b=b,
                 gamma=gamma,
                 initial_alpha=alpha,
                 negative_sample_rate=neg,
-                move_other=False,
+                self_table=False,
             )
             return {out_col: np.asarray(emb)}
 
