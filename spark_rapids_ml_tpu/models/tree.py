@@ -417,6 +417,13 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
                 "gains": gains.astype(np.float32),
                 "n_classes": n_stats if is_classification else 0,
                 "num_features": d,
+                # bin-space tables for the two-hop descent (inference):
+                # x >= edges[f, b] <=> bin(x) > b, the exact training-side
+                # routing rule, so bin-space transform matches the raw
+                # thresholds bit-for-bit. Absent in pre-round-5 saves —
+                # loaders fall back to the raw-threshold descent.
+                "threshold_bins": thr_bin.astype(np.int32),
+                "bin_edges": edges_np.astype(np.float32),
             }
 
         return _fit
@@ -451,6 +458,38 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
     def _max_depth_built(self) -> int:
         m = self._features_arr.shape[1]
         return int(math.log2(m + 1)) - 1
+
+    def _bins_apply_ready(self) -> bool:
+        """True when transform can use the two-hop bin-space descent:
+        the model carries its bin tables (round-5+ fits), the built depth
+        fits the two-hop split (k1 <= 8), and the path is not disabled.
+        TPUML_RF_APPLY=legacy forces the raw-threshold descent;
+        =bins forces bin-space everywhere (incl. CPU, for parity tests)."""
+        mode = os.environ.get("TPUML_RF_APPLY", "auto")
+        if mode not in ("auto", "legacy", "bins"):
+            raise ValueError(
+                f"TPUML_RF_APPLY must be auto|legacy|bins, got {mode!r}"
+            )
+        if mode == "legacy":
+            return False
+        has = (
+            self._model_attributes.get("threshold_bins") is not None
+            and self._model_attributes.get("bin_edges") is not None
+        )
+        ok = has and self._max_depth_built <= 14
+        if mode == "bins":
+            return ok
+        return ok and jax.default_backend() == "tpu"
+
+    def _make_binize_for_apply(self) -> Callable[[np.ndarray], jax.Array]:
+        """Per-batch quantizer with the edges table hoisted device-side
+        ONCE (a streaming transform calls the returned fn per batch)."""
+        from ..ops.tree_kernels import binize
+
+        edges = jnp.asarray(np.asarray(self._model_attributes["bin_edges"]))
+        d = edges.shape[0]
+        d_pad = -(-d // 4) * 4  # word-packing alignment
+        return lambda Xb: binize(jnp.asarray(Xb), edges, d_pad=d_pad)
 
     @property
     def numFeatures(self) -> int:
@@ -621,6 +660,27 @@ class RandomForestClassificationModel(
         leafp = jnp.asarray(self._leaf_probs())
         depth = self._max_depth_built
 
+        if self._bins_apply_ready():
+            from ..ops.tree_kernels import rf_classify_bins
+
+            thrb = jnp.asarray(
+                np.asarray(self._model_attributes["threshold_bins"])
+            )
+            binz = self._make_binize_for_apply()
+
+            def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+                pred, prob, raw = rf_classify_bins(
+                    binz(Xb), feat, thrb, leafp,
+                    max_depth=depth,
+                )
+                return {
+                    pred_col: np.asarray(pred, dtype=Xb.dtype),
+                    prob_col: np.asarray(prob),
+                    raw_col: np.asarray(raw),
+                }
+
+            return _fn
+
         def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
             pred, prob, raw = rf_classify(
                 jnp.asarray(Xb), feat, jnp.asarray(thr, Xb.dtype), leafp,
@@ -733,6 +793,23 @@ class RandomForestRegressionModel(_RandomForestModel):
         thr = self._thresholds_arr
         leafv = jnp.asarray(self._leaf_means())
         depth = self._max_depth_built
+
+        if self._bins_apply_ready():
+            from ..ops.tree_kernels import rf_regress_bins
+
+            thrb = jnp.asarray(
+                np.asarray(self._model_attributes["threshold_bins"])
+            )
+            binz = self._make_binize_for_apply()
+
+            def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+                pred = rf_regress_bins(
+                    binz(Xb), feat, thrb, leafv,
+                    max_depth=depth,
+                )
+                return {pred_col: np.asarray(pred, dtype=Xb.dtype)}
+
+            return _fn
 
         def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
             pred = rf_regress(

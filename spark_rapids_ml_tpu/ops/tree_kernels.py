@@ -171,16 +171,25 @@ def make_bin_edges(
 def binize(X: jax.Array, edges: jax.Array, *, d_pad: int) -> jax.Array:
     """Quantize rows to bins: (n, d) x (d, nb-1) -> (n, d_pad) uint8.
 
-    Elementwise along rows, so XLA keeps the dp row sharding. Padding
-    features (d..d_pad) get bin 0 and are masked out of split search.
+    bin = #{edges <= x}, computed as a broadcast compare-count in feature
+    chunks — the searchsorted formulation lowers to a per-element binary
+    search (~n*d*log(nb) serialized gathers, seconds at 131k x 256) while
+    the compare-count is a fused VPU reduction (n*d*nb compare-adds,
+    ~ms). Elementwise along rows, so XLA keeps the dp row sharding.
+    Padding features (d..d_pad) get bin 0 and are masked out of split
+    search.
     """
     n, d = X.shape
-
-    def one_feature(xc: jax.Array, e: jax.Array) -> jax.Array:
-        return jnp.searchsorted(e, xc, side="right")
-
-    bins = jax.vmap(one_feature, in_axes=(1, 0), out_axes=1)(X, edges)
-    bins = bins.astype(jnp.uint8)
+    Fc = max(1, min(d, (1 << 22) // max(n, 1)))  # bound the (n,Fc,nb) tile
+    parts = []
+    for c0 in range(0, d, Fc):
+        xc = X[:, c0 : c0 + Fc]                       # (n, fc)
+        ec = edges[c0 : c0 + Fc]                      # (fc, nb-1)
+        cnt = (xc[:, :, None] >= ec[None, :, :]).sum(
+            axis=2, dtype=jnp.int32
+        )
+        parts.append(cnt.astype(jnp.uint8))
+    bins = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
     if d_pad > d:
         bins = jnp.pad(bins, ((0, 0), (0, d_pad - d)))
     return bins
@@ -290,6 +299,25 @@ def _compact_r_sub(n: int, n_nodes: int, R: int, S: int) -> int:
     return max(1, min(r, cap, R))
 
 
+def _sorted_block_reduce(partials2d, pstart, r_sub, n_nodes):
+    """Per-node reduction of node-sorted sub-block partials via cumulative
+    sums + boundary differences instead of a segment_sum scatter: the
+    sub-blocks are already contiguous per node, so node g's histogram is
+    ``C[pstart[g+1]/r_sub] - C[pstart[g]/r_sub]`` with C the zero-prefixed
+    cumsum. Wide-row segment_sum measures ~3e6 rows/s; the cumsum runs at
+    bandwidth and the boundary gather touches only n_nodes+1 rows.
+
+    EXACT for integer stats (classification counts stay < 2^24 so every
+    f32 partial sum is exactly representable); callers keep the scatter
+    path for variance stats where cumsum reassociation would round."""
+    C = jnp.concatenate(
+        [jnp.zeros((1, partials2d.shape[1]), partials2d.dtype),
+         jnp.cumsum(partials2d, axis=0)]
+    )
+    bounds = C[pstart[: n_nodes + 1] // r_sub]
+    return bounds[1:] - bounds[:-1]
+
+
 def _hist_compact(
     hist_src,             # (n, F) int bin values, or None with full_bins
     seg: jax.Array,       # (n,) int32 level-local node id; n_nodes = dead
@@ -385,11 +413,15 @@ def _hist_compact(
             bq, featsq, swq.T, n_bins=nb, r_sub=r_sub,
             variance=variance, interpret=interpret,
         )                                                   # (n_sb, S, F*nb)
-        hist_nodes = jax.ops.segment_sum(
-            partials.reshape(n_sb, S * F * nb),
-            seg_red,
-            num_segments=n_nodes + 1,
-        )[:n_nodes].reshape(n_nodes, S, F, nb)
+        p2d = partials.reshape(n_sb, S * F * nb)
+        if variance:
+            hist_nodes = jax.ops.segment_sum(
+                p2d, seg_red, num_segments=n_nodes + 1
+            )[:n_nodes].reshape(n_nodes, S, F, nb)
+        else:
+            hist_nodes = _sorted_block_reduce(
+                p2d, pstart, r_sub, n_nodes
+            ).reshape(n_nodes, S, F, nb)
     else:
         # int32 bins always (hist_src may arrive uint8 from
         # take_along_axis): the kernel — and its lowering probe — see
@@ -407,13 +439,14 @@ def _hist_compact(
                 binq[:, c0 : c0 + Fc], swq, n_bins=nb, r_sub=r_sub,
                 variance=variance, interpret=interpret,
             )                                               # (n_sb, S, Fc*nb)
-            hist_parts.append(
-                jax.ops.segment_sum(
-                    partials.reshape(n_sb, S * Fc * nb),
-                    seg_red,
-                    num_segments=n_nodes + 1,
-                )[:n_nodes].reshape(n_nodes, S, Fc, nb)
-            )
+            p2d = partials.reshape(n_sb, S * Fc * nb)
+            if variance:
+                part = jax.ops.segment_sum(
+                    p2d, seg_red, num_segments=n_nodes + 1
+                )[:n_nodes]
+            else:
+                part = _sorted_block_reduce(p2d, pstart, r_sub, n_nodes)
+            hist_parts.append(part.reshape(n_nodes, S, Fc, nb))
         hist_nodes = (
             hist_parts[0]
             if len(hist_parts) == 1
@@ -555,7 +588,15 @@ def _build_tree(
             r = jax.random.uniform(
                 jax.random.fold_in(kf, level), (n_nodes, cfg.n_features)
             )
-            feats = lax.top_k(r, cfg.k_features)[1].astype(jnp.int32)
+            if jax.default_backend() == "tpu":
+                # indices of the k largest uniforms are a uniform random
+                # k-subset either way; PartialReduce at recall 1.0 is exact
+                # and ~4x cheaper than full-sort top_k at (4096, 256)
+                feats = lax.approx_max_k(
+                    r, cfg.k_features, recall_target=1.0
+                )[1].astype(jnp.int32)
+            else:
+                feats = lax.top_k(r, cfg.k_features)[1].astype(jnp.int32)
             k_pad = next_pow2(cfg.k_features)
             if k_pad > cfg.k_features:
                 # sentinel n_features: invalid (masked out of gain search)
@@ -1027,6 +1068,272 @@ def forest_apply(
     tdt = jnp.promote_types(thr.dtype, jnp.float32)
     tbl = jnp.stack([feat.astype(tdt), thr.astype(tdt)], axis=-1)
     return jax.vmap(one_tree)(tbl)
+
+
+# The lane-shuffle byte-gather kernel measures ~1e11 lane-gathers/s in
+# isolation, but engaging it in the descent loses badly (161 ms -> ~500 ms
+# for the bench forest, single or batched pallas_call alike): the call
+# boundary de-fuses the surrounding pipeline. Opt-in knob kept for future
+# toolchains; the compare-select contraction is the default. Read ONCE at
+# import (the callers-outside-jit rule: an env read inside the traced
+# functions would be silently ignored on jit cache hits; a module-level
+# read is likewise cache-safe — the value is fixed per process).
+_RF_BYTE_GATHER = _os.environ.get("TPUML_RF_BYTE_GATHER", "0") == "1"
+
+
+# --- two-hop subtree descent (bin space, zero per-row gathers) -------------
+#
+# The level-synchronous descent above pays one (n,2)-row gather per
+# (tree, level): T*depth*n ~ 95M gathered rows at the bench shape, and the
+# chip's gather engine tops out near 4e8 rows/s — an architectural wall
+# ~25x short of GPU FIL-class inference (reference tree.py:557-591). The
+# two-hop formulation removes per-row gathers entirely by exploiting the
+# full-binary-tree layout (node i's children at 2i+1/2i+2, levels laid out
+# contiguously, so every level-L slice reshapes to (2^k1, 2^(L-k1)) per
+# level-k1 subtree):
+#
+#   hop 1 (levels 0..k1-1): the root subtree is SHARED by all rows, so its
+#     2^k1-1 tests evaluate as ONE bf16 matmul of the binned rows against
+#     the subtree's feature one-hot (bin ids and feature ids are small
+#     ints — exact in bf16), then k1 arithmetic bit-navigation steps;
+#   hop 2 (levels k1..D): each row's level-k1 subtree is one of 2^k1, so
+#     its (feature, threshold) table arrives by a one-hot contraction over
+#     the 2^k1 axis on the MXU (again exact small ints), the row-specific
+#     feature bins come from the word-packed contraction gather, and k2
+#     more bit-navigation steps reach the leaf. Leaf values are selected
+#     the same way (f32 one-hot contraction + lane select).
+#
+# All comparisons happen in BIN space (x >= edges[f,b]  <=>  bin(x) > b,
+# the exact training-side routing rule), so results are bit-identical to
+# the raw-threshold descent wherever the model carries its bin tables.
+
+
+def _navigate(enc, steps, L):
+    """Heap-local descent over payload array enc (n, L) int32, heap order:
+    enc[i] = 0 at a leaf (stop) else 1 + go_right_bit, so each step is
+    ``i -> 2i + enc[i]`` while enc[i] > 0.
+
+    The step-s lookup touches only the depth-s heap slice
+    ``enc[:, 2^s-1 : 2^(s+1)-1]`` — a width-2^s lane one-hot — so total
+    select work across all steps is one full pass over enc (n*L elements)
+    instead of steps * n * L. Rows frozen at a shallower depth (i < lo)
+    are guarded from reading a clipped lane. Returns (i, stopped_early):
+    rows that complete all `steps` land at index >= L = 2^steps - 1."""
+    n = enc.shape[0]
+    i = jnp.zeros((n,), jnp.int32)
+    for s in range(steps):
+        lo = (1 << s) - 1
+        w = 1 << s
+        sl = lax.slice_in_dim(enc, lo, lo + w, axis=1)
+        il = jnp.clip(i - lo, 0, w - 1)
+        lanes = jnp.arange(w, dtype=jnp.int32)
+        e = jnp.where(lanes[None, :] == il[:, None], sl, 0).sum(axis=1)
+        e = jnp.where(i >= lo, e, 0)
+        i = jnp.where(e > 0, 2 * i + e, i)
+    return i, i < L
+
+
+def _twohop_group(xb16, packed, feat_g, thr_g, val_g, *, max_depth, d):
+    """One tree-group pass of the two-hop descent.
+
+    xb16 (n, d) bf16 bins; packed (n, d/4) i32; feat_g (G, M) i32;
+    thr_g (G, M) i32; val_g (G, M, V) f32 or None. Returns
+    (leaf_ids (G, n) i32, values (n, V) f32 summed over the group or None).
+    """
+    n = xb16.shape[0]
+    G, M = feat_g.shape
+    D = max_depth
+    k1 = max(min(7, D), D - 6)
+    k2 = D - k1
+    n1 = (1 << k1) - 1          # hop-1 internal candidate nodes 0..n1-1
+    iota_d = jnp.arange(d, dtype=jnp.int32)
+    from .rf_pallas import packed_byte_gather_many, packed_byte_gather_ok
+
+    words = packed.shape[1]
+    Wg = max(64, words)
+    nint = (1 << k2) - 1 if k2 > 0 else 0
+    use_bg = k2 > 0 and _RF_BYTE_GATHER and packed_byte_gather_ok(
+        n, words, nint
+    )
+    if use_bg and words < Wg:
+        packed = jnp.pad(packed, ((0, 0), (0, Wg - words)))
+
+    leaf_ids = []
+    vals_sum = None
+    # phase A (per tree): hop-1 navigation + hop-2 table rows + byte indices
+    ph = []
+    for g in range(G):
+        feat_t = feat_g[g]
+        thr_t = thr_g[g]
+        # ---- hop 1: shared root subtree
+        f1 = feat_t[:n1]                                    # (n1,)
+        oh1 = (f1[:, None] == iota_d[None, :]).astype(jnp.bfloat16)
+        tests1 = jax.lax.dot_general(
+            xb16, oh1, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                   # (n, n1)
+        bits1 = (tests1 > thr_t[:n1].astype(jnp.float32)).astype(jnp.int32)
+        enc1 = (1 + bits1) * (f1 >= 0)[None, :].astype(jnp.int32)
+        i1, done1 = _navigate(enc1, k1, n1)
+        if k2 == 0:
+            leaf_ids.append(i1)
+            if val_g is not None:
+                v = val_g[g][i1]                            # (n, V) row gather
+                vals_sum = v if vals_sum is None else vals_sum + v
+            continue
+
+        l7 = jnp.clip(i1 - n1, 0, (1 << k1) - 1)            # subtree id
+        # ---- hop 2: per-subtree local tables, heap order m = 2^delta-1+j.
+        # The per-row table read is ONE row gather from a tiny
+        # (2^k1, 2*nint) table: a (n, 2^k1) one-hot matmul of the same
+        # selection measures ~4 ms/tree at ANY precision (~2 TF/s
+        # effective on the skinny shape) while the gather engine does
+        # these rows in ~0.3 ms/tree — gathers win 10x here.
+        sub_f = []
+        sub_t = []
+        for delta in range(k2):
+            off = (1 << (k1 + delta)) - 1
+            cnt = 1 << (k1 + delta)
+            sh = (1 << k1, 1 << delta)
+            sub_f.append(feat_t[off : off + cnt].reshape(sh))
+            sub_t.append(thr_t[off : off + cnt].reshape(sh))
+        tbl2 = jnp.concatenate(sub_f + sub_t, axis=1)       # (2^k1, 2*nint)
+        rrow = tbl2[l7]                                     # (n, 2*nint)
+        rfeat = rrow[:, :nint]
+        rthr = rrow[:, nint:]
+        ridx = jnp.clip(rfeat, 0, d - 1)
+        ph.append((i1, done1, l7, rfeat, rthr, ridx))
+
+    if k2 == 0:
+        return jnp.stack(leaf_ids, axis=0), vals_sum
+
+    # phase B: ONE batched lane-shuffle gather for the whole group (per-tree
+    # pallas_call dispatches measured ~6 ms of overhead each inside a jitted
+    # forest evaluation; the contraction fallback costs ~70 ms per forest)
+    if use_bg:
+        idx_all = jnp.stack(
+            [jnp.pad(p[5], ((0, 0), (0, Wg - nint))) for p in ph]
+        )                                                   # (G, n, Wg)
+        xv_all = packed_byte_gather_many(packed, idx_all)   # (G, n, Wg)
+
+    # phase C (per tree): hop-2 navigation + leaf/value resolution
+    for g, (i1, done1, l7, rfeat, rthr, ridx) in enumerate(ph):
+        if use_bg:
+            xv = xv_all[g][:, :nint]
+        else:
+            xv = _contract_gather(packed, ridx)             # (n, nint) i32
+        bits2 = ((xv > rthr) & (rfeat >= 0)).astype(jnp.int32)
+        enc2 = (1 + bits2) * (rfeat >= 0).astype(jnp.int32)
+        enc2 = jnp.where(done1[:, None], 0, enc2)
+        m, _ = _navigate(enc2, k2, nint)
+        # done1 rows keep i1; others: global id from (l7, local heap m)
+        delta = jnp.zeros_like(m)
+        for j in range(1, k2 + 1):
+            delta = delta + (m + 1 >= (1 << j)).astype(jnp.int32)
+        pd = jnp.left_shift(jnp.int32(1), delta)            # 2^delta
+        j_local = m - (pd - 1)
+        gid = ((1 << k1) * pd - 1) + l7 * pd + j_local
+        leaf = jnp.where(done1, i1, gid)
+        leaf_ids.append(leaf)
+
+        if val_g is not None:
+            v = val_g[g][leaf]                              # (n, V) row gather
+            vals_sum = v if vals_sum is None else vals_sum + v
+
+    return jnp.stack(leaf_ids, axis=0), vals_sum
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "group"))
+def forest_apply_bins(
+    xb: jax.Array,       # (n, d_pad) uint8 bin ids
+    feat: jax.Array,     # (T, M) int32, -1 = leaf
+    thr_bin: jax.Array,  # (T, M) int32 (bin(x) > thr_bin -> right)
+    *,
+    max_depth: int,
+    group: int = 8,
+) -> jax.Array:
+    """Leaf node index per (tree, row) via the two-hop subtree descent."""
+    from .rf_pallas import _GATHER_BLOCK
+
+    T = feat.shape[0]
+    n0 = xb.shape[0]
+    if _RF_BYTE_GATHER and jax.default_backend() == "tpu":
+        # block-align rows so the Pallas lane-gather gate engages
+        xb = jnp.pad(xb, ((0, (-n0) % _GATHER_BLOCK), (0, 0)))
+    xb16 = xb.astype(jnp.bfloat16)
+    packed = _pack_bins(xb)
+    out = []
+    for g0 in range(0, T, group):
+        ids, _ = _twohop_group(
+            xb16, packed, feat[g0 : g0 + group],
+            thr_bin[g0 : g0 + group], None,
+            max_depth=max_depth, d=xb.shape[1],
+        )
+        out.append(ids)
+    return jnp.concatenate(out, axis=0)[:, :n0]
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "group"))
+def rf_eval_bins(
+    xb: jax.Array,       # (n, d_pad) uint8 bin ids
+    feat: jax.Array,     # (T, M) int32, -1 = leaf
+    thr_bin: jax.Array,  # (T, M) int32
+    values: jax.Array,   # (T, M, V) f32 per-node leaf stats
+    *,
+    max_depth: int,
+    group: int = 8,
+) -> jax.Array:
+    """Sum over trees of each tree's leaf value vector, (n, V)."""
+    from .rf_pallas import _GATHER_BLOCK
+
+    T = feat.shape[0]
+    n0 = xb.shape[0]
+    if _RF_BYTE_GATHER and jax.default_backend() == "tpu":
+        xb = jnp.pad(xb, ((0, (-n0) % _GATHER_BLOCK), (0, 0)))
+    xb16 = xb.astype(jnp.bfloat16)
+    packed = _pack_bins(xb)
+    acc = None
+    for g0 in range(0, T, group):
+        _, v = _twohop_group(
+            xb16, packed, feat[g0 : g0 + group],
+            thr_bin[g0 : g0 + group], values[g0 : g0 + group],
+            max_depth=max_depth, d=xb.shape[1],
+        )
+        acc = v if acc is None else acc + v
+    return acc[:n0]
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def rf_classify_bins(
+    xb: jax.Array,       # (n, d_pad) uint8 bin ids
+    feat: jax.Array,
+    thr_bin: jax.Array,
+    leaf_prob: jax.Array,  # (T, M, C) normalized leaf distributions
+    *,
+    max_depth: int,
+):
+    """Spark RF vote semantics via the two-hop bin-space descent: the
+    summed-over-trees leaf distribution arrives directly from
+    ``rf_eval_bins`` — no (T, n, C) materialization."""
+    raw = rf_eval_bins(xb, feat, thr_bin, leaf_prob, max_depth=max_depth)
+    prob = raw / feat.shape[0]
+    pred = jnp.argmax(raw, axis=1).astype(jnp.float32)
+    return pred, prob, raw
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def rf_regress_bins(
+    xb: jax.Array,
+    feat: jax.Array,
+    thr_bin: jax.Array,
+    leaf_value: jax.Array,  # (T, M) per-tree leaf means
+    *,
+    max_depth: int,
+) -> jax.Array:
+    s = rf_eval_bins(
+        xb, feat, thr_bin, leaf_value[..., None], max_depth=max_depth
+    )
+    return s[:, 0] / leaf_value.shape[0]
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))

@@ -596,3 +596,79 @@ def test_forest_apply_contract_matches_gather():
             Xd, jnp.asarray(feat), td, max_depth=depth, use_contract=False
         ))
         np.testing.assert_array_equal(a, b)
+
+
+def test_two_hop_bins_descent_matches_python_oracle():
+    """forest_apply_bins / rf_eval_bins (the two-hop subtree descent used
+    for TPU inference) vs a per-row python heap walk, across depths with
+    random internal leaves. Values must be bit-exact (integer bin
+    comparisons + direct value gathers)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.tree_kernels import (
+        forest_apply_bins, max_nodes, rf_eval_bins)
+
+    rng = np.random.default_rng(11)
+    for depth, T, n, d, nb in [(9, 4, 400, 16, 64), (4, 3, 200, 8, 32)]:
+        M = max_nodes(depth)
+        feat = rng.integers(0, d, size=(T, M)).astype(np.int32)
+        leaf_mask = np.zeros((T, M), bool)
+        leaf_mask[:, (1 << depth) - 1:] = True
+        leaf_mask |= rng.random((T, M)) < 0.2
+        feat = np.where(leaf_mask, -1, feat)
+        thrb = rng.integers(0, nb - 1, size=(T, M)).astype(np.int32)
+        vals = rng.normal(size=(T, M, 2)).astype(np.float32)
+        xb = rng.integers(0, nb, size=(n, d), dtype=np.uint8)
+
+        def descend(t, row):
+            i = 0
+            while feat[t, i] >= 0:
+                i = 2 * i + 1 + int(xb[row, feat[t, i]] > thrb[t, i])
+            return i
+
+        oracle = np.array(
+            [[descend(t, r) for r in range(n)] for t in range(T)])
+        got = np.asarray(forest_apply_bins(
+            jnp.asarray(xb), jnp.asarray(feat), jnp.asarray(thrb),
+            max_depth=depth))
+        np.testing.assert_array_equal(got, oracle)
+        expect = np.zeros((n, 2), np.float32)
+        for t in range(T):
+            expect += vals[t][oracle[t]]
+        gv = np.asarray(rf_eval_bins(
+            jnp.asarray(xb), jnp.asarray(feat), jnp.asarray(thrb),
+            jnp.asarray(vals), max_depth=depth))
+        np.testing.assert_array_equal(gv, expect)
+
+
+def test_rf_transform_bins_path_matches_legacy(monkeypatch):
+    """Model-level parity: TPUML_RF_APPLY=bins (the two-hop bin-space
+    descent, default on TPU) must reproduce the raw-threshold descent's
+    predictions on fresh query data — classification and regression."""
+    X, y = _blobs(n=500, d=10, k=3, seed=5)
+    df = DataFrame({"features": X, "label": y})
+    Xq = X + np.float32(0.01) * np.random.default_rng(6).normal(
+        size=X.shape).astype(np.float32)
+    dfq = DataFrame({"features": Xq})
+
+    model = RandomForestClassifier(
+        numTrees=5, maxDepth=5, seed=7).fit(df)
+    monkeypatch.setenv("TPUML_RF_APPLY", "legacy")
+    out_legacy = model.transform(dfq)
+    monkeypatch.setenv("TPUML_RF_APPLY", "bins")
+    out_bins = model.transform(dfq)
+    np.testing.assert_array_equal(
+        np.asarray(out_legacy["prediction"]),
+        np.asarray(out_bins["prediction"]))
+    np.testing.assert_allclose(
+        np.asarray(out_legacy["probability"]),
+        np.asarray(out_bins["probability"]), rtol=0, atol=1e-6)
+
+    Xr, yr = _regression_data(n=500, d=6, seed=9)
+    dfr = DataFrame({"features": Xr, "label": yr})
+    mr = RandomForestRegressor(numTrees=5, maxDepth=5, seed=7).fit(dfr)
+    monkeypatch.setenv("TPUML_RF_APPLY", "legacy")
+    pl_ = np.asarray(mr.transform(dfr)["prediction"])
+    monkeypatch.setenv("TPUML_RF_APPLY", "bins")
+    pb = np.asarray(mr.transform(dfr)["prediction"])
+    np.testing.assert_allclose(pl_, pb, rtol=1e-6)
