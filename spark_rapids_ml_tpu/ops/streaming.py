@@ -173,6 +173,12 @@ def prefetch_chunks(it, depth: Optional[int] = None):
     th.start()
     try:
         while True:
+            # fail fast: a producer exception surfaces on the NEXT get,
+            # not after `depth` already-buffered chunks drain (those
+            # chunks are valid but the stream is doomed — callers want
+            # the error, not more partial work)
+            if err:
+                raise err[0]
             c = q.get()
             if c is end:
                 break
@@ -180,6 +186,10 @@ def prefetch_chunks(it, depth: Optional[int] = None):
         if err:
             raise err[0]
     finally:
+        # Callers that abandon the generator early should close() it (the
+        # `finally` then runs promptly); an unclosed-but-unreferenced
+        # generator only cancels the producer when GC collects it, until
+        # which the daemon thread spins on 0.1 s put timeouts.
         cancel.set()
 
 

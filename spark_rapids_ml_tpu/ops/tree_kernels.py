@@ -307,9 +307,13 @@ def _sorted_block_reduce(partials2d, pstart, r_sub, n_nodes):
     cumsum. Wide-row segment_sum measures ~3e6 rows/s; the cumsum runs at
     bandwidth and the boundary gather touches only n_nodes+1 rows.
 
-    EXACT for integer stats (classification counts stay < 2^24 so every
-    f32 partial sum is exactly representable); callers keep the scatter
-    path for variance stats where cumsum reassociation would round."""
+    EXACT for integer stats while the GLOBAL per-column prefix stays
+    < 2^24 (every f32 running sum is then an exactly-representable
+    integer — note this bounds the whole column's cumsum, a stronger
+    requirement than per-node sums, so callers gate on total row count);
+    callers keep the scatter path for variance stats where cumsum
+    reassociation would round, and for row counts where a concentrated
+    bin could push a column prefix past 2^24."""
     C = jnp.concatenate(
         [jnp.zeros((1, partials2d.shape[1]), partials2d.dtype),
          jnp.cumsum(partials2d, axis=0)]
@@ -399,6 +403,13 @@ def _hist_compact(
     swq = sw[src2] * pvalid[:, None].astype(sw.dtype)       # (n_pad, S)
     seg_red = jnp.where(seg_sb < n_nodes, seg_sb, n_nodes)
 
+    # cumsum boundary-diff reduction only where EXACT (see
+    # _sorted_block_reduce): integer stats AND total weighted rows small
+    # enough that no per-column global prefix can reach 2^24 (Poisson
+    # bootstrap weights average 1, so n rows bounds the count column up
+    # to tail factors the 2^23 margin absorbs)
+    use_cumsum = (not variance) and n <= (1 << 23)
+
     if full_bins is not None:
         # fused-selection path: ONE whole-row gather of the uint8 bins
         # (~93 GB/s — wide contiguous rows) + per-sub-block feature ids;
@@ -414,14 +425,14 @@ def _hist_compact(
             variance=variance, interpret=interpret,
         )                                                   # (n_sb, S, F*nb)
         p2d = partials.reshape(n_sb, S * F * nb)
-        if variance:
-            hist_nodes = jax.ops.segment_sum(
-                p2d, seg_red, num_segments=n_nodes + 1
-            )[:n_nodes].reshape(n_nodes, S, F, nb)
-        else:
+        if use_cumsum:
             hist_nodes = _sorted_block_reduce(
                 p2d, pstart, r_sub, n_nodes
             ).reshape(n_nodes, S, F, nb)
+        else:
+            hist_nodes = jax.ops.segment_sum(
+                p2d, seg_red, num_segments=n_nodes + 1
+            )[:n_nodes].reshape(n_nodes, S, F, nb)
     else:
         # int32 bins always (hist_src may arrive uint8 from
         # take_along_axis): the kernel — and its lowering probe — see
@@ -440,12 +451,12 @@ def _hist_compact(
                 variance=variance, interpret=interpret,
             )                                               # (n_sb, S, Fc*nb)
             p2d = partials.reshape(n_sb, S * Fc * nb)
-            if variance:
+            if use_cumsum:
+                part = _sorted_block_reduce(p2d, pstart, r_sub, n_nodes)
+            else:
                 part = jax.ops.segment_sum(
                     p2d, seg_red, num_segments=n_nodes + 1
                 )[:n_nodes]
-            else:
-                part = _sorted_block_reduce(p2d, pstart, r_sub, n_nodes)
             hist_parts.append(part.reshape(n_nodes, S, Fc, nb))
         hist_nodes = (
             hist_parts[0]
@@ -1243,6 +1254,37 @@ def _twohop_group(xb16, packed, feat_g, thr_g, val_g, *, max_depth, d):
     return jnp.stack(leaf_ids, axis=0), vals_sum
 
 
+def _twohop_drive(xb, feat, thr_bin, values, *, max_depth, group):
+    """Shared driver for the two-hop descent: byte-gather row alignment,
+    bf16 cast + word packing, tree-group loop, and row unpadding. With
+    ``values`` None returns stacked (T, n) leaf ids; otherwise the (n, V)
+    value sum over trees."""
+    from .rf_pallas import _GATHER_BLOCK
+
+    T = feat.shape[0]
+    n0 = xb.shape[0]
+    if _RF_BYTE_GATHER and jax.default_backend() == "tpu":
+        # block-align rows so the Pallas lane-gather gate engages
+        xb = jnp.pad(xb, ((0, (-n0) % _GATHER_BLOCK), (0, 0)))
+    xb16 = xb.astype(jnp.bfloat16)
+    packed = _pack_bins(xb)
+    ids_out = []
+    acc = None
+    for g0 in range(0, T, group):
+        ids, v = _twohop_group(
+            xb16, packed, feat[g0 : g0 + group],
+            thr_bin[g0 : g0 + group],
+            None if values is None else values[g0 : g0 + group],
+            max_depth=max_depth, d=xb.shape[1],
+        )
+        ids_out.append(ids)
+        if values is not None:
+            acc = v if acc is None else acc + v
+    if values is None:
+        return jnp.concatenate(ids_out, axis=0)[:, :n0]
+    return acc[:n0]
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth", "group"))
 def forest_apply_bins(
     xb: jax.Array,       # (n, d_pad) uint8 bin ids
@@ -1253,24 +1295,9 @@ def forest_apply_bins(
     group: int = 8,
 ) -> jax.Array:
     """Leaf node index per (tree, row) via the two-hop subtree descent."""
-    from .rf_pallas import _GATHER_BLOCK
-
-    T = feat.shape[0]
-    n0 = xb.shape[0]
-    if _RF_BYTE_GATHER and jax.default_backend() == "tpu":
-        # block-align rows so the Pallas lane-gather gate engages
-        xb = jnp.pad(xb, ((0, (-n0) % _GATHER_BLOCK), (0, 0)))
-    xb16 = xb.astype(jnp.bfloat16)
-    packed = _pack_bins(xb)
-    out = []
-    for g0 in range(0, T, group):
-        ids, _ = _twohop_group(
-            xb16, packed, feat[g0 : g0 + group],
-            thr_bin[g0 : g0 + group], None,
-            max_depth=max_depth, d=xb.shape[1],
-        )
-        out.append(ids)
-    return jnp.concatenate(out, axis=0)[:, :n0]
+    return _twohop_drive(
+        xb, feat, thr_bin, None, max_depth=max_depth, group=group
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "group"))
@@ -1284,26 +1311,12 @@ def rf_eval_bins(
     group: int = 8,
 ) -> jax.Array:
     """Sum over trees of each tree's leaf value vector, (n, V)."""
-    from .rf_pallas import _GATHER_BLOCK
-
-    T = feat.shape[0]
-    n0 = xb.shape[0]
-    if _RF_BYTE_GATHER and jax.default_backend() == "tpu":
-        xb = jnp.pad(xb, ((0, (-n0) % _GATHER_BLOCK), (0, 0)))
-    xb16 = xb.astype(jnp.bfloat16)
-    packed = _pack_bins(xb)
-    acc = None
-    for g0 in range(0, T, group):
-        _, v = _twohop_group(
-            xb16, packed, feat[g0 : g0 + group],
-            thr_bin[g0 : g0 + group], values[g0 : g0 + group],
-            max_depth=max_depth, d=xb.shape[1],
-        )
-        acc = v if acc is None else acc + v
-    return acc[:n0]
+    return _twohop_drive(
+        xb, feat, thr_bin, values, max_depth=max_depth, group=group
+    )
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth",))
+@functools.partial(jax.jit, static_argnames=("max_depth", "group"))
 def rf_classify_bins(
     xb: jax.Array,       # (n, d_pad) uint8 bin ids
     feat: jax.Array,
@@ -1311,17 +1324,21 @@ def rf_classify_bins(
     leaf_prob: jax.Array,  # (T, M, C) normalized leaf distributions
     *,
     max_depth: int,
+    group: int = 8,
 ):
     """Spark RF vote semantics via the two-hop bin-space descent: the
     summed-over-trees leaf distribution arrives directly from
-    ``rf_eval_bins`` — no (T, n, C) materialization."""
-    raw = rf_eval_bins(xb, feat, thr_bin, leaf_prob, max_depth=max_depth)
+    ``rf_eval_bins`` — no (T, n, C) materialization. ``group`` bounds the
+    per-tree-group transients (smaller = leaner alongside big residents)."""
+    raw = rf_eval_bins(
+        xb, feat, thr_bin, leaf_prob, max_depth=max_depth, group=group
+    )
     prob = raw / feat.shape[0]
     pred = jnp.argmax(raw, axis=1).astype(jnp.float32)
     return pred, prob, raw
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth",))
+@functools.partial(jax.jit, static_argnames=("max_depth", "group"))
 def rf_regress_bins(
     xb: jax.Array,
     feat: jax.Array,
@@ -1329,9 +1346,11 @@ def rf_regress_bins(
     leaf_value: jax.Array,  # (T, M) per-tree leaf means
     *,
     max_depth: int,
+    group: int = 8,
 ) -> jax.Array:
     s = rf_eval_bins(
-        xb, feat, thr_bin, leaf_value[..., None], max_depth=max_depth
+        xb, feat, thr_bin, leaf_value[..., None], max_depth=max_depth,
+        group=group,
     )
     return s[:, 0] / leaf_value.shape[0]
 

@@ -1,0 +1,142 @@
+"""Generate ``spark_vectorudt_parquet/`` — a parquet directory with the
+EXACT physical layout Spark ML writes for a DataFrame of
+``(features: VectorUDT, extra: array<float>, label: double)``:
+
+* VectorUDT's on-disk struct ``{type: tinyint, size: int,
+  indices: list<int>, values: list<double>}`` with MIXED dense
+  (type=1: size/indices null) and sparse (type=0: CSR-style
+  indices/values) rows — the shape ``data/dataframe.py`` decodes
+  (reference consumes it through Spark itself, ``core.py:160-241``);
+* the ``org.apache.spark.sql.parquet.row.metadata`` schema key Spark
+  stamps on every file (carrying the UDT class name);
+* Spark's directory layout: ``part-*.parquet`` + an empty ``_SUCCESS``.
+
+This image has no pyspark, so the fixture is synthesized with pyarrow to
+Spark 3.5's documented physical schema; on machines with pyspark the
+live round-trip test in ``test_pyspark_parity.py`` covers the same
+contract against genuinely Spark-written files.
+
+Run from the repo root:  python tests/fixtures/gen_spark_fixture.py
+"""
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "spark_vectorudt_parquet")
+
+N, D = 64, 4
+
+SPARK_ROW_METADATA = {
+    "type": "struct",
+    "fields": [
+        {
+            "name": "features",
+            "type": {
+                "type": "udt",
+                "class": "org.apache.spark.ml.linalg.VectorUDT",
+                "pyClass": "pyspark.ml.linalg.VectorUDT",
+                "sqlType": {
+                    "type": "struct",
+                    "fields": [
+                        {"name": "type", "type": "byte", "nullable": False,
+                         "metadata": {}},
+                        {"name": "size", "type": "integer", "nullable": True,
+                         "metadata": {}},
+                        {"name": "indices",
+                         "type": {"type": "array", "elementType": "integer",
+                                  "containsNull": False},
+                         "nullable": True, "metadata": {}},
+                        {"name": "values",
+                         "type": {"type": "array", "elementType": "double",
+                                  "containsNull": False},
+                         "nullable": True, "metadata": {}},
+                    ],
+                },
+            },
+            "nullable": True,
+            "metadata": {},
+        },
+        {
+            "name": "extra",
+            "type": {"type": "array", "elementType": "float",
+                     "containsNull": True},
+            "nullable": True,
+            "metadata": {},
+        },
+        {"name": "label", "type": "double", "nullable": True, "metadata": {}},
+    ],
+}
+
+
+def main():
+    rng = np.random.default_rng(42)
+    types = []
+    sizes = []
+    indices = []
+    values = []
+    dense_truth = np.zeros((N, D))
+    for i in range(N):
+        if i % 3 == 0:
+            # sparse row (type=0): CSR-style indices/values, size = D
+            nz = sorted(rng.choice(D, size=2, replace=False).tolist())
+            vv = [round(float(v), 6) for v in rng.normal(size=2)]
+            types.append(0)
+            sizes.append(D)
+            indices.append(nz)
+            values.append(vv)
+            for j, v in zip(nz, vv):
+                dense_truth[i, j] = v
+        else:
+            # dense row (type=1): Spark leaves size/indices null
+            vv = [float(i), float(i) / 2.0, float(i % 5), -1.0]
+            types.append(1)
+            sizes.append(None)
+            indices.append([])
+            values.append(vv)
+            dense_truth[i] = vv
+
+    features = pa.StructArray.from_arrays(
+        [
+            pa.array(types, pa.int8()),
+            pa.array(sizes, pa.int32()),
+            pa.array(indices, pa.list_(pa.int32())),
+            pa.array(values, pa.list_(pa.float64())),
+        ],
+        names=["type", "size", "indices", "values"],
+    )
+    extra = pa.array(
+        [[float(i), float(2 * i)] for i in range(N)], pa.list_(pa.float32())
+    )
+    label = pa.array([float(i % 2) for i in range(N)], pa.float64())
+    schema = pa.schema(
+        [
+            pa.field("features", features.type),
+            pa.field("extra", extra.type),
+            pa.field("label", label.type),
+        ],
+        metadata={
+            "org.apache.spark.sql.parquet.row.metadata": json.dumps(
+                SPARK_ROW_METADATA
+            )
+        },
+    )
+    table = pa.Table.from_arrays([features, extra, label], schema=schema)
+    os.makedirs(OUT, exist_ok=True)
+    pq.write_table(
+        table,
+        os.path.join(
+            OUT, "part-00000-6a1c0e5b-spark-shaped-c000.snappy.parquet"
+        ),
+        compression="snappy",
+    )
+    open(os.path.join(OUT, "_SUCCESS"), "w").close()
+    np.save(os.path.join(HERE, "spark_vectorudt_expected.npy"), dense_truth)
+    print(f"wrote {OUT} ({N} rows, d={D})")
+
+
+if __name__ == "__main__":
+    main()
