@@ -677,7 +677,10 @@ def bench_rf(X, mask, y, mesh, n_chips):
 
     def tr_fn(Xq, edges, feat_t, thrb_t, prob_t):
         acc = jnp.float32(0.0)
-        for lo in (0, n_half):
+        # second chunk is anchored to the END so odd n_rf still covers
+        # every row (the one-row overlap double-counts a checksum term,
+        # not timed work of any significance)
+        for lo in (0, n_rf - n_half):
             xbq = binize(Xq[lo : lo + n_half], edges, d_pad=d_pad4)
             acc = acc + _checksum(
                 rf_classify_bins(

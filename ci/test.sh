@@ -36,6 +36,13 @@ else
     python -m pytest tests/ -q --runslow
 fi
 
+echo "== notebooks (headless, CPU) =="
+if python -c "import nbclient, nbformat, ipykernel" 2>/dev/null; then
+    python ci/run_notebooks.py
+else
+    echo "nbclient/ipykernel not installed; skipping notebook execution"
+fi
+
 echo "== benchmark smoke =="
 ./run_benchmark.sh cpu 5000 64
 

@@ -249,7 +249,9 @@ items = rng.normal(size=(n_items, n_cols)).astype(np.float32)
 queries = items[rng.choice(n_items, n_queries, replace=False)] + \\
     0.01 * rng.normal(size=(n_queries, n_cols)).astype(np.float32)
 df_items = DataFrame({"features": items, "id": np.arange(n_items).astype(np.float64)})
-df_queries = DataFrame({"features": queries})"""),
+# with a custom idCol the QUERY frame carries ids too (Spark parity)
+df_queries = DataFrame({"features": queries,
+                        "id": np.arange(n_queries).astype(np.float64)})"""),
         ("md", "### Exact brute-force kNN (ring top-k on device)"),
         ("code", """\
 from spark_rapids_ml_tpu.knn import NearestNeighbors

@@ -91,11 +91,12 @@ def main():
             for j, v in zip(nz, vv):
                 dense_truth[i, j] = v
         else:
-            # dense row (type=1): Spark leaves size/indices null
+            # dense row (type=1): Spark serializes (1, None, None, values)
+            # — size AND indices are null, not empty
             vv = [float(i), float(i) / 2.0, float(i % 5), -1.0]
             types.append(1)
             sizes.append(None)
-            indices.append([])
+            indices.append(None)
             values.append(vv)
             dense_truth[i] = vv
 
