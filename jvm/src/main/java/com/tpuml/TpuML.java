@@ -1,0 +1,58 @@
+/*
+ * JNA binding for libtpuml.so — the TPU-side counterpart of the
+ * reference's JNI surface (reference:
+ * jvm/src/main/java/com/nvidia/rapids/ml/JniRAPIDSML.java:64-77, backed
+ * by jvm/src/main/cpp/src/rapidsml_jni.cu). Where the reference hand-rolls
+ * JNI stubs + a native glue library, this binds the published C ABI
+ * (native/include/tpuml.h) directly: no generated headers, no JNI glue,
+ * same entry points.
+ *
+ * Build recipe (any machine with a JDK; jna.jar from Maven Central):
+ *   javac -cp jna-5.14.0.jar -d out \
+ *       jvm/src/main/java/com/tpuml/TpuML.java \
+ *       jvm/src/test/java/com/tpuml/TpuMLRoundTrip.java
+ *   java  -cp out:jna-5.14.0.jar -Djna.library.path=native/build \
+ *       com.tpuml.TpuMLRoundTrip
+ *
+ * The image this repo builds in carries no JDK, so CI compiles this file
+ * only where `javac` exists (tests/test_native.py::test_jvm_binding_compiles).
+ */
+package com.tpuml;
+
+import com.sun.jna.Library;
+import com.sun.jna.Native;
+
+public interface TpuML extends Library {
+    TpuML I = Native.load("tpuml", TpuML.class);
+
+    /** Bind a CBLAS shared object; returns adopted int width (32/64),
+     *  -1 unloadable, -2 no dsyrk/dgemm. One-shot per process. */
+    int tpuml_set_blas(String path);
+
+    /** 0 while unbound, else the bound ABI's int width. */
+    int tpuml_blas_bits();
+
+    /** out(d,d) += X^T X, row-major (n,d), f64 accumulation. */
+    void tpuml_gram_f64(double[] X, long n, long d, double[] out);
+
+    /** f32 input widened blockwise to f64 before accumulation. */
+    void tpuml_gram_f32(float[] X, long n, long d, double[] out);
+
+    /** out(d) += column sums of a row-major (n,d) f32 batch. */
+    void tpuml_colsum_f32(float[] X, long n, long d, double[] out);
+
+    /** In-place largest-|entry|-positive sign convention on (k,d). */
+    void tpuml_sign_flip(double[] components, long k, long d);
+
+    /** Top-k eigendecomposition of a symmetric covariance; 0 on success. */
+    int tpuml_eig_cov(double[] cov, long d, long k, double scale,
+                      double[] components, double[] eigenvalues,
+                      double[] singular);
+
+    /** out(n,k) = X @ components^T, f32 in/out, f64 inner accumulation. */
+    void tpuml_gemm_transform_f32(float[] X, long n, long d,
+                                  double[] components, long k, float[] out);
+
+    /** ABI version of the bound library. */
+    int tpuml_version();
+}

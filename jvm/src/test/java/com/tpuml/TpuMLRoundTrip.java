@@ -1,0 +1,78 @@
+/*
+ * Round-trip test for the JNA binding: a tiny PCA through the native
+ * pipeline — gram -> eig_cov -> sign_flip -> gemm_transform — asserting
+ * the same invariants tests/test_native.py checks from Python/ctypes
+ * (orthonormal components, descending eigenvalues, projection shape).
+ * Plain main() so it runs without a test framework:
+ *
+ *   java -cp out:jna-5.14.0.jar -Djna.library.path=native/build \
+ *       com.tpuml.TpuMLRoundTrip
+ */
+package com.tpuml;
+
+public final class TpuMLRoundTrip {
+    public static void main(String[] args) {
+        final int n = 64, d = 8, k = 3;
+        final TpuML t = TpuML.I;
+        if (t.tpuml_version() <= 0) {
+            throw new AssertionError("tpuml_version must be positive");
+        }
+
+        final java.util.Random rng = new java.util.Random(7);
+        final float[] X = new float[n * d];
+        for (int i = 0; i < X.length; i++) X[i] = (float) rng.nextGaussian();
+
+        final double[] gram = new double[d * d];
+        t.tpuml_gram_f32(X, n, d, gram);
+        // symmetry of the accumulated Gram
+        for (int i = 0; i < d; i++)
+            for (int j = 0; j < d; j++)
+                assertClose(gram[i * d + j], gram[j * d + i], 1e-9, "gram sym");
+
+        final double[] cov = new double[d * d];
+        for (int i = 0; i < d * d; i++) cov[i] = gram[i] / (n - 1);
+        final double[] comps = new double[k * d];
+        final double[] eig = new double[k];
+        final double[] sing = new double[k];
+        final int rc = t.tpuml_eig_cov(cov, d, k, n - 1.0, comps, eig, sing);
+        if (rc != 0) throw new AssertionError("eig_cov rc=" + rc);
+        for (int i = 1; i < k; i++) {
+            if (eig[i] > eig[i - 1] + 1e-12)
+                throw new AssertionError("eigenvalues not descending");
+        }
+        // orthonormal rows
+        for (int a = 0; a < k; a++)
+            for (int b = 0; b < k; b++) {
+                double dot = 0;
+                for (int j = 0; j < d; j++)
+                    dot += comps[a * d + j] * comps[b * d + j];
+                assertClose(dot, a == b ? 1.0 : 0.0, 1e-9, "orthonormal");
+            }
+
+        t.tpuml_sign_flip(comps, k, d);
+        for (int a = 0; a < k; a++) {
+            double best = 0;
+            for (int j = 0; j < d; j++)
+                if (Math.abs(comps[a * d + j]) > Math.abs(best))
+                    best = comps[a * d + j];
+            if (best < 0) throw new AssertionError("sign_flip convention");
+        }
+
+        final float[] out = new float[n * k];
+        t.tpuml_gemm_transform_f32(X, n, d, comps, k, out);
+        double norm = 0;
+        for (float v : out) norm += v * v;
+        if (!(norm > 0)) throw new AssertionError("projection is zero");
+
+        System.out.println("TpuMLRoundTrip OK (version "
+                + t.tpuml_version() + ", blas_bits " + t.tpuml_blas_bits()
+                + ")");
+    }
+
+    private static void assertClose(double a, double b, double tol, String what) {
+        if (Math.abs(a - b) > tol)
+            throw new AssertionError(what + ": " + a + " vs " + b);
+    }
+
+    private TpuMLRoundTrip() {}
+}

@@ -1,0 +1,159 @@
+"""Generate markdown API reference pages under docs/api/ from docstrings
+(the committed-output analog of the reference's Sphinx site,
+``/root/reference/docs/site/api/``; this image has no sphinx/pdoc, so the
+generator is dependency-free inspect walking).
+
+Run from the repo root on CPU:
+    JAX_PLATFORMS=cpu python scripts/gen_api_docs.py
+"""
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs", "api"
+)
+
+# public surface: module -> classes/functions to document (None = every
+# public name defined in the module)
+MODULES = [
+    ("spark_rapids_ml_tpu.classification", None),
+    ("spark_rapids_ml_tpu.regression", None),
+    ("spark_rapids_ml_tpu.clustering", None),
+    ("spark_rapids_ml_tpu.feature", None),
+    ("spark_rapids_ml_tpu.knn", None),
+    ("spark_rapids_ml_tpu.umap", None),
+    ("spark_rapids_ml_tpu.tuning", None),
+    ("spark_rapids_ml_tpu.evaluation", None),
+    ("spark_rapids_ml_tpu.metrics", None),
+    ("spark_rapids_ml_tpu.pipeline", None),
+    ("spark_rapids_ml_tpu.params", ["Param", "Params", "TypeConverters"]),
+    ("spark_rapids_ml_tpu.data", ["DataFrame"]),
+    ("spark_rapids_ml_tpu.data.dataframe", ["ParquetScanFrame"]),
+    ("spark_rapids_ml_tpu.core", ["_TpuEstimator", "_TpuModel"]),
+    ("spark_rapids_ml_tpu.native", None),
+    ("spark_rapids_ml_tpu.parallel.context", ["TpuDistContext"]),
+    ("spark_rapids_ml_tpu.parallel.mesh", None),
+    ("spark_rapids_ml_tpu.ops.streaming", None),
+    ("spark_rapids_ml_tpu.utils.platform", None),
+    ("spark_rapids_ml_tpu.utils.profiling", None),
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def _doc(obj):
+    d = inspect.getdoc(obj)
+    return d or ""
+
+
+def render_class(name, cls):
+    lines = [f"### class `{name}{_sig(cls.__init__) if '__init__' in cls.__dict__ else ''}`", ""]
+    d = _doc(cls)
+    if d:
+        lines += [d, ""]
+    members = []
+    seen = set()
+    own = set(vars(cls))
+    for klass in cls.__mro__:
+        if not klass.__module__.startswith("spark_rapids_ml_tpu"):
+            continue
+        for mname, m in sorted(vars(klass).items()):
+            if mname.startswith("_") or mname in seen:
+                continue
+            seen.add(mname)
+            inh = "" if mname in own else ", inherited"
+            if isinstance(m, property):
+                members.append((mname, f"property{inh}",
+                                _doc(m.fget) if m.fget else ""))
+            elif isinstance(m, (classmethod, staticmethod)):
+                fn = m.__func__
+                kind = ("classmethod" if isinstance(m, classmethod)
+                        else "staticmethod") + inh
+                members.append((f"{mname}{_sig(fn)}", kind, _doc(fn)))
+            elif inspect.isfunction(m):
+                members.append((f"{mname}{_sig(m)}", f"method{inh}", _doc(m)))
+    members.sort(key=lambda t: t[0])
+    for label, kind, doc in members:
+        lines.append(f"- **`{label}`** *({kind})*")
+        if doc:
+            first = doc.splitlines()
+            head = first[0]
+            lines.append(f"  — {head}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def render_function(name, fn):
+    lines = [f"### `{name}{_sig(fn)}`", ""]
+    d = _doc(fn)
+    if d:
+        lines += [d, ""]
+    return "\n".join(lines)
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    # stale pages from renamed/delisted modules must not linger in the
+    # committed output
+    for f in os.listdir(OUT):
+        if f.endswith(".md"):
+            os.remove(os.path.join(OUT, f))
+    index = [
+        "# spark_rapids_ml_tpu API reference",
+        "",
+        "Generated from docstrings by `scripts/gen_api_docs.py` "
+        "(committed output — regenerate after changing public surfaces).",
+        "",
+    ]
+    for modname, names in MODULES:
+        mod = importlib.import_module(modname)
+        if names is None:
+            names = [
+                n for n in (getattr(mod, "__all__", None) or sorted(vars(mod)))
+                if not n.startswith("_")
+                and getattr(getattr(mod, n, None), "__module__", "").startswith(
+                    "spark_rapids_ml_tpu"
+                )
+            ]
+        page = [f"# `{modname}`", ""]
+        d = _doc(mod)
+        if d:
+            page += [d, ""]
+        count = 0
+        for n in names:
+            obj = getattr(mod, n, None)
+            if obj is None:
+                continue
+            if inspect.isclass(obj):
+                page.append(render_class(n, obj))
+                count += 1
+            elif inspect.isfunction(obj):
+                page.append(render_function(n, obj))
+                count += 1
+        if count == 0:
+            continue
+        fname = modname.replace("spark_rapids_ml_tpu", "srmt").replace(".", "_") + ".md"
+        with open(os.path.join(OUT, fname), "w") as f:
+            f.write("\n".join(page))
+        index.append(f"- [`{modname}`]({fname}) — {count} documented entries")
+        print(f"wrote docs/api/{fname} ({count} entries)")
+    with open(os.path.join(OUT, "index.md"), "w") as f:
+        f.write("\n".join(index) + "\n")
+    print("wrote docs/api/index.md")
+
+
+if __name__ == "__main__":
+    main()

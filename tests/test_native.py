@@ -2,6 +2,8 @@
 NativePCA pipeline vs the TPU-path PCA (the reference's PCASuite.scala
 checks GPU PCA against mllib RowMatrix up to sign, 1e-5; :43-90)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -202,3 +204,45 @@ def test_header_declares_abi_and_links():
         # and a hard pin here would be a third place encoding the version
         got = int(out.stdout.strip().removeprefix("version="))
         assert got >= native._ABI_VERSION, (got, native._ABI_VERSION)
+
+
+def test_jvm_binding_compiles(tmp_path):
+    """Compile-check the JNA binding sources (jvm/) where a JDK exists.
+
+    The image carries no jna.jar, so compilation runs against a minimal
+    com.sun.jna stub (Library/Native signatures only) — enough to catch
+    syntax/type drift in our sources; machines with the real jar use the
+    recipe in TpuML.java's header. Skips where javac is absent (this
+    image), mirroring the live-pyspark tier's design."""
+    import shutil
+    import subprocess
+
+    javac = shutil.which("javac")
+    if javac is None:
+        pytest.skip("no JDK in this image — compile-checked where javac exists")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stub = tmp_path / "com" / "sun" / "jna"
+    stub.mkdir(parents=True)
+    (stub / "Library.java").write_text(
+        "package com.sun.jna;\npublic interface Library {}\n"
+    )
+    (stub / "Native.java").write_text(
+        "package com.sun.jna;\npublic final class Native {\n"
+        "  public static <T extends Library> T load(String n, Class<T> c)"
+        " { return null; }\n  private Native() {}\n}\n"
+    )
+    out = tmp_path / "out"
+    out.mkdir()
+    subprocess.run(
+        [
+            javac, "-d", str(out), "-cp", str(tmp_path),
+            str(stub / "Library.java"), str(stub / "Native.java"),
+            os.path.join(repo, "jvm/src/main/java/com/tpuml/TpuML.java"),
+            os.path.join(
+                repo, "jvm/src/test/java/com/tpuml/TpuMLRoundTrip.java"
+            ),
+        ],
+        check=True,
+    )
+    assert (out / "com" / "tpuml" / "TpuML.class").exists()
