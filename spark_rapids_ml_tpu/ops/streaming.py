@@ -173,13 +173,19 @@ def prefetch_chunks(it, depth: Optional[int] = None):
     th.start()
     try:
         while True:
-            # fail fast: a producer exception surfaces on the NEXT get,
-            # not after `depth` already-buffered chunks drain (those
-            # chunks are valid but the stream is doomed — callers want
-            # the error, not more partial work)
+            # fail fast, but deliver what was produced: chunks already in
+            # the queue predate the failure and are valid; once the queue
+            # is empty and the producer has recorded an error, raise
+            # immediately instead of waiting for the end sentinel behind
+            # `depth` buffered puts
             if err:
-                raise err[0]
-            c = q.get()
+                try:
+                    c = q.get_nowait()
+                except queue.Empty:
+                    # the internal Empty is not part of the user's error
+                    raise err[0] from None
+            else:
+                c = q.get()
             if c is end:
                 break
             yield c

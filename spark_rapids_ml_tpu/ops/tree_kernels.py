@@ -407,8 +407,13 @@ def _hist_compact(
     # _sorted_block_reduce): integer stats AND total weighted rows small
     # enough that no per-column global prefix can reach 2^24 (Poisson
     # bootstrap weights average 1, so n rows bounds the count column up
-    # to tail factors the 2^23 margin absorbs)
-    use_cumsum = (not variance) and n <= (1 << 23)
+    # to tail factors the 2^23 margin absorbs). Width-gated too: the
+    # prefix array is a materialized (n_sb, W) transient, and at the
+    # 1M x 3000 reference shape (W = 16384) the cumsum formulation
+    # measured ~25% SLOWER end-to-end than the segment_sum it replaces
+    # (182 s vs 146 s full fit) — keep it to bench-class widths
+    def _use_cumsum(width):
+        return (not variance) and n <= (1 << 23) and width <= 8192
 
     if full_bins is not None:
         # fused-selection path: ONE whole-row gather of the uint8 bins
@@ -425,7 +430,7 @@ def _hist_compact(
             variance=variance, interpret=interpret,
         )                                                   # (n_sb, S, F*nb)
         p2d = partials.reshape(n_sb, S * F * nb)
-        if use_cumsum:
+        if _use_cumsum(S * F * nb):
             hist_nodes = _sorted_block_reduce(
                 p2d, pstart, r_sub, n_nodes
             ).reshape(n_nodes, S, F, nb)
@@ -451,7 +456,7 @@ def _hist_compact(
                 variance=variance, interpret=interpret,
             )                                               # (n_sb, S, Fc*nb)
             p2d = partials.reshape(n_sb, S * Fc * nb)
-            if use_cumsum:
+            if _use_cumsum(S * Fc * nb):
                 part = _sorted_block_reduce(p2d, pstart, r_sub, n_nodes)
             else:
                 part = jax.ops.segment_sum(
