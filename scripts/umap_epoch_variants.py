@@ -105,7 +105,7 @@ def main():
     knn_i = idx_np[keep].reshape(n, k)
     knn_d = dists_np[keep].reshape(n, k)
     heads, tails, w = fuzzy_simplicial_set(knn_i, knn_d, 1.0, 1.0)
-    rh, tp, pp = build_row_adjacency(heads, tails, w, n, K=32)
+    rh, tp, pp = build_row_adjacency(heads, tails, w, n, K=24)
     a, b = find_ab_params(1.0, 0.1)
     emb0 = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
     args = (jnp.asarray(rh), jnp.asarray(tp), jnp.asarray(pp),

@@ -70,7 +70,7 @@ def main():
     print(f"spectral init {time.perf_counter() - t0:.2f}s")
 
     t0 = time.perf_counter()
-    rh, tp, pp = build_row_adjacency(heads, tails, weights, n, K=32)
+    rh, tp, pp = build_row_adjacency(heads, tails, weights, n, K=24)
     print(f"row adjacency {time.perf_counter() - t0:.2f}s  R={len(rh)}")
 
     a, b = find_ab_params(1.0, 0.1)

@@ -315,8 +315,10 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         # Graduate the row bucket for small fits so they don't spend most
         # SGD work on inert padding.
         row_bucket = 4096 if n >= 4096 else 256
+        # K=24 measured best at the bench shape (9.2 vs 10.7 ms/epoch at
+        # K=32): fewer inert padding slots than 32, fewer split rows than 16
         row_heads, tails_pad, p_pad = build_row_adjacency(
-            heads, tails, weights, n, K=32, row_bucket=row_bucket
+            heads, tails, weights, n, K=24, row_bucket=row_bucket
         )
         n_epochs = self._tpu_params.get("n_epochs") or default_n_epochs(n)
         emb0 = jnp.asarray(emb0)
