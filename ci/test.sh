@@ -46,4 +46,13 @@ fi
 echo "== benchmark smoke =="
 ./run_benchmark.sh cpu 5000 64
 
+echo "== transform bench smoke (rf packed engine + umap) =="
+# Serving-path contract: the rf and umap entries must emit
+# transform_vs_baseline (BENCH_REQUIRE_TRANSFORM makes a silently
+# dropped rf transform metric a hard failure). Tiny CPU scales — this
+# checks the metric plumbing, not the TPU throughput target.
+JAX_PLATFORMS=cpu BENCH_ONLY=rf,umap BENCH_REQUIRE_TRANSFORM=rf \
+    BENCH_ROWS=4096 BENCH_RF_ROWS=4096 BENCH_RF_TREES=4 BENCH_RF_DEPTH=8 \
+    BENCH_UMAP_ROWS=1024 python bench.py
+
 echo "CI OK"

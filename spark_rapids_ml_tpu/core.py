@@ -645,6 +645,7 @@ class _TpuModel(Params, _TpuParams):
                         self.logger, "transform(streamed)"
                     ):
                         out_columns = self._apply_streamed(fn, dataset, input_col)
+                    self._log_transform_stages()
                 return AugmentedScanFrame(dataset, out_columns)
         X = self._extract_features_for_transform(dataset)
         with _x64_ctx(X.dtype):
@@ -653,10 +654,19 @@ class _TpuModel(Params, _TpuParams):
                 self.logger, "transform"
             ):
                 out_columns = self._apply_batched(fn, X)
+            self._log_transform_stages()
         out = dataset
         for name, col in out_columns.items():
             out = out.withColumn(name, col)
         return out
+
+    def _log_transform_stages(self) -> None:
+        """Emit the per-stage wall-clock breakdown a transform engine
+        accumulated (models attach a ``profiling.StageTimer`` as
+        ``_transform_stage_timer``; no-op otherwise)."""
+        st = getattr(self, "_transform_stage_timer", None)
+        if st is not None:
+            st.log_summary(self.logger)
 
     def _apply_streamed(
         self,
@@ -684,20 +694,39 @@ class _TpuModel(Params, _TpuParams):
     def _transform_batch_rows(self) -> int:
         return 1 << 17  # 131072 rows/batch keeps HBM use bounded
 
+    # Models whose transform kernels accept committed device arrays set
+    # this to overlap host->device staging of batch i+1 with batch i's
+    # compute (the async dispatch returns before device work finishes, so
+    # the explicit device_put below it runs during the previous batch).
+    _transform_device_staging = False
+
     def _apply_batched(
         self,
         fn: Callable[[np.ndarray], Dict[str, np.ndarray]],
         X: np.ndarray,
     ) -> Dict[str, np.ndarray]:
+        staging = self._transform_device_staging
         n = X.shape[0]
         bs = self._transform_batch_rows()
         if n <= bs:
-            return {k: np.asarray(v)[:n] for k, v in fn(X).items()}
+            Xb = jax.device_put(X) if staging else X
+            return {k: np.asarray(v)[:n] for k, v in fn(Xb).items()}
         chunks: Dict[str, List[np.ndarray]] = {}
+        nxt = jax.device_put(X[:bs]) if staging else X[:bs]
         for lo in range(0, n, bs):
-            part = fn(X[lo : lo + bs])
+            cur = nxt
+            hi = min(lo + bs, n)
+            if hi < n:
+                # double-buffer: stage the NEXT batch before materializing
+                # this batch's outputs (np.asarray below blocks on device)
+                nxt = (
+                    jax.device_put(X[hi : hi + bs])
+                    if staging
+                    else X[hi : hi + bs]
+                )
+            part = fn(cur)
             for k, v in part.items():
-                chunks.setdefault(k, []).append(np.asarray(v)[: min(bs, n - lo)])
+                chunks.setdefault(k, []).append(np.asarray(v)[: hi - lo])
         return {k: np.concatenate(v, axis=0) for k, v in chunks.items()}
 
     # ---- multi-model support (CV single-pass) ----------------------------

@@ -38,6 +38,7 @@ __all__ = [
     "linear_regression_to_sklearn",
     "logistic_regression_to_sklearn",
     "random_forest_to_sklearn",
+    "random_forest_packed",
     "to_sklearn",
 ]
 
@@ -247,6 +248,49 @@ def random_forest_to_sklearn(model: Any):
     forest.n_features_in_ = d
     forest.n_outputs_ = 1
     return forest
+
+
+def random_forest_packed(model: Any) -> dict:
+    """The FIL-style packed SoA layout of a fitted forest, as plain numpy.
+
+    Returns the exact tensors the lockstep transform engine traverses
+    (``ops/tree_kernels.pack_forest``): breadth-first interleaved,
+    lane-width padded, hop-split at ``k1``. Packing runs at most once per
+    model — the layout is cached on the model object and persisted through
+    save/load, so calling this on a freshly loaded round-5+ model does no
+    repacking work. Keys:
+
+    * ``feat1``/``thr1`` — ``(T_pad, 2^k1 - 1)`` int32 hop-1 heap levels
+      (feature id / bin threshold; ``feat < 0`` marks leaves).
+    * ``feat2``/``thr2`` — ``(T_pad * 2^k1, 64)`` int32 hop-2 subtree
+      tables, one 64-lane row per hop-1 exit slot (empty ``(0, 64)`` when
+      the forest is shallow enough that hop 1 reaches every leaf).
+    * ``meta`` — ``{"n_trees", "k1", "k2", "max_depth"}``; ``n_trees`` is
+      the REAL tree count, rows beyond it in ``feat1`` are all-leaf
+      padding to the sublane multiple of 8.
+    """
+    from .models.tree import _RandomForestModel
+
+    if not isinstance(model, _RandomForestModel):
+        raise TypeError(f"expected a RandomForest model, got {type(model).__name__}")
+    if model._model_attributes.get("threshold_bins") is None:
+        raise ValueError(
+            "model predates bin-space tables (pre-round-5 save); "
+            "re-fit to obtain the packed layout"
+        )
+    pf = model._ensure_packed()
+    return {
+        "feat1": np.asarray(pf.feat1),
+        "thr1": np.asarray(pf.thr1),
+        "feat2": np.asarray(pf.feat2),
+        "thr2": np.asarray(pf.thr2),
+        "meta": {
+            "n_trees": pf.n_trees,
+            "k1": pf.k1,
+            "k2": pf.k2,
+            "max_depth": pf.max_depth,
+        },
+    }
 
 
 def to_sklearn(model: Any):

@@ -93,6 +93,9 @@ class _RandomForestClass:
             "checkpointInterval": "",
             "subsamplingRate": "",
             "minWeightFractionPerNode": "",
+            # weightCol stays unmapped (raise-on-set): see the guard note
+            # at the ``weightCol`` Param declaration below before wiring
+            # real-valued row weights through
             "weightCol": None,
             "leafCol": None,
         }
@@ -158,6 +161,14 @@ class _RandomForestParams(
     minWeightFractionPerNode = _mk(
         "minWeightFractionPerNode", "min weight fraction (ignored)", TypeConverters.toFloat
     )
+    # GUARD: keep weightCol unsupported until the histogram reduction is
+    # re-audited. The builder's cumsum boundary-diff strategy
+    # (``ops/tree_kernels.py`` ``_use_cumsum``) is gated on stats staying
+    # EXACT in f32 prefix sums, which holds because bootstrap row weights
+    # are small integers (Poisson, mean 1) — count columns stay integers
+    # below the 2^24 mantissa bound. Arbitrary real-valued weights break
+    # that exactness argument; wiring weightCol through would need the
+    # cumsum gate forced off (or a weight-scale analysis) first.
     weightCol = _mk("weightCol", "weight column (unsupported)", TypeConverters.toString)
     leafCol = _mk("leafCol", "leaf index column (unsupported)", TypeConverters.toString)
 
@@ -312,6 +323,19 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
             step = max(1, inputs.n_rows // 131072)
             valid_pos = np.nonzero(fetch_global(inputs.mask, inputs.mesh) > 0)[0]
             sample = gather_rows_global(inputs.X, valid_pos[::step], inputs.mesh)
+            # Input contract: features must be FINITE. binize routes NaN
+            # to bin 0 (compare-count semantics; see its docstring) where
+            # searchsorted would route it to the top bin — consistent
+            # between fit and transform, but silently different from
+            # engines that impute. The quantile sample is already on the
+            # host, so screening it is ~free; TPUML_RF_CHECK_FINITE=1
+            # extends the check to every transform batch.
+            if not np.isfinite(sample).all():
+                raise ValueError(
+                    "RandomForest features contain NaN/Inf; clean or "
+                    "impute before fit (binize would route non-finite "
+                    "values to bin 0)"
+                )
             edges_np = make_bin_edges(sample, n_bins, seed=seed)
             bins = binize(inputs.X, jnp.asarray(edges_np), d_pad=d_pad)
 
@@ -459,17 +483,24 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
         m = self._features_arr.shape[1]
         return int(math.log2(m + 1)) - 1
 
-    def _bins_apply_ready(self) -> bool:
-        """True when transform can use the two-hop bin-space descent:
-        the model carries its bin tables (round-5+ fits), the built depth
-        fits the two-hop split (k1 <= 8), and the path is not disabled.
-        TPUML_RF_APPLY=legacy forces the raw-threshold descent;
-        =bins forces bin-space everywhere (incl. CPU, for parity tests)."""
+    def _apply_mode(self) -> str:
+        """Validated transform-engine selector. TPUML_RF_APPLY=legacy
+        forces the raw-threshold descent, =bins the per-tree bin-space
+        descent (incl. CPU, for parity tests), =packed the packed-forest
+        lockstep engine (falls back down the chain if its kernel cannot
+        lower); auto prefers packed > bins > legacy on TPU."""
         mode = os.environ.get("TPUML_RF_APPLY", "auto")
-        if mode not in ("auto", "legacy", "bins"):
+        if mode not in ("auto", "legacy", "bins", "packed"):
             raise ValueError(
-                f"TPUML_RF_APPLY must be auto|legacy|bins, got {mode!r}"
+                f"TPUML_RF_APPLY must be auto|legacy|bins|packed, got {mode!r}"
             )
+        return mode
+
+    def _bins_apply_ready(self) -> bool:
+        """True when transform can use the bin-space descents: the model
+        carries its bin tables (round-5+ fits) and the built depth fits
+        the two-hop split (k1 <= 8)."""
+        mode = self._apply_mode()
         if mode == "legacy":
             return False
         has = (
@@ -477,9 +508,62 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
             and self._model_attributes.get("bin_edges") is not None
         )
         ok = has and self._max_depth_built <= 14
-        if mode == "bins":
+        if mode in ("bins", "packed"):
             return ok
         return ok and jax.default_backend() == "tpu"
+
+    def _packed_apply_ready(self) -> bool:
+        """True when transform can use the packed-forest engine: bin
+        tables present AND the lockstep traversal kernel lowers for this
+        forest shape (or the forest is shallow enough that hop-1 alone
+        reaches every leaf — no kernel needed)."""
+        if self._apply_mode() == "bins" or not self._bins_apply_ready():
+            return False
+        from ..ops.rf_pallas import packed_traverse_ok
+
+        pf = self._ensure_packed()
+        if pf.k2 == 0:
+            return True
+        d = int(np.asarray(self._model_attributes["bin_edges"]).shape[0])
+        words = -(-d // 4)  # binize pads features to the word boundary
+        return packed_traverse_ok(pf.feat1.shape[0], pf.k1, pf.k2, words)
+
+    def _ensure_packed(self):
+        """The packed SoA forest layout, computed once per model and
+        persisted through the standard attribute round-trip: saved models
+        reload PRE-PACKED (the arrays land in model.npz; ``pack_forest``
+        never reruns after a load)."""
+        pf = getattr(self, "_packed_cache", None)
+        if pf is not None:
+            return pf
+        from ..ops.tree_kernels import PackedForest, pack_forest
+
+        ma = self._model_attributes
+        if ma.get("packed_feat1") is not None and ma.get("packed_meta") is not None:
+            meta = np.asarray(ma["packed_meta"]).astype(np.int64)
+            pf = PackedForest(
+                feat1=np.asarray(ma["packed_feat1"], dtype=np.int32),
+                thr1=np.asarray(ma["packed_thr1"], dtype=np.int32),
+                feat2=np.asarray(ma["packed_feat2"], dtype=np.int32),
+                thr2=np.asarray(ma["packed_thr2"], dtype=np.int32),
+                n_trees=int(meta[0]), k1=int(meta[1]), k2=int(meta[2]),
+                max_depth=int(meta[3]),
+            )
+        else:
+            pf = pack_forest(
+                self._features_arr,
+                np.asarray(ma["threshold_bins"]),
+                max_depth=self._max_depth_built,
+            )
+            ma["packed_feat1"] = pf.feat1
+            ma["packed_thr1"] = pf.thr1
+            ma["packed_feat2"] = pf.feat2
+            ma["packed_thr2"] = pf.thr2
+            ma["packed_meta"] = np.asarray(
+                [pf.n_trees, pf.k1, pf.k2, pf.max_depth], dtype=np.int32
+            )
+        self._packed_cache = pf
+        return pf
 
     def _make_binize_for_apply(self) -> Callable[[np.ndarray], jax.Array]:
         """Per-batch quantizer with the edges table hoisted device-side
@@ -489,7 +573,70 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
         edges = jnp.asarray(np.asarray(self._model_attributes["bin_edges"]))
         d = edges.shape[0]
         d_pad = -(-d // 4) * 4  # word-packing alignment
+        if os.environ.get("TPUML_RF_CHECK_FINITE", "0") == "1":
+            # opt-in serving-boundary guard for the finite-input contract
+            # (binize routes NaN to bin 0; see its docstring + the fit
+            # boundary check) — a full host pass per batch, so off by
+            # default on the hot path
+            def _binz(Xb):
+                if not np.isfinite(np.asarray(Xb)).all():
+                    raise ValueError(
+                        "RandomForest transform batch contains NaN/Inf "
+                        "(finite-input contract, TPUML_RF_CHECK_FINITE=1)"
+                    )
+                return binize(jnp.asarray(Xb), edges, d_pad=d_pad)
+
+            return _binz
         return lambda Xb: binize(jnp.asarray(Xb), edges, d_pad=d_pad)
+
+    # -- shared transform dispatch -----------------------------------------
+    # Classification and regression route through ONE engine resolution:
+    # packed lockstep traversal when its kernel lowers, the per-tree
+    # bin-space descent when bin tables exist, the raw-threshold descent
+    # otherwise. The resolved closure (device-resident operands + jitted
+    # callable) is cached on the model; ``core._apply_batched`` + the
+    # device-staging flag micro-batch rows through it with the next batch
+    # staged host->device while the current one computes.
+
+    _transform_device_staging = True
+
+    def _stage_timer(self):
+        from ..utils.profiling import StageTimer
+
+        st = getattr(self, "_transform_stage_timer", None)
+        if st is None:
+            st = StageTimer(f"{type(self).__name__}.transform")
+            self._transform_stage_timer = st
+        return st
+
+    def _get_tpu_transform_func(
+        self, dataset: Optional[DataFrame] = None
+    ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        if self._packed_apply_ready():
+            engine = "packed"
+        elif self._bins_apply_ready():
+            engine = "bins"
+        else:
+            engine = "legacy"
+        key = (engine, tuple(self._out_cols()))
+        cached = getattr(self, "_transform_engine_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        fn = getattr(self, f"_{engine}_transform_fn")()
+        self._transform_engine_cache = (key, fn)
+        return fn
+
+    def _out_cols(self) -> List[str]:
+        return [self.getOrDefault("predictionCol")]
+
+    def _packed_transform_fn(self):
+        raise NotImplementedError
+
+    def _bins_transform_fn(self):
+        raise NotImplementedError
+
+    def _legacy_transform_fn(self):
+        raise NotImplementedError
 
     @property
     def numFeatures(self) -> int:
@@ -664,37 +811,67 @@ class RandomForestClassificationModel(
             self.getOrDefault("rawPredictionCol"),
         ]
 
-    def _get_tpu_transform_func(
-        self, dataset: Optional[DataFrame] = None
-    ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
-        pred_col = self.getOrDefault("predictionCol")
-        prob_col = self.getOrDefault("probabilityCol")
-        raw_col = self.getOrDefault("rawPredictionCol")
-        feat = jnp.asarray(self._features_arr)
-        thr = jnp.asarray(self._thresholds_arr)
+    def _packed_transform_fn(self) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        from ..ops.tree_kernels import rf_classify_packed
+
+        pred_col, prob_col, raw_col = self._out_cols()
+        pf = self._ensure_packed()
+        feat1, thr1 = jnp.asarray(pf.feat1), jnp.asarray(pf.thr1)
+        feat2, thr2 = jnp.asarray(pf.feat2), jnp.asarray(pf.thr2)
         leafp = jnp.asarray(self._leaf_probs())
-        depth = self._max_depth_built
+        binz = self._make_binize_for_apply()
+        st = self._stage_timer()
 
-        if self._bins_apply_ready():
-            from ..ops.tree_kernels import rf_classify_bins
-
-            thrb = jnp.asarray(
-                np.asarray(self._model_attributes["threshold_bins"])
-            )
-            binz = self._make_binize_for_apply()
-
-            def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
-                pred, prob, raw = rf_classify_bins(
-                    binz(Xb), feat, thrb, leafp,
-                    max_depth=depth,
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            with st.stage("dispatch"):
+                pred, prob, raw = rf_classify_packed(
+                    binz(Xb), feat1, thr1, feat2, thr2, leafp,
+                    k1=pf.k1, k2=pf.k2, max_depth=pf.max_depth,
+                    pred_dtype=np.dtype(Xb.dtype),
                 )
+            with st.stage("host_out"):
                 return {
-                    pred_col: np.asarray(pred, dtype=Xb.dtype),
+                    pred_col: np.asarray(pred),
                     prob_col: np.asarray(prob),
                     raw_col: np.asarray(raw),
                 }
 
-            return _fn
+        return _fn
+
+    def _bins_transform_fn(self) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        from ..ops.tree_kernels import rf_classify_bins
+
+        pred_col, prob_col, raw_col = self._out_cols()
+        feat = jnp.asarray(self._features_arr)
+        leafp = jnp.asarray(self._leaf_probs())
+        depth = self._max_depth_built
+        thrb = jnp.asarray(
+            np.asarray(self._model_attributes["threshold_bins"])
+        )
+        binz = self._make_binize_for_apply()
+        st = self._stage_timer()
+
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            with st.stage("dispatch"):
+                pred, prob, raw = rf_classify_bins(
+                    binz(Xb), feat, thrb, leafp,
+                    max_depth=depth, pred_dtype=np.dtype(Xb.dtype),
+                )
+            with st.stage("host_out"):
+                return {
+                    pred_col: np.asarray(pred),
+                    prob_col: np.asarray(prob),
+                    raw_col: np.asarray(raw),
+                }
+
+        return _fn
+
+    def _legacy_transform_fn(self) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        pred_col, prob_col, raw_col = self._out_cols()
+        feat = jnp.asarray(self._features_arr)
+        thr = jnp.asarray(self._thresholds_arr)
+        leafp = jnp.asarray(self._leaf_probs())
+        depth = self._max_depth_built
 
         def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
             pred, prob, raw = rf_classify(
@@ -800,31 +977,58 @@ class RandomForestRegressionModel(_RandomForestModel):
         ls = self._leaf_stats_arr
         return (ls[:, :, 1] / np.maximum(ls[:, :, 0], 1e-12)).astype(np.float32)
 
-    def _get_tpu_transform_func(
-        self, dataset: Optional[DataFrame] = None
-    ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
-        pred_col = self.getOrDefault("predictionCol")
+    def _packed_transform_fn(self) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        from ..ops.tree_kernels import rf_regress_packed
+
+        (pred_col,) = self._out_cols()
+        pf = self._ensure_packed()
+        feat1, thr1 = jnp.asarray(pf.feat1), jnp.asarray(pf.thr1)
+        feat2, thr2 = jnp.asarray(pf.feat2), jnp.asarray(pf.thr2)
+        leafv = jnp.asarray(self._leaf_means())
+        binz = self._make_binize_for_apply()
+        st = self._stage_timer()
+
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            with st.stage("dispatch"):
+                pred = rf_regress_packed(
+                    binz(Xb), feat1, thr1, feat2, thr2, leafv,
+                    k1=pf.k1, k2=pf.k2, max_depth=pf.max_depth,
+                )
+            with st.stage("host_out"):
+                return {pred_col: np.asarray(pred, dtype=Xb.dtype)}
+
+        return _fn
+
+    def _bins_transform_fn(self) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        from ..ops.tree_kernels import rf_regress_bins
+
+        (pred_col,) = self._out_cols()
         feat = jnp.asarray(self._features_arr)
-        thr = self._thresholds_arr
         leafv = jnp.asarray(self._leaf_means())
         depth = self._max_depth_built
+        thrb = jnp.asarray(
+            np.asarray(self._model_attributes["threshold_bins"])
+        )
+        binz = self._make_binize_for_apply()
+        st = self._stage_timer()
 
-        if self._bins_apply_ready():
-            from ..ops.tree_kernels import rf_regress_bins
-
-            thrb = jnp.asarray(
-                np.asarray(self._model_attributes["threshold_bins"])
-            )
-            binz = self._make_binize_for_apply()
-
-            def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            with st.stage("dispatch"):
                 pred = rf_regress_bins(
                     binz(Xb), feat, thrb, leafv,
                     max_depth=depth,
                 )
+            with st.stage("host_out"):
                 return {pred_col: np.asarray(pred, dtype=Xb.dtype)}
 
-            return _fn
+        return _fn
+
+    def _legacy_transform_fn(self) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        (pred_col,) = self._out_cols()
+        feat = jnp.asarray(self._features_arr)
+        thr = self._thresholds_arr
+        leafv = jnp.asarray(self._leaf_means())
+        depth = self._max_depth_built
 
         def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
             pred = rf_regress(

@@ -1,0 +1,38 @@
+"""Version shims for the narrow band of jax APIs whose spelling moved
+between the 0.4.x series and current jax.
+
+Kernels are written against the modern surface (``jax.shard_map`` with
+``check_vma``, ``pltpu.CompilerParams``); this module backfills those
+names on 0.4.x so the library imports and runs on either series without
+scattering try/except through every ops module.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:  # jax >= 0.6: public top-level export, `check_vma` kwarg
+    from jax import shard_map as shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x: experimental module, `check_rep` kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    @functools.wraps(_shard_map_04)
+    def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        if f is None:  # partial-application form: shard_map(mesh=..., ...)(f)
+            return lambda g: shard_map(
+                g, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_vma, **kw,
+            )
+        return _shard_map_04(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kw,
+        )
+
+
+def pallas_tpu_compiler_params(pltpu_module, **kwargs):
+    """Build the TPU compiler-params struct under either spelling
+    (``CompilerParams`` today, ``TPUCompilerParams`` on 0.4.x)."""
+    cls = getattr(pltpu_module, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu_module.TPUCompilerParams
+    return cls(**kwargs)

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ._compat import shard_map
 
 from ..parallel.mesh import DP_AXIS
 from .linalg import check_row_chunking, row_chunk

@@ -33,6 +33,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ._compat import pallas_tpu_compiler_params
+
 # Test hook (mirrors ops.linalg.FORCE_INTERPRET): run the kernel through
 # the Pallas interpreter on CPU so tests cover the real kernel body.
 FORCE_INTERPRET = False
@@ -188,7 +190,8 @@ def lloyd_step_pallas(
             jax.ShapeDtypeStruct((1, k_pad), jnp.int32),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),

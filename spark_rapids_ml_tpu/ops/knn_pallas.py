@@ -31,6 +31,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ._compat import pallas_tpu_compiler_params
 from jax import lax
 
 # Test hook (mirrors ops.kmeans_pallas.FORCE_INTERPRET).
@@ -182,7 +184,8 @@ def knn_pallas_pass(
             jax.ShapeDtypeStruct((nq, k), jnp.float32),
             jax.ShapeDtypeStruct((nq, k), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),

@@ -16,7 +16,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ._compat import pallas_tpu_compiler_params, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import DP_AXIS
@@ -154,7 +154,8 @@ def _shifted_gram_pallas(
             jax.ShapeDtypeStruct((d, d), jnp.float32),
             jax.ShapeDtypeStruct((1, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",),
             # 16 MB double-buffered row tiles + centering temporaries + the
             # d×d accumulator (16 MB at d=2048) need headroom past the

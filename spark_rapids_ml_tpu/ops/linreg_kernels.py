@@ -107,7 +107,7 @@ def linreg_suffstats_chunked(
     kept as the single implementation; don't re-add a Pallas path here
     without profiling past that result.
     """
-    from jax import shard_map
+    from ._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DP_AXIS

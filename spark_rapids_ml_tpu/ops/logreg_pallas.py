@@ -25,7 +25,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ._compat import pallas_tpu_compiler_params, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import DP_AXIS
@@ -168,7 +168,8 @@ def _loss_grad_pallas(Xl, yl, ml, A, b_row, *, multinomial: bool,
             jax.ShapeDtypeStruct((Kp, d), jnp.float32),
             jax.ShapeDtypeStruct((1, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),

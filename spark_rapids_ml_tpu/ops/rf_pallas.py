@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ._compat import pallas_tpu_compiler_params
+
 # Test hook (mirrors ops.linalg.FORCE_INTERPRET): run the kernel through
 # the Pallas interpreter on CPU so tests cover the real kernel body.
 FORCE_INTERPRET = False
@@ -196,7 +198,8 @@ def subblock_hist(
             (L * S, W), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((n_blocks * L * S, W), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
@@ -320,7 +323,8 @@ def subblock_hist_sel(
             (L * S, W), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((n_blocks * L * S, W), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
+            pltpu,
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
@@ -453,6 +457,190 @@ def packed_byte_gather(
         out_shape=jax.ShapeDtypeStruct((n, W), jnp.int32),
         interpret=interpret,
     )(packed, idx)
+
+
+# ---------------------------------------------------------------------------
+# packed-forest lockstep traversal (inference): hop-2 of the two-hop
+# descent for ALL trees fused into one kernel per row block
+# ---------------------------------------------------------------------------
+
+# Rows per traversal grid block. VMEM at the cap: packed rows
+# (B, 128) i32 + i1 (B, T_pad) + per-tree (B, 256) one-hot / (B, 64)
+# table-row transients + (B, T_pad) output — ~6 MB at B=1024, T_pad=64,
+# double-buffered well inside the 100 MB budget; the hop-2 tables
+# (T_pad * 2^k1, 64) f32 ride along whole (<= 4 MB at T_pad=64, k1=8).
+TRAVERSE_BLOCK = 1024
+
+_TRAVERSE_LOWERING_OK: dict = {}
+
+
+def packed_traverse_ok(t_pad: int, k1: int, k2: int, words: int) -> bool:
+    """Trace-time gate for ``packed_traverse``: TPU (or interpret), a
+    row's packed bins within one lane-shuffle width (probe: W=256 fails
+    to lower, so d_pad <= 512), the two-hop split shape in range, and a
+    probed lowering. Row-count alignment is NOT gated — the callers pad
+    rows to TRAVERSE_BLOCK internally."""
+    Wp = max(64, words)
+    ok = (
+        (jax.default_backend() == "tpu" or FORCE_INTERPRET)
+        and 1 <= k2 <= 6
+        and 1 <= k1 <= 8
+        and Wp <= 128
+        and t_pad % 8 == 0
+    )
+    if ok and not FORCE_INTERPRET:
+        key = ("trav", t_pad, k1, k2, Wp)
+
+        def compile_fn():
+            K1 = 1 << k1
+            p = jax.ShapeDtypeStruct((2 * TRAVERSE_BLOCK, Wp), jnp.int32)
+            i = jax.ShapeDtypeStruct((2 * TRAVERSE_BLOCK, t_pad), jnp.int32)
+            f = jax.ShapeDtypeStruct((t_pad * K1, 64), jnp.int32)
+            t = jax.ShapeDtypeStruct((t_pad * K1, 64), jnp.int32)
+            packed_traverse.lower(
+                p, i, f, t, k1=k1, k2=k2, d_pad=4 * words
+            ).compile()
+
+        from .linalg import probe_pallas_lowering
+
+        ok = probe_pallas_lowering(
+            _TRAVERSE_LOWERING_OK, key, compile_fn,
+            "RF packed-forest traversal",
+        )
+    return ok
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k1", "k2", "d_pad", "interpret")
+)
+def packed_traverse(
+    packed: jax.Array,   # (n, Wp) int32 word-packed row bins, n % B == 0
+    i1: jax.Array,       # (n, T_pad) int32 hop-1 heap indices
+    feat2: jax.Array,    # (T_pad * 2^k1, 64) int32 hop-2 feature tables
+    thr2: jax.Array,     # (T_pad * 2^k1, 64) int32 hop-2 thresholds
+    *,
+    k1: int,
+    k2: int,
+    d_pad: int,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Global leaf index per (row, tree): (n, T_pad) int32.
+
+    One pallas_call descends the row block through EVERY tree's hop-2
+    subtree in lockstep — the FIL move, on TPU terms. Per tree (static
+    loop, fully fused by Mosaic):
+
+      row   = onehot(l7) @ tbl[t]        table row-select on the MXU
+                                         (HIGHEST keeps f32 operands —
+                                         feature ids may exceed bf16's
+                                         exact-integer range)
+      xv    = lane-shuffle byte gather   the row's feature bins, one
+                                         in-register tpu.dynamic_gather
+      bits  = (xv > thr) & is_split      fused bin-space compare (the
+                                         exact training-side rule:
+                                         bin(x) > t  <=>  x >= edge[t])
+      leaf  = navigate + arithmetic id   masked advance, k2 steps
+
+    All integer math — leaf ids are bit-identical to the per-tree bins
+    descent. Rows already at a hop-1 leaf (i1 < 2^k1 - 1) keep their
+    hop-1 index via the final select; their hop-2 work is masked out by
+    the same select, not skipped (lockstep has no divergence)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = FORCE_INTERPRET
+    n, words = packed.shape
+    Wp = max(64, words)  # lane-shuffle operand width (gate caps at 128)
+    if words < Wp:
+        packed = jnp.pad(packed, ((0, 0), (0, Wp - words)))
+    T_pad = i1.shape[1]
+    K1 = 1 << k1
+    n1 = K1 - 1
+    LANES = feat2.shape[1]
+    B = TRAVERSE_BLOCK
+    f2f = feat2.astype(jnp.float32)
+    t2f = thr2.astype(jnp.float32)
+
+    def kern(p_ref, i_ref, f_ref, t_ref, o_ref):
+        iv1_all = i_ref[...]                               # (B, T_pad)
+        lane_k1 = lax.broadcasted_iota(jnp.int32, (B, K1), 1)
+        pbins = p_ref[...]                                 # (B, Wp)
+        cols = []
+        for t in range(T_pad):
+            iv1 = lax.slice_in_dim(iv1_all, t, t + 1, axis=1)  # (B, 1)
+            l7 = jnp.clip(iv1 - n1, 0, K1 - 1)
+            oh = (lane_k1 == l7).astype(jnp.float32)       # (B, K1)
+            ft = f_ref[t * K1 : (t + 1) * K1, :]           # (K1, 64)
+            tt = t_ref[t * K1 : (t + 1) * K1, :]
+            rfeat = jnp.dot(
+                oh, ft, precision=lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )                                              # (B, 64)
+            rthr = jnp.dot(
+                oh, tt, precision=lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )
+            ridx = jnp.clip(rfeat.astype(jnp.int32), 0, d_pad - 1)
+            if LANES < Wp:
+                ridx = jnp.concatenate(
+                    [ridx, jnp.zeros((B, Wp - LANES), jnp.int32)], axis=1
+                )
+            w = jnp.take_along_axis(pbins, ridx >> 2, axis=1)
+            xv = (w >> ((ridx & 3) * 8)) & 0xFF            # (B, Wp)
+            xv = lax.slice_in_dim(xv, 0, LANES, axis=1)    # (B, 64)
+            is_split = rfeat >= 0.0
+            bits = ((xv.astype(jnp.float32) > rthr) & is_split).astype(
+                jnp.int32
+            )
+            enc = (1 + bits) * is_split.astype(jnp.int32)  # (B, 64)
+            m = jnp.zeros_like(iv1)                        # (B, 1)
+            for s in range(k2):
+                lo = (1 << s) - 1
+                wd = 1 << s
+                sl = lax.slice_in_dim(enc, lo, lo + wd, axis=1)
+                il = jnp.clip(m - lo, 0, wd - 1)
+                lanes = lax.broadcasted_iota(jnp.int32, (B, wd), 1)
+                e = jnp.where(lanes == il, sl, 0).sum(
+                    axis=1, keepdims=True
+                )
+                e = jnp.where(m >= lo, e, 0)
+                m = jnp.where(e > 0, 2 * m + e, m)
+            delta = jnp.zeros_like(m)
+            for j in range(1, k2 + 1):
+                delta = delta + (m + 1 >= (1 << j)).astype(jnp.int32)
+            pd = jnp.left_shift(jnp.int32(1), delta)       # 2^delta
+            j_local = m - (pd - 1)
+            gid = (K1 * pd - 1) + l7 * pd + j_local
+            cols.append(jnp.where(iv1 < n1, iv1, gid))     # (B, 1)
+        o_ref[...] = jnp.concatenate(cols, axis=1)
+
+    return pl.pallas_call(
+        kern,
+        grid=(n // B,),
+        in_specs=[
+            pl.BlockSpec((B, Wp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (B, T_pad), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (T_pad * K1, LANES), lambda i: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (T_pad * K1, LANES), lambda i: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec((B, T_pad), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, T_pad), jnp.int32),
+        compiler_params=pallas_tpu_compiler_params(
+            pltpu,
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(packed, i1, f2f, t2f)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -32,7 +32,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DP_AXIS
@@ -178,6 +178,16 @@ def binize(X: jax.Array, edges: jax.Array, *, d_pad: int) -> jax.Array:
     ~ms). Elementwise along rows, so XLA keeps the dp row sharding.
     Padding features (d..d_pad) get bin 0 and are masked out of split
     search.
+
+    Input contract — FINITE values only. NaN compares false against every
+    edge, so a NaN lands in bin 0 (the leftmost child everywhere below),
+    where numpy's searchsorted would route it PAST the last edge into the
+    rightmost bin. This routing is intentional and fixed (fit and
+    transform quantize through this same function, so training and
+    serving agree), but it is a semantics choice, not an accident — the
+    estimator boundary enforces/documents the finite-input contract
+    (``models/tree.py``, ``TPUML_RF_CHECK_FINITE``) rather than paying a
+    per-element isnan pass here on the hot path.
     """
     n, d = X.shape
     Fc = max(1, min(d, (1 << 22) // max(n, 1)))  # bound the (n,Fc,nb) tile
@@ -1321,7 +1331,9 @@ def rf_eval_bins(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth", "group"))
+@functools.partial(
+    jax.jit, static_argnames=("max_depth", "group", "pred_dtype")
+)
 def rf_classify_bins(
     xb: jax.Array,       # (n, d_pad) uint8 bin ids
     feat: jax.Array,
@@ -1330,16 +1342,20 @@ def rf_classify_bins(
     *,
     max_depth: int,
     group: int = 8,
+    pred_dtype=None,
 ):
     """Spark RF vote semantics via the two-hop bin-space descent: the
     summed-over-trees leaf distribution arrives directly from
     ``rf_eval_bins`` — no (T, n, C) materialization. ``group`` bounds the
-    per-tree-group transients (smaller = leaner alongside big residents)."""
+    per-tree-group transients (smaller = leaner alongside big residents).
+    ``pred_dtype`` sets the prediction dtype (legacy ``rf_classify``
+    returns predictions in X.dtype; callers pass their row dtype here to
+    keep that contract — default float32 for compatibility)."""
     raw = rf_eval_bins(
         xb, feat, thr_bin, leaf_prob, max_depth=max_depth, group=group
     )
     prob = raw / feat.shape[0]
-    pred = jnp.argmax(raw, axis=1).astype(jnp.float32)
+    pred = jnp.argmax(raw, axis=1).astype(pred_dtype or jnp.float32)
     return pred, prob, raw
 
 
@@ -1356,6 +1372,279 @@ def rf_regress_bins(
     s = rf_eval_bins(
         xb, feat, thr_bin, leaf_value[..., None], max_depth=max_depth,
         group=group,
+    )
+    return s[:, 0] / leaf_value.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# FIL-style packed-forest inference engine
+# ---------------------------------------------------------------------------
+#
+# The two-hop bins path above still walks trees one at a time inside each
+# group: per tree one skinny hop-1 matmul, one table gather, one
+# contraction gather — each a separate XLA op with its own fusion
+# boundary, ~70 ms of contraction gathers plus per-op overhead at the
+# bench forest. cuML's FIL closes the same gap on GPU by re-laying the
+# forest into an interleaved SoA blob and descending a row tile through
+# ALL trees per level in lockstep. The TPU analog here:
+#
+#   * ``pack_forest`` (host, once per model) re-lays the heap-ordered
+#     (T, M) tensors breadth-first into lane-width-padded SoA blocks:
+#     hop-1 root subtrees as (T_pad, n1) slabs driving ONE all-tree bf16
+#     one-hot matmul, and hop-2 per-subtree (feature, threshold) tables
+#     as (T_pad * 2^k1, 64) slabs the traversal kernel row-selects on
+#     the MXU.
+#   * ``rf_pallas.packed_traverse`` fuses the whole hop-2 phase — table
+#     row-select, lane-shuffle byte gather of the row's feature bins,
+#     masked bit-navigation, global-leaf-id arithmetic — for every tree
+#     into ONE pallas_call per row block, removing the per-tree dispatch
+#     and gather-engine costs that dominated the bins path.
+#   * leaf payloads are then accumulated tree-sequentially in the exact
+#     order ``_twohop_drive`` uses (group-8 partial sums), so packed
+#     results are BIT-IDENTICAL to the bins path: leaf indices are
+#     integers (exact by construction) and the f32 payload sums
+#     reassociate identically.
+
+
+class PackedForest(NamedTuple):
+    """Breadth-first interleaved SoA forest layout (``pack_forest``).
+
+    Arrays are plain numpy (host) so models can persist them via the
+    standard attribute round-trip and ship them to device once per
+    process. ``feat2``/``thr2`` are empty (0, 64) when ``k2 == 0`` —
+    forests shallow enough that hop-1 alone reaches every leaf.
+    """
+
+    feat1: np.ndarray    # (T_pad, n1) int32 hop-1 root subtrees, -1 = leaf
+    thr1: np.ndarray     # (T_pad, n1) int32 bin thresholds
+    feat2: np.ndarray    # (T_pad * 2^k1, 64) int32 hop-2 tables, -1 pad
+    thr2: np.ndarray     # (T_pad * 2^k1, 64) int32
+    n_trees: int         # real tree count T (payload accumulation bound)
+    k1: int              # hop-1 depth (root-subtree levels)
+    k2: int              # hop-2 depth (per-subtree levels)
+    max_depth: int
+
+
+def pack_forest(feat, thr_bin, *, max_depth: int) -> PackedForest:
+    """Re-lay a trained forest for lockstep traversal (host, once).
+
+    ``feat``/``thr_bin`` are the (T, M) heap-ordered int32 tensors the
+    builder emits. The split point k1/k2 matches ``_twohop_group``
+    exactly (k1 = max(min(7, D), D-6)) so packed descent reproduces the
+    same leaf indices. Trees are padded to a multiple of 8 with all-leaf
+    sentinels (feat = -1): padding trees navigate to leaf 0 and are
+    sliced out of payload accumulation. The hop-2 tables interleave
+    per-subtree rows — table row ``t * 2^k1 + s`` holds subtree ``s`` of
+    tree ``t`` with its ``2^k2 - 1`` internal nodes in heap-local
+    breadth-first order along lanes (lane m = local heap slot m), padded
+    to the 64-lane shuffle width with leaf sentinels.
+    """
+    feat = np.asarray(feat, dtype=np.int32)
+    thr = np.asarray(thr_bin, dtype=np.int32)
+    T, M = feat.shape
+    D = int(max_depth)
+    k1 = max(min(7, D), D - 6)
+    k2 = D - k1
+    n1 = (1 << k1) - 1
+    T_pad = -(-T // 8) * 8
+    featp = np.pad(feat, ((0, T_pad - T), (0, 0)), constant_values=-1)
+    thrp = np.pad(thr, ((0, T_pad - T), (0, 0)))
+    feat1 = np.ascontiguousarray(featp[:, :n1])
+    thr1 = np.ascontiguousarray(thrp[:, :n1])
+    LANES = 64  # nint = 2^k2 - 1 <= 63 always (k2 <= 6)
+    if k2 == 0:
+        feat2 = np.full((0, LANES), -1, np.int32)
+        thr2 = np.zeros((0, LANES), np.int32)
+    else:
+        K1 = 1 << k1
+        f2 = np.full((T_pad, K1, LANES), -1, np.int32)
+        t2 = np.zeros((T_pad, K1, LANES), np.int32)
+        for delta in range(k2):
+            off = (1 << (k1 + delta)) - 1
+            cnt = 1 << (k1 + delta)
+            w = 1 << delta
+            lo = (1 << delta) - 1  # heap-local lane offset of this level
+            f2[:, :, lo : lo + w] = featp[:, off : off + cnt].reshape(
+                T_pad, K1, w
+            )
+            t2[:, :, lo : lo + w] = thrp[:, off : off + cnt].reshape(
+                T_pad, K1, w
+            )
+        feat2 = f2.reshape(T_pad * K1, LANES)
+        thr2 = t2.reshape(T_pad * K1, LANES)
+    return PackedForest(
+        feat1=feat1, thr1=thr1, feat2=feat2, thr2=thr2,
+        n_trees=T, k1=k1, k2=k2, max_depth=D,
+    )
+
+
+def _packed_hop1(xb16, feat1, thr1, *, k1):
+    """All-tree hop-1: every root subtree's tests in ONE bf16 one-hot
+    matmul (exact — bin and feature ids are small ints) followed by a
+    tree-batched bit-navigation. Returns (n, T_pad) int32 heap indices;
+    rows stopped at a hop-1 leaf hold index < 2^k1 - 1."""
+    n, d = xb16.shape
+    T_pad, n1 = feat1.shape
+    iota_d = jnp.arange(d, dtype=jnp.int32)
+    f1 = feat1.reshape(T_pad * n1)
+    oh1 = (f1[:, None] == iota_d[None, :]).astype(jnp.bfloat16)
+    tests1 = lax.dot_general(
+        xb16, oh1, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                    # (n, T_pad*n1)
+    thr_f = thr1.reshape(T_pad * n1).astype(jnp.float32)
+    bits1 = (tests1 > thr_f[None, :]).astype(jnp.int32)
+    enc1 = ((1 + bits1) * (f1 >= 0)[None, :].astype(jnp.int32)).reshape(
+        n, T_pad, n1
+    )
+    i = jnp.zeros((n, T_pad), jnp.int32)
+    for s in range(k1):
+        lo = (1 << s) - 1
+        w = 1 << s
+        sl = lax.slice_in_dim(enc1, lo, lo + w, axis=2)   # (n, T, w)
+        il = jnp.clip(i - lo, 0, w - 1)
+        lanes = jnp.arange(w, dtype=jnp.int32)
+        e = jnp.where(lanes[None, None, :] == il[..., None], sl, 0).sum(
+            axis=2
+        )
+        e = jnp.where(i >= lo, e, 0)
+        i = jnp.where(e > 0, 2 * i + e, i)
+    return i
+
+
+def _packed_payload(leaf, values, *, n_trees, group):
+    """Tree-sequential payload accumulation over packed leaf ids, in the
+    EXACT association ``_twohop_drive`` uses (per-group partial sums in
+    tree order, then sequential across groups) so packed f32 sums are
+    bit-identical to the bins path's."""
+    acc = None
+    for g0 in range(0, n_trees, group):
+        vals_sum = None
+        for t in range(g0, min(g0 + group, n_trees)):
+            v = values[t][leaf[:, t]]                    # (n, V) row gather
+            vals_sum = v if vals_sum is None else vals_sum + v
+        acc = vals_sum if acc is None else acc + vals_sum
+    return acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k1", "k2", "max_depth", "interpret")
+)
+def forest_apply_packed(
+    xb: jax.Array,       # (n, d_pad) uint8 bin ids
+    feat1: jax.Array,    # (T_pad, n1) int32
+    thr1: jax.Array,     # (T_pad, n1) int32
+    feat2: jax.Array,    # (T_pad * 2^k1, 64) int32
+    thr2: jax.Array,     # (T_pad * 2^k1, 64) int32
+    *,
+    k1: int,
+    k2: int,
+    max_depth: int,
+    interpret=None,
+) -> jax.Array:
+    """Global leaf index per (row, tree): (n, T_pad) int32, lockstep over
+    all trees. Callers gate on ``rf_pallas.packed_traverse_ok`` first —
+    this function assumes the traversal kernel lowers (or interprets)."""
+    from .rf_pallas import TRAVERSE_BLOCK, packed_traverse
+
+    n0, d_pad = xb.shape
+    n = -(-n0 // TRAVERSE_BLOCK) * TRAVERSE_BLOCK
+    if n > n0:
+        xb = jnp.pad(xb, ((0, n - n0), (0, 0)))
+    xb16 = xb.astype(jnp.bfloat16)
+    i1 = _packed_hop1(xb16, feat1, thr1, k1=k1)          # (n, T_pad)
+    if k2 == 0:
+        return i1[:n0]
+    packed = _pack_bins(xb)                              # (n, d_pad/4)
+    leaf = packed_traverse(
+        packed, i1, feat2, thr2, k1=k1, k2=k2, d_pad=d_pad,
+        interpret=interpret,
+    )
+    return leaf[:n0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k1", "k2", "max_depth", "group", "interpret")
+)
+def rf_eval_packed(
+    xb: jax.Array,
+    feat1: jax.Array,
+    thr1: jax.Array,
+    feat2: jax.Array,
+    thr2: jax.Array,
+    values: jax.Array,   # (T, M, V) per-node leaf payloads (REAL trees)
+    *,
+    k1: int,
+    k2: int,
+    max_depth: int,
+    group: int = 8,
+    interpret=None,
+) -> jax.Array:
+    """Sum over trees of each tree's leaf payload vector, (n, V) — the
+    packed-engine equivalent of ``rf_eval_bins``, bit-identical to it
+    (same leaf indices, same f32 accumulation order)."""
+    leaf = forest_apply_packed(
+        xb, feat1, thr1, feat2, thr2, k1=k1, k2=k2, max_depth=max_depth,
+        interpret=interpret,
+    )
+    return _packed_payload(
+        leaf, values, n_trees=values.shape[0], group=group
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k1", "k2", "max_depth", "group", "pred_dtype",
+                     "interpret"),
+)
+def rf_classify_packed(
+    xb: jax.Array,
+    feat1: jax.Array,
+    thr1: jax.Array,
+    feat2: jax.Array,
+    thr2: jax.Array,
+    leaf_prob: jax.Array,  # (T, M, C) normalized leaf distributions
+    *,
+    k1: int,
+    k2: int,
+    max_depth: int,
+    group: int = 8,
+    pred_dtype=None,
+    interpret=None,
+):
+    """Spark RF vote semantics through the packed engine — same contract
+    (and bit-identical outputs) as ``rf_classify_bins``."""
+    raw = rf_eval_packed(
+        xb, feat1, thr1, feat2, thr2, leaf_prob,
+        k1=k1, k2=k2, max_depth=max_depth, group=group,
+        interpret=interpret,
+    )
+    prob = raw / leaf_prob.shape[0]
+    pred = jnp.argmax(raw, axis=1).astype(pred_dtype or jnp.float32)
+    return pred, prob, raw
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k1", "k2", "max_depth", "group", "interpret")
+)
+def rf_regress_packed(
+    xb: jax.Array,
+    feat1: jax.Array,
+    thr1: jax.Array,
+    feat2: jax.Array,
+    thr2: jax.Array,
+    leaf_value: jax.Array,  # (T, M) per-tree leaf means
+    *,
+    k1: int,
+    k2: int,
+    max_depth: int,
+    group: int = 8,
+    interpret=None,
+) -> jax.Array:
+    s = rf_eval_packed(
+        xb, feat1, thr1, feat2, thr2, leaf_value[..., None],
+        k1=k1, k2=k2, max_depth=max_depth, group=group,
+        interpret=interpret,
     )
     return s[:, 0] / leaf_value.shape[0]
 

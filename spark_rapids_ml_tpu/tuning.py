@@ -15,6 +15,7 @@ folds run on a thread pool (reference ``tuning.py:106-129``).
 from __future__ import annotations
 
 import itertools
+import threading
 from multiprocessing.pool import ThreadPool
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -25,6 +26,11 @@ from .data.dataframe import DataFrame, kfold
 from .evaluation import Evaluator
 from .params import Param, Params, TypeConverters, _mk
 from .utils.logging import get_logger
+
+# Serializes per-fold device work under parallel CV (see run_fold in
+# CrossValidator.fit): concurrent first-compiles of one jitted fit from
+# multiple threads deadlock on jax 0.4.x.
+_FOLD_DEVICE_LOCK = threading.Lock()
 
 
 class ParamGridBuilder:
@@ -154,23 +160,32 @@ class CrossValidator(_CrossValidatorParams):
         collect_sub = bool(self.getOrDefault("collectSubModels"))
 
         def run_fold(i: int) -> Tuple[np.ndarray, Optional[List[_TpuModel]]]:
-            train, validation = folds[i]
-            if single_pass:
-                # ONE barrier-pass fit of all maps + ONE evaluate pass
-                models = [m for _, m in est.fitMultiple(train, epm)]
-                combined = type(models[0])._combine(models)
-                vals = combined._transformEvaluate(validation, eva)
+            # Device work is serialized across fold threads: jax 0.4.x can
+            # deadlock (futex wedge inside the dispatch lock) when several
+            # threads race the *first* compile of the same jitted fit. The
+            # ThreadPool keeps the pyspark parallelism API/semantics; folds
+            # still overlap host-side prep outside this critical section.
+            with _FOLD_DEVICE_LOCK:
+                train, validation = folds[i]
+                if single_pass:
+                    # ONE barrier-pass fit of all maps + ONE evaluate pass
+                    models = [m for _, m in est.fitMultiple(train, epm)]
+                    combined = type(models[0])._combine(models)
+                    vals = combined._transformEvaluate(validation, eva)
+                    return (
+                        np.asarray(vals, dtype=np.float64),
+                        models if collect_sub else None,
+                    )
+                vals, models = [], []
+                for pm in epm:
+                    model = est.fit(train, pm)
+                    vals.append(eva.evaluate(model.transform(validation)))
+                    if collect_sub:
+                        models.append(model)
                 return (
                     np.asarray(vals, dtype=np.float64),
                     models if collect_sub else None,
                 )
-            vals, models = [], []
-            for pm in epm:
-                model = est.fit(train, pm)
-                vals.append(eva.evaluate(model.transform(validation)))
-                if collect_sub:
-                    models.append(model)
-            return np.asarray(vals, dtype=np.float64), models if collect_sub else None
 
         par = max(1, self.getParallelism())
         if par > 1:

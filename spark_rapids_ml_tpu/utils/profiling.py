@@ -53,3 +53,43 @@ def timed(logger, phase: str) -> Iterator[None]:
         yield
     finally:
         logger.debug("%s took %.4fs", phase, time.perf_counter() - t0)
+
+
+class StageTimer:
+    """Accumulating per-stage wall-clock breakdown for repeated pipelines
+    (the packed-forest transform engine wraps its quantize/traverse
+    dispatch and host materialization per micro-batch; one summary line
+    per transform call).
+
+    Same caveat as :func:`timed`: dispatch stages measure ASYNC enqueue
+    time — device wait lands in whichever stage first materializes
+    results (``np.asarray``). The split still attributes host-side costs
+    (staging, packing, output copies) faithfully.
+    """
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.totals: dict = {}
+        self.counts: dict = {}
+
+    @contextlib.contextmanager
+    def stage(self, label: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[label] = self.totals.get(label, 0.0) + dt
+            self.counts[label] = self.counts.get(label, 0) + 1
+
+    def log_summary(self, logger) -> None:
+        """Debug-log accumulated stages and reset for the next call."""
+        if not self.totals:
+            return
+        parts = ", ".join(
+            f"{k}={v:.4f}s/{self.counts[k]}x"
+            for k, v in sorted(self.totals.items())
+        )
+        logger.debug("%s stages: %s", self.name, parts)
+        self.totals.clear()
+        self.counts.clear()

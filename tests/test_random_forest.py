@@ -671,4 +671,6 @@ def test_rf_transform_bins_path_matches_legacy(monkeypatch):
     pl_ = np.asarray(mr.transform(dfr)["prediction"])
     monkeypatch.setenv("TPUML_RF_APPLY", "bins")
     pb = np.asarray(mr.transform(dfr)["prediction"])
-    np.testing.assert_allclose(pl_, pb, rtol=1e-6)
+    # atol absorbs the last-ULP reassociation of the per-tree mean (the
+    # two descents gather identical leaves; only the f32 sum order differs)
+    np.testing.assert_allclose(pl_, pb, rtol=1e-6, atol=1e-7)
