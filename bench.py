@@ -42,6 +42,7 @@ Robustness (round-1 postmortem): any algo failing with a transient
 results still produce a JSON line; diagnostics go to stderr.
 """
 
+import contextlib
 import json
 import math
 import os
@@ -271,6 +272,11 @@ def bench_pca(X, mask, mesh, n_chips):
         "inner_fits_per_dispatch": INNER_FITS,
         "flops_model": flops,
         "baseline_samples_per_sec": 1.1e8,
+        "baseline_inputs": {
+            "formula": "a10g_syrk_flat_v1",
+            "samples_per_sec": 1.1e8,
+            "d": N_COLS,
+        },
     }
 
 
@@ -340,6 +346,12 @@ def bench_kmeans(X, mask, mesh, n_chips):
         "matmul_dtype": km_dtype,
         "flops_model": flops,
         "baseline_samples_per_sec": 2.9e7,
+        "baseline_inputs": {
+            "formula": "a10g_kmeans_flat_v1",
+            "samples_per_sec": 2.9e7,
+            "k": KMEANS_K,
+            "d": N_COLS,
+        },
     }
 
 
@@ -464,6 +476,11 @@ def bench_logreg(X, mask, y, mesh, n_chips):
         "objective_dtype": obj_dtype,
         "flops_model": flops,
         "baseline_samples_per_sec": 2.9e8,
+        "baseline_inputs": {
+            "formula": "a10g_logreg_flat_per_iter_v1",
+            "samples_per_sec_per_iter": 2.9e8,
+            "d": N_COLS,
+        },
     }
 
 
@@ -513,6 +530,11 @@ def bench_linreg(X, mask, y, mesh, n_chips):
         "inner_fits_per_dispatch": INNER_FITS,
         "flops_model": flops,
         "baseline_samples_per_sec": 1.1e8,
+        "baseline_inputs": {
+            "formula": "a10g_syrk_flat_v1",
+            "samples_per_sec": 1.1e8,
+            "d": N_COLS,
+        },
     }
 
 
@@ -754,6 +776,15 @@ def bench_rf(X, mask, y, mesh, n_chips):
         "k_features": k_feat,
         "flops_model": updates,  # scatter-equivalent work, not MXU flops
         "baseline_samples_per_sec": 1.8e9 / (k_feat * RF_DEPTH * 2),
+        "baseline_inputs": {
+            "formula": "rf_hist_atomics_v1",
+            "atomics_per_sec": 1.8e9,
+            "k_features": k_feat,
+            "depth": RF_DEPTH,
+            "n_stats": 2,
+            "transform_formula": "fil_node_fetch_v1",
+            "node_fetches_per_sec": 1e10,
+        },
     }
 
 
@@ -812,6 +843,13 @@ def bench_knn(X, mask, mesh, n_chips):
         "flops_model": flops,
         "baseline_samples_per_sec": 1.0 / base_q_s,
         "baseline_kind": "derived-roofline",
+        "baseline_inputs": {
+            "formula": "knn_matmul_select_v1",
+            "matmul_flops_per_sec": 15e12,
+            "select_bytes_per_sec": 0.5 * 600e9,
+            "items": ni,
+            "d": N_COLS,
+        },
     }
 
 
@@ -899,17 +937,38 @@ def bench_umap(mesh, n_chips):
     knn_s = 2.0 * n * n * d / 15e12
     sgd_s = epochs * f_active * m_edges * 6 * 2 / 1.8e9
     base_fit_s = knn_s + sgd_s + 0.2
+    # stage decomposition + engine choice straight from the estimator's
+    # fit/transform reports, so a drifting vs_baseline is attributable to
+    # a stage (graph vs init vs sgd) without rerunning under a profiler
+    rep = dict(getattr(model, "_fit_report", None) or {})
+    trep = dict(getattr(model, "_transform_report", None) or {})
     return {
         "samples_per_sec_per_chip": n / t_fit / n_chips,
         "fit_seconds": t_fit,
         "transform_seconds": t_tr,
         "transform_samples_per_sec_per_chip": n / t_tr / n_chips,
         "transform_baseline_samples_per_sec": n / (knn_s + sgd_s / 3.0),
+        "transform_engine": trep.get("sgd_engine"),
         "rows": n,
         "trustworthiness": round(trust, 4),
+        "graph_seconds": rep.get("graph_seconds"),
+        "init_seconds": rep.get("init_seconds"),
+        "sgd_seconds": rep.get("sgd_seconds"),
+        "epoch_ms": rep.get("epoch_ms"),
+        "sgd_engine": rep.get("sgd_engine"),
         "flops_model": 2.0 * float(n) * n * d,
         "baseline_samples_per_sec": n / base_fit_s,
         "baseline_kind": "derived-roofline",
+        "baseline_inputs": {
+            "formula": "umap_roofline_v1",
+            "knn_flops_per_sec": 15e12,
+            "atomics_per_sec": 1.8e9,
+            "edge_factor": 1.74,
+            "f_active": f_active,
+            "epochs": epochs,
+            "n_neighbors": UMAP_NEIGHBORS,
+            "spectral_flat_seconds": 0.2,
+        },
     }
 
 
@@ -1022,12 +1081,13 @@ def bench_pca_stream(mesh, n_chips):
         for _pass in range(2):
             acc = jnp.float32(0.0)
             guard = StreamGuard()
-            for chunk in prefetch_chunks(
-                src.iter_chunks(chunk_rows, np.float32)
-            ):
-                devc = put_chunk(chunk, mesh, np.float32)
-                acc = _touch(acc, devc["X"], devc["mask"])
-                guard.tick(devc, acc)
+            with contextlib.closing(
+                prefetch_chunks(src.iter_chunks(chunk_rows, np.float32))
+            ) as chunks:
+                for chunk in chunks:
+                    devc = put_chunk(chunk, mesh, np.float32)
+                    acc = _touch(acc, devc["X"], devc["mask"])
+                    guard.tick(devc, acc)
             guard.flush(acc)
 
     # warm: the first _touch call pays jit trace+compile (several tunnel
@@ -1064,6 +1124,11 @@ def bench_pca_stream(mesh, n_chips):
         "overlap_efficiency": round(overlap, 3),
         "flops_model": flops,
         "baseline_samples_per_sec": 1.1e8,
+        "baseline_inputs": {
+            "formula": "a10g_syrk_flat_v1",
+            "samples_per_sec": 1.1e8,
+            "d": d,
+        },
         "tunnel_bound": ingest_gbps < 1.0,
     }
 
@@ -1381,7 +1446,9 @@ def _emit_line(results, meta, watchdog_tripped):
         "transform_seconds", "transform_engine",
         "transform_samples_per_sec_per_chip",
         "transform_vs_baseline", "samples_per_sec_per_chip_e2e",
-        "trustworthiness", "baseline_kind",
+        "trustworthiness", "baseline_kind", "baseline_inputs",
+        "graph_seconds", "init_seconds", "sgd_seconds", "epoch_ms",
+        "sgd_engine",
     )
     for name, r in results.items():
         line[name] = {

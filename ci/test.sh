@@ -51,8 +51,31 @@ echo "== transform bench smoke (rf packed engine + umap) =="
 # transform_vs_baseline (BENCH_REQUIRE_TRANSFORM makes a silently
 # dropped rf transform metric a hard failure). Tiny CPU scales — this
 # checks the metric plumbing, not the TPU throughput target.
-JAX_PLATFORMS=cpu BENCH_ONLY=rf,umap BENCH_REQUIRE_TRANSFORM=rf \
+JAX_PLATFORMS=cpu BENCH_ONLY=rf,umap BENCH_REQUIRE_TRANSFORM=rf,umap \
     BENCH_ROWS=4096 BENCH_RF_ROWS=4096 BENCH_RF_TREES=4 BENCH_RF_DEPTH=8 \
     BENCH_UMAP_ROWS=1024 python bench.py
+
+echo "== umap sgd engine dispatch smoke =="
+# TPUML_UMAP_OPT contract: bad modes fail loudly, and on a CPU host both
+# auto and an explicit pallas request resolve to the XLA engine (probe
+# fallback) instead of crashing the fit.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+from spark_rapids_ml_tpu.ops import umap_pallas as up
+
+os.environ["TPUML_UMAP_OPT"] = "bogus"
+try:
+    up.resolve_umap_opt()
+except ValueError:
+    pass
+else:
+    raise SystemExit("TPUML_UMAP_OPT=bogus did not raise")
+for mode in ("auto", "xla", "pallas"):
+    os.environ["TPUML_UMAP_OPT"] = mode
+    eng = up.select_sgd_engine(1024, 24, 2, 5)
+    assert eng == "xla", (mode, eng)
+os.environ.pop("TPUML_UMAP_OPT")
+print("umap engine dispatch smoke OK")
+EOF
 
 echo "CI OK"

@@ -19,6 +19,7 @@ the frozen training embedding (cuML's transform algorithm).
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
@@ -51,6 +52,24 @@ from ..ops.umap_kernels import (
     smooth_knn_dist,
     spectral_init,
 )
+from ..ops.umap_pallas import (
+    default_rng_mode,
+    select_sgd_engine,
+    umap_sgd_pallas,
+)
+from ..utils.profiling import StageTimer
+
+_LOGGER = logging.getLogger("spark_rapids_ml_tpu.umap")
+
+
+def _run_sgd(engine: str, *args: Any, **kwargs: Any) -> jax.Array:
+    """Dispatch one SGD run to the selected engine. Both engines share
+    the ``optimize_embedding_rows`` signature; the Pallas one adds the
+    randomness-source knob (on-chip PRNG on real hardware, the XLA
+    stream elsewhere — see ``ops/umap_pallas.py``)."""
+    if engine == "pallas":
+        return umap_sgd_pallas(*args, rng=default_rng_mode(), **kwargs)
+    return optimize_embedding_rows(*args, **kwargs)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "qchunk", "topk_impl"))
@@ -260,92 +279,123 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         if k >= n:
             raise ValueError(f"n_neighbors={k} must be < number of rows {n}")
 
-        # 1) kNN graph: fetch k+1 and drop the SELF entry by index match —
-        # with duplicate rows, top_k tie-breaking can put self anywhere in
-        # the tie run, so dropping column 0 would discard a real neighbor
-        # and keep a self-loop
-        Xd = jnp.asarray(X)
-        dists, idx = knn_brute(Xd, Xd, k=k + 1, topk_impl=resolve_knn_topk())
-        idx_np = np.asarray(idx)
-        dists_np = np.asarray(dists)
-        self_mask = idx_np == np.arange(n)[:, None]
-        has_self = self_mask.any(axis=1)
-        drop_col = np.where(has_self, self_mask.argmax(axis=1), k)
-        keep = np.ones_like(self_mask)
-        keep[np.arange(n), drop_col] = False
-        knn_i = idx_np[keep].reshape(n, k)
-        knn_d = dists_np[keep].reshape(n, k)
+        # stage decomposition (graph / init / sgd) feeds the bench entry
+        # and the debug log; device work materializes inside its stage so
+        # async dispatch cannot smear across the split
+        timer = StageTimer("umap.fit")
 
-        # 2) fuzzy simplicial set (+ categorical label intersection when
-        # supervised)
-        heads, tails, weights = fuzzy_simplicial_set(
-            knn_i,
-            knn_d,
-            float(self._tpu_params.get("local_connectivity", 1.0)),
-            float(self._tpu_params.get("set_op_mix_ratio", 1.0)),
-        )
-        if y_labels is not None:
-            heads, tails, weights = categorical_simplicial_set_intersection(
-                heads, tails, weights, y_labels, n
+        with timer.stage("graph"):
+            # 1) kNN graph: fetch k+1 and drop the SELF entry by index
+            # match — with duplicate rows, top_k tie-breaking can put self
+            # anywhere in the tie run, so dropping column 0 would discard
+            # a real neighbor and keep a self-loop
+            Xd = jnp.asarray(X)
+            dists, idx = knn_brute(
+                Xd, Xd, k=k + 1, topk_impl=resolve_knn_topk()
             )
+            idx_np = np.asarray(idx)
+            dists_np = np.asarray(dists)
+            self_mask = idx_np == np.arange(n)[:, None]
+            has_self = self_mask.any(axis=1)
+            drop_col = np.where(has_self, self_mask.argmax(axis=1), k)
+            keep = np.ones_like(self_mask)
+            keep[np.arange(n), drop_col] = False
+            knn_i = idx_np[keep].reshape(n, k)
+            knn_d = dists_np[keep].reshape(n, k)
 
-        # 3) curve params + init
-        a = self._tpu_params.get("a")
-        b = self._tpu_params.get("b")
-        if a is None or b is None:
-            a, b = find_ab_params(
-                float(self._tpu_params.get("spread", 1.0)),
-                float(self._tpu_params.get("min_dist", 0.1)),
+            # 2) fuzzy simplicial set (+ categorical label intersection
+            # when supervised)
+            heads, tails, weights = fuzzy_simplicial_set(
+                knn_i,
+                knn_d,
+                float(self._tpu_params.get("local_connectivity", 1.0)),
+                float(self._tpu_params.get("set_op_mix_ratio", 1.0)),
             )
-        n_comp = int(self._tpu_params.get("n_components", 2))
-        if self._tpu_params.get("init", "spectral") == "spectral":
-            emb0 = spectral_init(heads, tails, weights, n, n_comp, seed)
-        else:
-            emb0 = (
-                np.random.default_rng(seed)
-                .uniform(-10, 10, size=(n, n_comp))
-                .astype(np.float32)
-            )
+            if y_labels is not None:
+                heads, tails, weights = categorical_simplicial_set_intersection(
+                    heads, tails, weights, y_labels, n
+                )
 
-        # 4) SGD over CSR-padded rows (``build_row_adjacency``): head-only
-        # updates with cuML's directed-symmetric semantics; the row count
-        # is bucketed inside the builder so same-bucket fits reuse the
-        # compiled epoch loop (an unpadded call recompiles on EVERY fit —
-        # ~60 s measured at the 64k bench shape, as long as the SGD).
-        # Graduate the row bucket for small fits so they don't spend most
-        # SGD work on inert padding.
-        row_bucket = 4096 if n >= 4096 else 256
-        # K=24 measured best at the bench shape (9.2 vs 10.7 ms/epoch at
-        # K=32): fewer inert padding slots than 32, fewer split rows than 16
-        row_heads, tails_pad, p_pad = build_row_adjacency(
-            heads, tails, weights, n, K=24, row_bucket=row_bucket
-        )
-        n_epochs = self._tpu_params.get("n_epochs") or default_n_epochs(n)
-        emb0 = jnp.asarray(emb0)
-        emb = optimize_embedding_rows(
-            emb0,
-            emb0,
-            jnp.asarray(row_heads),
-            jnp.asarray(tails_pad),
-            jnp.asarray(p_pad),
-            jax.random.PRNGKey(seed),
-            n_epochs=int(n_epochs),
-            a=float(a),
-            b=float(b),
-            gamma=float(self._tpu_params.get("repulsion_strength", 1.0)),
-            initial_alpha=float(self._tpu_params.get("learning_rate", 1.0)),
-            negative_sample_rate=int(self._tpu_params.get("negative_sample_rate", 5)),
-            self_table=True,
-        )
+        with timer.stage("init"):
+            # 3) curve params + init
+            a = self._tpu_params.get("a")
+            b = self._tpu_params.get("b")
+            if a is None or b is None:
+                a, b = find_ab_params(
+                    float(self._tpu_params.get("spread", 1.0)),
+                    float(self._tpu_params.get("min_dist", 0.1)),
+                )
+            n_comp = int(self._tpu_params.get("n_components", 2))
+            if self._tpu_params.get("init", "spectral") == "spectral":
+                emb0 = spectral_init(heads, tails, weights, n, n_comp, seed)
+            else:
+                emb0 = (
+                    np.random.default_rng(seed)
+                    .uniform(-10, 10, size=(n, n_comp))
+                    .astype(np.float32)
+                )
+
+        with timer.stage("sgd"):
+            # 4) SGD over CSR-padded rows (``build_row_adjacency``):
+            # head-only updates with cuML's directed-symmetric semantics;
+            # the row count is bucketed inside the builder so same-bucket
+            # fits reuse the compiled epoch loop (an unpadded call
+            # recompiles on EVERY fit — ~60 s measured at the 64k bench
+            # shape, as long as the SGD). Graduate the row bucket for
+            # small fits so they don't spend most SGD work on inert
+            # padding.
+            row_bucket = 4096 if n >= 4096 else 256
+            # K=24 measured best at the bench shape (9.2 vs 10.7 ms/epoch
+            # at K=32): fewer inert padding slots than 32, fewer split
+            # rows than 16
+            row_heads, tails_pad, p_pad = build_row_adjacency(
+                heads, tails, weights, n, K=24, row_bucket=row_bucket
+            )
+            n_epochs = self._tpu_params.get("n_epochs") or default_n_epochs(n)
+            neg_rate = int(self._tpu_params.get("negative_sample_rate", 5))
+            # engine dispatch (TPUML_UMAP_OPT, probe-gated): the
+            # VMEM-resident Pallas kernel vs the jitted XLA loop
+            engine = select_sgd_engine(n, tails_pad.shape[1], n_comp, neg_rate)
+            emb0 = jnp.asarray(emb0)
+            emb = _run_sgd(
+                engine,
+                emb0,
+                emb0,
+                jnp.asarray(row_heads),
+                jnp.asarray(tails_pad),
+                jnp.asarray(p_pad),
+                jax.random.PRNGKey(seed),
+                n_epochs=int(n_epochs),
+                a=float(a),
+                b=float(b),
+                gamma=float(self._tpu_params.get("repulsion_strength", 1.0)),
+                initial_alpha=float(self._tpu_params.get("learning_rate", 1.0)),
+                negative_sample_rate=neg_rate,
+                self_table=True,
+            )
+            emb_host = np.asarray(emb, dtype=np.float32)
 
         model = UMAPModel(
-            embedding_=np.asarray(emb, dtype=np.float32),
+            embedding_=emb_host,
             raw_data_=X,
             a=float(a),
             b=float(b),
         )
         self._copyValues(model)
         self._copy_tpu_params(model)
+        stages = dict(timer.totals)
+        timer.log_summary(_LOGGER)
+        sgd_s = stages.get("sgd", 0.0)
+        # non-persisted fit provenance for the bench/debug surface (the
+        # rf transform_engine analog): which SGD engine ran and where the
+        # fit wall-clock went
+        model._fit_report = {
+            "graph_seconds": round(stages.get("graph", 0.0), 4),
+            "init_seconds": round(stages.get("init", 0.0), 4),
+            "sgd_seconds": round(sgd_s, 4),
+            "epoch_ms": round(sgd_s / max(int(n_epochs), 1) * 1e3, 3),
+            "sgd_engine": engine,
+        }
         return model
 
     def _get_tpu_fit_func(self, dataset: DataFrame):  # pragma: no cover
@@ -378,6 +428,22 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
     def _out_cols(self) -> List[str]:
         return [self.getOrDefault("outputCol")]
 
+    def _refine_engine(self, n_tab: int, K: int, C: int, neg: int) -> str:
+        """SGD engine for the transform refine pass, memoized per config
+        (and per ``TPUML_UMAP_OPT`` value, so tests flipping the env var
+        are not pinned to a stale choice): the lowering probe behind
+        ``select_sgd_engine`` AOT-compiles on first use — repeated
+        transform micro-batches must not re-enter it."""
+        from ..ops.umap_pallas import resolve_umap_opt
+
+        cache = getattr(self, "_sgd_engine_cache", None)
+        if cache is None:
+            cache = self._sgd_engine_cache = {}
+        key = (n_tab, K, C, neg, resolve_umap_opt())
+        if key not in cache:
+            cache[key] = select_sgd_engine(n_tab, K, C, neg)
+        return cache[key]
+
     def _get_tpu_transform_func(
         self, dataset: Optional[DataFrame] = None
     ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
@@ -396,6 +462,8 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
         neg = int(self._tpu_params.get("negative_sample_rate", 5))
         alpha = float(self._tpu_params.get("learning_rate", 1.0))
 
+        n_comp = int(train_emb.shape[1])
+
         def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
             nq = Xb.shape[0]
             dists, idx = knn_brute(
@@ -410,7 +478,14 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
             # already CSR-padded shape (nq, k), one row per query
             row_heads = jnp.arange(nq, dtype=jnp.int32)
             p_pad = w / jnp.maximum(w.max(), 1e-12)
-            emb = optimize_embedding_rows(
+            # refine against the FROZEN training table: same engine
+            # dispatch as fit (the Pallas kernel keeps train_emb
+            # VMEM-resident across each refine epoch)
+            engine = self._refine_engine(
+                int(train_emb.shape[0]), k, n_comp, neg
+            )
+            emb = _run_sgd(
+                engine,
                 emb0,
                 train_emb,
                 row_heads,
@@ -425,6 +500,10 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
                 negative_sample_rate=neg,
                 self_table=False,
             )
+            self._transform_report = {
+                "sgd_engine": engine,
+                "refine_epochs": refine,
+            }
             return {out_col: np.asarray(emb)}
 
         return _fn

@@ -36,3 +36,15 @@ def pallas_tpu_compiler_params(pltpu_module, **kwargs):
     if cls is None:
         cls = pltpu_module.TPUCompilerParams
     return cls(**kwargs)
+
+
+def pallas_tpu_prng(pltpu_module):
+    """``(prng_seed, prng_random_bits)`` for the on-chip TPU PRNG, or
+    ``None`` when this jax build does not expose it — callers (the UMAP
+    SGD engine) then stay on their XLA-stream randomness instead of
+    scattering hasattr checks through kernel code."""
+    seed = getattr(pltpu_module, "prng_seed", None)
+    bits = getattr(pltpu_module, "prng_random_bits", None)
+    if seed is None or bits is None:
+        return None
+    return seed, bits

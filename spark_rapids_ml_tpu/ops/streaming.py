@@ -20,6 +20,7 @@ avoiding the f32 catastrophic cancellation of one-pass covariance.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Dict, Optional
 
@@ -452,11 +453,16 @@ def streamed_suffstats(
 
     acc1 = moments1_init(d, dtype, with_y)
     guard = StreamGuard()
-    for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
-        dev = put_chunk(chunk, mesh, dtype, need_y=with_y)
-        rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
-        acc1 = moments1_step(acc1, dev["X"], rw, dev["y"] if with_y else None)
-        guard.tick(dev, acc1)
+    # closing() so an exception in the loop body tears down the prefetch
+    # thread promptly instead of at GC time (caveat on prefetch_chunks).
+    with contextlib.closing(
+        prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+    ) as chunks:
+        for chunk in chunks:
+            dev = put_chunk(chunk, mesh, dtype, need_y=with_y)
+            rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
+            acc1 = moments1_step(acc1, dev["X"], rw, dev["y"] if with_y else None)
+            guard.tick(dev, acc1)
     guard.flush(acc1)
     # cross-process allreduce of the first-moment partials (the NCCL
     # allreduce analog; identity single-process)
@@ -476,14 +482,17 @@ def streamed_suffstats(
 
     acc2 = gram2_init(d, dtype, with_y)
     guard = StreamGuard()
-    for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
-        dev = put_chunk(chunk, mesh, dtype, need_y=with_y)
-        rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
-        acc2 = gram2_step(
-            acc2, dev["X"], rw, mean_x,
-            dev["y"] if with_y else None, mean_y,
-        )
-        guard.tick(dev, acc2)
+    with contextlib.closing(
+        prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+    ) as chunks:
+        for chunk in chunks:
+            dev = put_chunk(chunk, mesh, dtype, need_y=with_y)
+            rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
+            acc2 = gram2_step(
+                acc2, dev["X"], rw, mean_x,
+                dev["y"] if with_y else None, mean_y,
+            )
+            guard.tick(dev, acc2)
     guard.flush(acc2)
     if with_y:
         G_h, Xy_h, yy_h = allreduce_sum_host(acc2["G"], acc2["Xy"], acc2["yy"])
@@ -547,10 +556,13 @@ def streamed_logreg_fit(
     # pass 1: n + feature means (partials allreduced across processes)
     acc1 = moments1_init(d, dtype, with_y=False)
     guard = StreamGuard()
-    for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
-        dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
-        acc1 = moments1_step(acc1, dev["X"], dev["mask"])
-        guard.tick(dev, acc1)
+    with contextlib.closing(
+        prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+    ) as chunks:
+        for chunk in chunks:
+            dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
+            acc1 = moments1_step(acc1, dev["X"], dev["mask"])
+            guard.tick(dev, acc1)
     guard.flush(acc1)
     n_h, sx_h = allreduce_sum_host(acc1["n"], acc1["sum_x"])
     n = float(n_h)
@@ -561,10 +573,13 @@ def streamed_logreg_fit(
         # reference's denominator (``classification.py:1024-1026``)
         vacc = jnp.zeros((d,), dtype)
         guard = StreamGuard()
-        for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
-            dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
-            vacc = var_chunk_step(vacc, dev["X"], dev["mask"], mean)
-            guard.tick(dev, vacc)
+        with contextlib.closing(
+            prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+        ) as chunks:
+            for chunk in chunks:
+                dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
+                vacc = var_chunk_step(vacc, dev["X"], dev["mask"], mean)
+                guard.tick(dev, vacc)
         guard.flush(vacc)
         (vacc_h,) = allreduce_sum_host(vacc)
         var = jnp.asarray(vacc_h, dtype) / max(n - 1.0, 1.0)
@@ -584,14 +599,17 @@ def streamed_logreg_fit(
         wd = jnp.asarray(w_np, dtype)
         acc = {"f": jnp.zeros((), dtype), "g": jnp.zeros((p,), dtype)}
         guard = StreamGuard()
-        for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
-            dev = put_chunk(chunk, mesh, dtype, need_w=False)
-            acc = logreg_chunk_vg_step(
-                acc, dev["X"], dev["mask"], dev["y"], wd, mean_dev, inv_std,
-                n_classes=n_classes, multinomial=multinomial,
-                fit_intercept=fit_intercept, use_center=use_center,
-            )
-            guard.tick(dev, acc)
+        with contextlib.closing(
+            prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+        ) as chunks:
+            for chunk in chunks:
+                dev = put_chunk(chunk, mesh, dtype, need_w=False)
+                acc = logreg_chunk_vg_step(
+                    acc, dev["X"], dev["mask"], dev["y"], wd, mean_dev, inv_std,
+                    n_classes=n_classes, multinomial=multinomial,
+                    fit_intercept=fit_intercept, use_center=use_center,
+                )
+                guard.tick(dev, acc)
         guard.flush(acc)
         # per-evaluation allreduce of (loss, grad) partials — the QN-loop
         # NCCL allreduce of the reference's distributed L-BFGS; every rank
@@ -659,10 +677,15 @@ def streamed_kmeans_lloyd(
             "cost": jnp.zeros((), dtype),
         }
         guard = StreamGuard()
-        for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
-            dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
-            acc = kmeans_chunk_step(acc, dev["X"], dev["mask"], cts, matmul_dtype=mm)
-            guard.tick(dev, acc)
+        with contextlib.closing(
+            prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+        ) as chunks:
+            for chunk in chunks:
+                dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
+                acc = kmeans_chunk_step(
+                    acc, dev["X"], dev["mask"], cts, matmul_dtype=mm
+                )
+                guard.tick(dev, acc)
         guard.flush(acc)
         # per-iteration allreduce of (sums, counts, cost) partials — the
         # Lloyd-loop NCCL allreduce; every rank then updates identically
@@ -789,23 +812,28 @@ def streamed_min_sq_dists_update(
     )
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     offset = 0
-    for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
-        dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
-        d2 = np.asarray(
-            chunk_min_sq_dists(dev["X"], dev["mask"], cands_dev), np.float64
-        )
-        # the d2 fetch above proves the step completed; release the
-        # chunk's buffers including the raw wire transfer (StreamGuard
-        # rationale — retention otherwise grows with total bytes shipped)
-        for a in dev.values():
-            if a is not None:
-                try:
-                    a.delete()
-                except Exception:
-                    pass
-        nv = chunk.n_valid
-        np.minimum(out[offset : offset + nv], d2[:nv], out=out[offset : offset + nv])
-        offset += nv
+    with contextlib.closing(
+        prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+    ) as chunks:
+        for chunk in chunks:
+            dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
+            d2 = np.asarray(
+                chunk_min_sq_dists(dev["X"], dev["mask"], cands_dev), np.float64
+            )
+            # the d2 fetch above proves the step completed; release the
+            # chunk's buffers including the raw wire transfer (StreamGuard
+            # rationale — retention otherwise grows with total bytes shipped)
+            for a in dev.values():
+                if a is not None:
+                    try:
+                        a.delete()
+                    except Exception:
+                        pass
+            nv = chunk.n_valid
+            np.minimum(
+                out[offset : offset + nv], d2[:nv], out=out[offset : offset + nv]
+            )
+            offset += nv
     return out
 
 
@@ -818,9 +846,14 @@ def streamed_count_closest(
     counts = jnp.zeros((cands.shape[0],), jnp.int32)
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     guard = StreamGuard()
-    for chunk in prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype)):
-        dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
-        counts = count_closest_chunk_step(counts, dev["X"], dev["mask"], cands_dev)
-        guard.tick(dev, counts)
+    with contextlib.closing(
+        prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+    ) as chunks:
+        for chunk in chunks:
+            dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
+            counts = count_closest_chunk_step(
+                counts, dev["X"], dev["mask"], cands_dev
+            )
+            guard.tick(dev, counts)
     guard.flush(counts)
     return np.asarray(counts, np.float64)

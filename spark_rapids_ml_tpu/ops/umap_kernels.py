@@ -276,6 +276,21 @@ def build_row_adjacency(
     return row_heads, tails_pad, p_pad
 
 
+def epoch_rng_keys(key: jax.Array, e) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-epoch (slot-uniform, permutation, roll-offset) keys.
+
+    Shared by the XLA epoch loop below AND the VMEM-resident Pallas
+    engine (``ops/umap_pallas.py``): same-seed parity between the two
+    engines requires both to derive their randomness from this exact
+    fold_in/split order — change it in one place or not at all."""
+    return jax.random.split(jax.random.fold_in(key, e), 3)
+
+
+def epoch_alpha(initial_alpha, e, n_epochs):
+    """umap-learn's linear learning-rate decay (shared across engines)."""
+    return initial_alpha * (1.0 - e / n_epochs)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("n_epochs", "negative_sample_rate", "self_table"),
@@ -327,8 +342,8 @@ def optimize_embedding_rows(
 
     def epoch(e, emb):
         src = emb if self_table else table
-        k1, k2, k3 = jax.random.split(jax.random.fold_in(key, e), 3)
-        alpha = initial_alpha * (1.0 - e / n_epochs)
+        k1, k2, k3 = epoch_rng_keys(key, e)
+        alpha = epoch_alpha(initial_alpha, e, n_epochs)
         active = (jax.random.uniform(k1, (R, K)) < p_pad).astype(emb.dtype)
 
         h = emb[row_heads]                    # (R, c)

@@ -1,0 +1,165 @@
+"""VMEM-resident Pallas UMAP SGD engine (``ops/umap_pallas.py``): same-seed
+parity against the XLA epoch loop, the ``TPUML_UMAP_OPT`` dispatch contract,
+and gate/fallback behavior — all in interpret mode on CPU via the
+``FORCE_INTERPRET`` idiom (``tests/test_rf_packed.py``)."""
+
+import logging
+
+import jax
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.ops import umap_pallas as up
+from spark_rapids_ml_tpu.ops.umap_kernels import (
+    build_row_adjacency,
+    find_ab_params,
+    optimize_embedding_rows,
+)
+from spark_rapids_ml_tpu.umap import UMAP
+
+A, B = (float(v) for v in find_ab_params(1.0, 0.1))
+
+
+def _row_data(n=600, k=6, K=8, seed=0):
+    """Random directed edge list -> CSR-padded SGD rows + an init embedding."""
+    rng = np.random.default_rng(seed)
+    heads = np.repeat(np.arange(n, dtype=np.int64), k)
+    tails = rng.integers(0, n, size=n * k)
+    w = rng.uniform(0.1, 1.0, size=n * k).astype(np.float32)
+    row_heads, tails_pad, p_pad = build_row_adjacency(
+        heads, tails, w, n, K=K, row_bucket=256
+    )
+    emb0 = rng.normal(size=(n, 2)).astype(np.float32) * 0.1
+    return row_heads, tails_pad, p_pad, emb0
+
+
+def test_fit_parity_same_seed(monkeypatch):
+    monkeypatch.setattr(up, "FORCE_INTERPRET", True)
+    row_heads, tails_pad, p_pad, emb0 = _row_data()
+    key = jax.random.PRNGKey(7)
+    kw = dict(
+        n_epochs=2, a=A, b=B, gamma=1.0, initial_alpha=1.0,
+        negative_sample_rate=3, self_table=True,
+    )
+    ref = np.asarray(
+        optimize_embedding_rows(emb0, emb0, row_heads, tails_pad, p_pad, key, **kw)
+    )
+    got = np.asarray(
+        up.umap_sgd_pallas(
+            emb0, emb0, row_heads, tails_pad, p_pad, key,
+            rng="xla", interpret=True, **kw,
+        )
+    )
+    # rng="xla" draws from the shared epoch_rng_keys stream, so the engines
+    # are same-seed equivalent up to summation-order rounding; the chaotic
+    # self-table feedback amplifies that with epoch count, hence few epochs
+    np.testing.assert_allclose(got, ref, atol=5e-4)
+
+
+def test_transform_frozen_table_parity(monkeypatch):
+    """self_table=False refine on a query count that is NOT a BLOCK_ROWS
+    multiple — exercises the kernel's inert-row padding discipline."""
+    monkeypatch.setattr(up, "FORCE_INTERPRET", True)
+    nq, n_tab, K = 100, 500, 8
+    rng = np.random.default_rng(3)
+    table = rng.normal(size=(n_tab, 2)).astype(np.float32)
+    emb0 = rng.normal(size=(nq, 2)).astype(np.float32) * 0.1
+    row_heads = np.arange(nq, dtype=np.int32)
+    tails_pad = rng.integers(0, n_tab, size=(nq, K)).astype(np.int32)
+    p_pad = rng.uniform(0.2, 1.0, size=(nq, K)).astype(np.float32)
+    key = jax.random.PRNGKey(11)
+    kw = dict(
+        n_epochs=4, a=A, b=B, gamma=1.0, initial_alpha=1.0,
+        negative_sample_rate=5, self_table=False,
+    )
+    ref = np.asarray(
+        optimize_embedding_rows(emb0, table, row_heads, tails_pad, p_pad, key, **kw)
+    )
+    got = np.asarray(
+        up.umap_sgd_pallas(
+            emb0, table, row_heads, tails_pad, p_pad, key,
+            rng="xla", interpret=True, **kw,
+        )
+    )
+    np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+def test_estimator_engines_agree_on_quality(monkeypatch):
+    """Full estimator fit+transform through each engine: trustworthiness
+    within ±0.01 and the fit/transform reports name the engine that ran."""
+    from sklearn.manifold import trustworthiness
+
+    monkeypatch.setattr(up, "FORCE_INTERPRET", True)
+    rng = np.random.default_rng(5)
+    centers = rng.normal(size=(3, 8)) * 5
+    lab = rng.integers(0, 3, size=300)
+    X = (centers[lab] + 0.3 * rng.normal(size=(300, 8))).astype(np.float32)
+    df = DataFrame({"features": X})
+
+    models = {}
+    for mode in ("pallas", "xla"):
+        monkeypatch.setenv("TPUML_UMAP_OPT", mode)
+        models[mode] = UMAP(
+            n_neighbors=10, random_state=0, init="random", n_epochs=30,
+            num_workers=1,
+        ).fit(df)
+        assert models[mode]._fit_report["sgd_engine"] == mode
+        rep = models[mode]._fit_report
+        assert rep["sgd_seconds"] > 0 and rep["epoch_ms"] > 0
+    t = {
+        m: trustworthiness(X, np.asarray(mod.embedding_), n_neighbors=10)
+        for m, mod in models.items()
+    }
+    assert t["xla"] > 0.85
+    assert abs(t["pallas"] - t["xla"]) <= 0.01, t
+
+    monkeypatch.setenv("TPUML_UMAP_OPT", "pallas")
+    out = models["pallas"].transform(DataFrame({"features": X[:64]}))
+    assert out["embedding"].shape == (64, 2)
+    assert models["pallas"]._transform_report["sgd_engine"] == "pallas"
+
+
+def test_resolve_umap_opt_validates(monkeypatch):
+    monkeypatch.setenv("TPUML_UMAP_OPT", "bogus")
+    with pytest.raises(ValueError, match="TPUML_UMAP_OPT"):
+        up.resolve_umap_opt()
+
+
+def test_auto_and_pallas_fall_back_on_cpu(monkeypatch, caplog):
+    """Without interpret forcing, a CPU host must resolve every mode to the
+    XLA loop — and an explicit pallas request warns instead of crashing."""
+    monkeypatch.setattr(up, "FORCE_INTERPRET", False)
+    monkeypatch.delenv("TPUML_UMAP_OPT", raising=False)
+    assert up.select_sgd_engine(1024, 24, 2, 5) == "xla"
+    monkeypatch.setenv("TPUML_UMAP_OPT", "xla")
+    assert up.select_sgd_engine(1024, 24, 2, 5) == "xla"
+    monkeypatch.setenv("TPUML_UMAP_OPT", "pallas")
+    # the package logger does not propagate to root, so hook caplog's
+    # handler onto it directly
+    lg = logging.getLogger("spark_rapids_ml_tpu.umap")
+    lg.addHandler(caplog.handler)
+    try:
+        assert up.select_sgd_engine(1024, 24, 2, 5) == "xla"
+    finally:
+        lg.removeHandler(caplog.handler)
+    assert any("falling back" in r.getMessage() for r in caplog.records)
+
+
+def test_gate_bounds(monkeypatch):
+    monkeypatch.setattr(up, "FORCE_INTERPRET", True)
+    assert up.umap_sgd_pallas_ok(1024, 24, 2, 5)
+    assert not up.umap_sgd_pallas_ok(1024, 24, 9, 5)       # C > 8
+    assert not up.umap_sgd_pallas_ok(1024, 200, 2, 5)      # K > 128
+    assert not up.umap_sgd_pallas_ok(1024, 128, 2, 16)     # K*(1+neg) > 1024
+    assert not up.umap_sgd_pallas_ok(1 << 20, 24, 2, 5)    # VMEM cap
+    # the interpreter has no PRNG lowering: onchip must be rejected there
+    assert not up.umap_sgd_pallas_ok(1024, 24, 2, 5, rng="onchip")
+
+
+def test_default_rng_mode_is_xla_off_tpu(monkeypatch):
+    monkeypatch.setattr(up, "FORCE_INTERPRET", True)
+    assert up.default_rng_mode() == "xla"
+    monkeypatch.setattr(up, "FORCE_INTERPRET", False)
+    if jax.default_backend() != "tpu":
+        assert up.default_rng_mode() == "xla"
