@@ -1329,14 +1329,25 @@ def main() -> None:
     _PARTIAL.update(
         results=results, meta=meta, tripped=watchdog_tripped, emitted=False
     )
+    from spark_rapids_ml_tpu.runtime import counters as _res_counters
+
     for name, fn in runs.items():
         for attempt in (0, 1):
             try:
+                res_base = _res_counters.snapshot()
                 # per-algo TensorBoard profile capture when requested
                 with trace(
                     os.path.join(profile_dir, name) if profile_dir else None
                 ):
                     res = _run_with_watchdog(name, fn, watchdog_tripped)
+                # resilience-runtime provenance: robustness overhead must be
+                # visible in the perf trajectory, and a clean run must prove
+                # itself clean (both read 0 with no TPUML_* resilience env)
+                res_delta = _res_counters.delta_since(res_base)
+                res["retries"] = res_delta.get("retries", 0) + res_delta.get(
+                    "chunk_halvings", 0
+                )
+                res["resumed_from"] = res_delta.get("resumed_from", 0)
                 res["mfu"] = res["flops_model"] / (
                     res["fit_seconds"] * peak * n_chips
                 )
@@ -1448,7 +1459,7 @@ def _emit_line(results, meta, watchdog_tripped):
         "transform_vs_baseline", "samples_per_sec_per_chip_e2e",
         "trustworthiness", "baseline_kind", "baseline_inputs",
         "graph_seconds", "init_seconds", "sgd_seconds", "epoch_ms",
-        "sgd_engine",
+        "sgd_engine", "retries", "resumed_from",
     )
     for name, r in results.items():
         line[name] = {

@@ -78,4 +78,66 @@ os.environ.pop("TPUML_UMAP_OPT")
 print("umap engine dispatch smoke OK")
 EOF
 
+echo "== fault-injection + checkpoint/resume smoke =="
+# Resilience contract (docs/fault_tolerance.md): a fit killed mid-iteration
+# by an injected preemption, refit with TPUML_CKPT_DIR set, resumes from
+# the snapshot and matches the uninterrupted fit exactly.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.runtime import counters, reset_faults
+from spark_rapids_ml_tpu.runtime.faults import SimulatedPreemption
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(256, 5))
+X[:64] += 4.0
+df = DataFrame({"features": X})
+
+def fit():
+    return KMeans(
+        k=4, maxIter=8, tol=1e-12, seed=5, num_workers=4,
+        streaming=True, stream_chunk_rows=64,
+    ).setFeaturesCol("features").fit(df)
+
+clean = fit()
+
+ckpt_dir = tempfile.mkdtemp(prefix="tpuml-ckpt-smoke-")
+try:
+    os.environ["TPUML_CKPT_DIR"] = ckpt_dir
+    os.environ["TPUML_CKPT_EVERY"] = "1"
+    os.environ["TPUML_FAULT_SPEC"] = "sgd:epoch:2:preempt"
+    reset_faults()
+    try:
+        fit()
+    except SimulatedPreemption:
+        pass
+    else:
+        raise SystemExit("injected preemption did not fire")
+    assert os.listdir(ckpt_dir), "no checkpoint committed before the fault"
+
+    del os.environ["TPUML_FAULT_SPEC"]
+    reset_faults()
+    base = counters.snapshot()
+    resumed = fit()
+    delta = counters.delta_since(base)
+    assert delta.get("resumed_fits") == 1, delta
+    assert delta.get("resumed_from") == 2, delta
+    np.testing.assert_allclose(
+        resumed.cluster_centers_, clean.cluster_centers_, rtol=0, atol=1e-12
+    )
+    assert os.listdir(ckpt_dir) == [], "checkpoint not cleared on success"
+finally:
+    for var in ("TPUML_CKPT_DIR", "TPUML_CKPT_EVERY", "TPUML_FAULT_SPEC"):
+        os.environ.pop(var, None)
+    reset_faults()
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+print("fault-injection + resume smoke OK")
+EOF
+
 echo "CI OK"

@@ -525,12 +525,22 @@ class _TpuEstimator(Params, _TpuParams):
                 est._set_params(**kw)
                 estimators.append(est)
                 param_sets.append(dict(est._tpu_params))
+        from .runtime import counters as _res_counters
+
         for est, ps in zip(estimators, param_sets):
+            res_base = _res_counters.snapshot()
             with annotate(f"{cls_name}.fit"), timed(self.logger, "fit"):
                 result = fit_func(inputs, ps)
             model = est._create_model(result)
             est._copyValues(model)
             est._copy_tpu_params(model)
+            # resilience provenance: what the runtime had to do to land
+            # this fit (retries/halvings/resume). Empty dict — and no log
+            # line — on the clean path.
+            res_delta = _res_counters.delta_since(res_base)
+            model._resilience_report = res_delta
+            if res_delta:
+                self.logger.info("resilience events during fit: %s", res_delta)
             models.append(model)
         return models
 
@@ -588,6 +598,11 @@ class _TpuModel(Params, _TpuParams):
 
     # subclasses list their array attributes for persistence
     _model_attribute_names: List[str] = []
+
+    # resilience events observed during this model's fit (runtime/counters
+    # delta; {} on a clean path). Class-level default so models that never
+    # went through a fit loop (e.g. load()ed from disk) still expose it.
+    _resilience_report: Dict[str, int] = {}
 
     def __init__(self, **model_attributes: Any) -> None:
         super().__init__()

@@ -350,6 +350,26 @@ class LogisticRegression(
                     "objective_dtype=bfloat16 applies to the resident fit "
                     "only; the streaming fit reads chunks at wire dtype"
                 )
+            # checkpoint identity: the L-BFGS walk is fully determined by
+            # the objective config + data; shape/size stand in for a data
+            # digest (a content pass would cost a full extra read)
+            from ..runtime.checkpoint import FitCheckpointer
+
+            ckpt = FitCheckpointer.from_env(
+                "logreg",
+                {
+                    "n_classes": n_classes,
+                    "multinomial": multinomial,
+                    "fit_intercept": fit_intercept,
+                    "standardization": bool(params["standardization"]),
+                    "l1": reg * l1_ratio,
+                    "l2": reg * (1.0 - l1_ratio),
+                    "max_iter": int(params["max_iter"]),
+                    "tol": float(params["tol"]),
+                    "n_rows": int(inputs.n_rows),
+                    "d": int(inputs.n_features),
+                },
+            )
             out = streamed_logreg_fit(
                 inputs.source,
                 inputs.mesh,
@@ -363,6 +383,7 @@ class LogisticRegression(
                 l2=reg * (1.0 - l1_ratio),
                 max_iter=int(params["max_iter"]),
                 tol=float(params["tol"]),
+                checkpointer=ckpt if ckpt.enabled else None,
             )
             return {
                 "coef_": np.asarray(out["coef_"]),

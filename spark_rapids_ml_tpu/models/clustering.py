@@ -451,6 +451,25 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
                     inputs.n_rows, k, int(params.get("init_steps", 2)),
                     float(params.get("oversampling_factor", 2.0)), rng, owner,
                 )
+            # checkpoint identity: seeding is deterministic (seeded rng +
+            # chunked passes), so refit regenerates the same centers0 and
+            # its digest proves the Lloyd walk being resumed is this one
+            from ..runtime.checkpoint import FitCheckpointer, array_digest
+
+            ckpt = FitCheckpointer.from_env(
+                "kmeans",
+                {
+                    "k": k,
+                    "d": int(inputs.source.n_features),
+                    "n_rows": int(inputs.n_rows),
+                    "max_iter": int(params["max_iter"]),
+                    "tol": float(params["tol"]),
+                    "seed": int(params.get("random_state") or 0),
+                    "init": str(params.get("init")),
+                    "matmul_dtype": str(mm),
+                    "centers0": array_digest(centers0),
+                },
+            )
             centers, cost, n_iter = streamed_kmeans_lloyd(
                 inputs.source,
                 inputs.mesh,
@@ -460,6 +479,7 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
                 max_iter=int(params["max_iter"]),
                 tol=float(params["tol"]),
                 matmul_dtype=mm,
+                checkpointer=ckpt if ckpt.enabled else None,
             )
             return {
                 "cluster_centers": np.asarray(centers),

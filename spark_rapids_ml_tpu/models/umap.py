@@ -57,6 +57,9 @@ from ..ops.umap_pallas import (
     select_sgd_engine,
     umap_sgd_pallas,
 )
+from ..runtime import counters
+from ..runtime.checkpoint import FitCheckpointer, array_digest
+from ..runtime.faults import fault_site, fault_sites_active
 from ..utils.profiling import StageTimer
 
 _LOGGER = logging.getLogger("spark_rapids_ml_tpu.umap")
@@ -70,6 +73,59 @@ def _run_sgd(engine: str, *args: Any, **kwargs: Any) -> jax.Array:
     if engine == "pallas":
         return umap_sgd_pallas(*args, rng=default_rng_mode(), **kwargs)
     return optimize_embedding_rows(*args, **kwargs)
+
+
+def _run_sgd_segmented(
+    engine: str,
+    emb0: jax.Array,
+    row_heads: jax.Array,
+    tails_pad: jax.Array,
+    p_pad: jax.Array,
+    key: jax.Array,
+    ckpt: FitCheckpointer,
+    **kwargs: Any,
+) -> jax.Array:
+    """Host-driven segmented SGD: checkpoint/resume over the epoch loop.
+
+    Runs ``TPUML_CKPT_EVERY`` epochs per jitted call via the engines'
+    ``epoch_offset``/``epoch_span`` contract — per-epoch RNG and learning
+    rate are functions of the ABSOLUTE epoch index, so the segmented walk
+    is same-seed equivalent to the single fused ``fori_loop``. Each
+    segment boundary is a ``sgd:epoch`` fault site and (when checkpointing
+    is on) a snapshot of the embedding + epoch cursor; resume restores
+    both and re-enters at the saved absolute epoch. At most two epoch-span
+    values occur (the segment and the final remainder), so segmentation
+    costs at most one extra compile of the epoch loop.
+    """
+    n_epochs = int(kwargs["n_epochs"])
+    seg = ckpt.every if ckpt.enabled else 1
+    e = 0
+    emb = emb0
+    resumed = ckpt.load()
+    if resumed is not None:
+        e, arrays, _ = resumed
+        emb = jnp.asarray(arrays["embedding"])
+        counters.bump("resumed_fits")
+        counters.note("resumed_from", e)
+    while e < n_epochs:
+        fault_site("sgd:epoch")
+        span = min(seg, n_epochs - e)
+        emb = _run_sgd(
+            engine,
+            emb,
+            emb,
+            row_heads,
+            tails_pad,
+            p_pad,
+            key,
+            epoch_offset=e,
+            epoch_span=span,
+            **kwargs,
+        )
+        e += span
+        ckpt.maybe_save(e, {"embedding": np.asarray(emb)})
+    ckpt.clear()
+    return emb
 
 
 @functools.partial(jax.jit, static_argnames=("k", "qchunk", "topk_impl"))
@@ -250,6 +306,7 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         from ..parallel.context import ensure_distributed
 
         ensure_distributed()  # idempotent (package import already ran it)
+        res_base = counters.snapshot()
         seed = int(self._tpu_params.get("random_state") or 0)
         frac = float(self.getSampleFraction())
         df = dataset if frac >= 1.0 else dataset.sample(frac, seed=seed)
@@ -357,22 +414,54 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
             # VMEM-resident Pallas kernel vs the jitted XLA loop
             engine = select_sgd_engine(n, tails_pad.shape[1], n_comp, neg_rate)
             emb0 = jnp.asarray(emb0)
-            emb = _run_sgd(
-                engine,
-                emb0,
-                emb0,
+            gamma_v = float(self._tpu_params.get("repulsion_strength", 1.0))
+            alpha_v = float(self._tpu_params.get("learning_rate", 1.0))
+            sgd_kwargs: Dict[str, Any] = dict(
+                n_epochs=int(n_epochs),
+                a=float(a),
+                b=float(b),
+                gamma=gamma_v,
+                initial_alpha=alpha_v,
+                negative_sample_rate=neg_rate,
+                self_table=True,
+            )
+            sgd_args = (
                 jnp.asarray(row_heads),
                 jnp.asarray(tails_pad),
                 jnp.asarray(p_pad),
                 jax.random.PRNGKey(seed),
-                n_epochs=int(n_epochs),
-                a=float(a),
-                b=float(b),
-                gamma=float(self._tpu_params.get("repulsion_strength", 1.0)),
-                initial_alpha=float(self._tpu_params.get("learning_rate", 1.0)),
-                negative_sample_rate=neg_rate,
-                self_table=True,
             )
+            # checkpoint identity: everything the epoch sequence depends
+            # on, with array inputs content-digested (same seed + same
+            # graph => same stream; anything else must cold-start)
+            ckpt = FitCheckpointer.from_env(
+                "umap",
+                {
+                    "seed": seed,
+                    "n_epochs": int(n_epochs),
+                    "a": float(a),
+                    "b": float(b),
+                    "gamma": gamma_v,
+                    "alpha": alpha_v,
+                    "neg": neg_rate,
+                    "engine": engine,
+                    "n": n,
+                    "n_comp": n_comp,
+                    "emb0": array_digest(emb0),
+                    "row_heads": array_digest(row_heads),
+                    "tails": array_digest(tails_pad),
+                    "p": array_digest(p_pad),
+                },
+            )
+            if ckpt.enabled or fault_sites_active("sgd:epoch"):
+                # host-segmented epochs: checkpointable/faultable, same
+                # seed-equivalence as the fused loop (absolute-epoch RNG)
+                emb = _run_sgd_segmented(
+                    engine, emb0, *sgd_args, ckpt, **sgd_kwargs
+                )
+            else:
+                # clean path: one fused fori_loop call, unchanged
+                emb = _run_sgd(engine, emb0, emb0, *sgd_args, **sgd_kwargs)
             emb_host = np.asarray(emb, dtype=np.float32)
 
         model = UMAPModel(
@@ -396,6 +485,13 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
             "epoch_ms": round(sgd_s / max(int(n_epochs), 1) * 1e3, 3),
             "sgd_engine": engine,
         }
+        # UMAP overrides fit() and skips the core per-fit loop, so attach
+        # the resilience delta here (same contract as core._fit_internal)
+        model._resilience_report = counters.delta_since(res_base)
+        if model._resilience_report:
+            _LOGGER.info(
+                "resilience events during fit: %s", model._resilience_report
+            )
         return model
 
     def _get_tpu_fit_func(self, dataset: DataFrame):  # pragma: no cover

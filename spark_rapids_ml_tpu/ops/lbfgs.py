@@ -203,6 +203,7 @@ def minimize_lbfgs_host(
     l1_weights=None,
     history: int = 10,
     max_ls: int = 30,
+    checkpointer=None,
 ) -> LbfgsResult:
     """Host-driven L-BFGS/OWL-QN for out-of-core objectives.
 
@@ -216,8 +217,17 @@ def minimize_lbfgs_host(
 
     ``value_grad`` must return the SMOOTH (f, g) pair; the L1 term is added
     here, mirroring ``full_obj_parts`` in the jitted solver.
+
+    ``checkpointer`` (a ``runtime.FitCheckpointer``, or None) snapshots the
+    full carry — ``w/f/g`` and the ``S/Y`` history — after each iteration
+    and resumes from the last committed one on refit. The algorithm is
+    deterministic given the carry, so an interrupted-then-resumed run walks
+    the identical iterate sequence as an uninterrupted one.
     """
     import numpy as np
+
+    from ..runtime import counters
+    from ..runtime.faults import fault_site
 
     w = np.asarray(w0, dtype=np.float64)
     p = w.shape[0]
@@ -235,13 +245,26 @@ def minimize_lbfgs_host(
         at_zero = np.where(lo > 0.0, lo, np.where(hi < 0.0, hi, 0.0))
         return np.where(wv != 0.0, nonzero, at_zero)
 
-    f, g = full_obj(w)
     S: list = []
     Y: list = []
     c1 = 1e-4
     it = 0
     converged = False
+    resumed = checkpointer.load() if checkpointer is not None else None
+    if resumed is not None:
+        it, arrays, extra = resumed
+        w = np.asarray(arrays["w"], np.float64)
+        g = np.asarray(arrays["g"], np.float64)
+        S = [np.asarray(row, np.float64) for row in arrays["S"]]
+        Y = [np.asarray(row, np.float64) for row in arrays["Y"]]
+        f = float(extra["f"])
+        converged = bool(extra.get("converged", False))
+        counters.bump("resumed_fits")
+        counters.note("resumed_from", it)
+    else:
+        f, g = full_obj(w)
     while it < max_iter and not converged:
+        fault_site("sgd:epoch")
         pg = pseudo_grad(w, g) if use_l1 else g
         # two-loop recursion over the (oldest -> newest) history
         q = pg.copy()
@@ -297,6 +320,20 @@ def minimize_lbfgs_host(
         converged = rel_impr <= tol or dir_deriv >= 0.0
         w, f, g = w_new, f_t, g_t
         it += 1
+        if checkpointer is not None:
+            checkpointer.maybe_save(
+                it,
+                {
+                    "w": w,
+                    "g": g,
+                    "S": np.stack(S) if S else np.zeros((0, p)),
+                    "Y": np.stack(Y) if Y else np.zeros((0, p)),
+                },
+                {"f": f, "converged": bool(converged)},
+            )
+
+    if checkpointer is not None:
+        checkpointer.clear()
 
     import jax.numpy as _jnp
 

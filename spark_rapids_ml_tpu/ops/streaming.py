@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -31,6 +31,17 @@ import jax.numpy as jnp
 
 from ..data.chunks import Chunk, ChunkSource
 from ..parallel.mesh import row_sharding
+from ..runtime import counters
+from ..runtime.faults import SimulatedPreemption, fault_site
+from ..runtime.retry import (
+    backoff_schedule,
+    is_resource_exhausted,
+    resolve_backoff_ms,
+    resolve_retries,
+)
+from ..utils.logging import get_logger
+
+_res_logger = get_logger("streaming.resilience")
 
 
 # ---------------------------------------------------------------------------
@@ -183,15 +194,19 @@ def prefetch_chunks(it, depth: Optional[int] = None):
                 try:
                     c = q.get_nowait()
                 except queue.Empty:
-                    # the internal Empty is not part of the user's error
-                    raise err[0] from None
+                    # the internal Empty is not part of the user's error;
+                    # re-raise the worker's exception object WITH the
+                    # traceback it captured in the producer thread, so the
+                    # failing frame (parquet decode, injected ingest fault,
+                    # ...) is visible from the consumer
+                    raise err[0].with_traceback(err[0].__traceback__) from None
             else:
                 c = q.get()
             if c is end:
                 break
             yield c
         if err:
-            raise err[0]
+            raise err[0].with_traceback(err[0].__traceback__)
     finally:
         # Callers that abandon the generator early should close() it (the
         # `finally` then runs promptly); an unclosed-but-unreferenced
@@ -218,6 +233,7 @@ def put_chunk(
     transfer completed: an array the step never reads would otherwise sit
     in the guard's pending list with nothing proving its transfer retired
     before ``delete()``."""
+    fault_site("ingest:chunk")
     sh = row_sharding(mesh)
     x_host = np.asarray(chunk.X)
     wire = None
@@ -241,6 +257,96 @@ def put_chunk(
     if need_w and chunk.w is not None:
         out["w"] = jax.device_put(np.asarray(chunk.w, dtype=dtype), sh)
     return out
+
+
+def _split_chunk(chunk: Chunk, row_mult: int) -> Optional[Tuple[Chunk, Chunk]]:
+    """Split a chunk into two row-slabs, each a multiple of ``row_mult``.
+
+    ``row_mult`` is the dp mesh size — the sharding divisibility every
+    ``put_chunk`` row dimension must satisfy. Returns None when the chunk
+    is already at the minimum splittable size.
+    """
+    rows = chunk.X.shape[0]
+    if rows < 2 * row_mult or rows % row_mult != 0:
+        return None
+    half = (rows // 2 // row_mult) * row_mult
+    half = max(half, row_mult)
+
+    def slab(lo: int, hi: int) -> Chunk:
+        return Chunk(
+            X=chunk.X[lo:hi],
+            n_valid=int(np.clip(chunk.n_valid - lo, 0, hi - lo)),
+            y=None if chunk.y is None else chunk.y[lo:hi],
+            w=None if chunk.w is None else chunk.w[lo:hi],
+        )
+
+    return slab(0, half), slab(half, rows)
+
+
+def stage_chunks(chunk: Chunk, mesh, dtype, *, need_y: bool = True, need_w: bool = True):
+    """Stage ``chunk`` on device, degrading gracefully under failure.
+
+    Yields ``(piece, dev)`` pairs — normally exactly one, the whole chunk.
+    With a retry budget (``TPUML_RETRIES`` > 0):
+
+    - a RESOURCE_EXHAUSTED staging failure halves the chunk (at a dp-size
+      row multiple, preserving sharding divisibility) and stages the
+      halves independently, recursively down to one row-slab per dp rank —
+      an allocator-pressure spike degrades throughput instead of killing
+      the fit;
+    - any other staging failure is retried on the env backoff schedule;
+    - :class:`SimulatedPreemption` is terminal, never absorbed.
+
+    With the default env (no budget) this is one ``put_chunk`` call — the
+    clean path stays byte-identical. The accumulation steps downstream are
+    per-chunk sum-folds, so a split chunk folds to the same result as the
+    whole one (halves carry correctly sliced ``n_valid``/labels/weights).
+    """
+    budget = resolve_retries()
+    if budget <= 0:
+        yield chunk, put_chunk(chunk, mesh, dtype, need_y=need_y, need_w=need_w)
+        return
+    import time as _time
+
+    delays = backoff_schedule(budget, resolve_backoff_ms())
+    row_mult = max(1, int(mesh.shape.get("dp", 1)))
+    attempts = 0
+    pending = [chunk]
+    while pending:
+        piece = pending[0]
+        try:
+            dev = put_chunk(piece, mesh, dtype, need_y=need_y, need_w=need_w)
+        except SimulatedPreemption:
+            raise
+        except Exception as exc:
+            if is_resource_exhausted(exc):
+                halves = _split_chunk(piece, row_mult)
+                if halves is not None:
+                    counters.bump("chunk_halvings")
+                    _res_logger.warning(
+                        "chunk staging hit RESOURCE_EXHAUSTED (%s); halving "
+                        "%d rows -> 2 x %d-row slabs",
+                        exc,
+                        piece.X.shape[0],
+                        halves[0].X.shape[0],
+                    )
+                    pending[0:1] = list(halves)
+                    continue
+            if attempts >= budget:
+                raise
+            counters.bump("retries")
+            _res_logger.warning(
+                "chunk staging failed (attempt %d/%d): %s — retrying in %.0f ms",
+                attempts + 1,
+                budget + 1,
+                exc,
+                delays[attempts],
+            )
+            _time.sleep(delays[attempts] / 1000.0)
+            attempts += 1
+            continue
+        pending.pop(0)
+        yield piece, dev
 
 
 # ---------------------------------------------------------------------------
@@ -459,10 +565,12 @@ def streamed_suffstats(
         prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
     ) as chunks:
         for chunk in chunks:
-            dev = put_chunk(chunk, mesh, dtype, need_y=with_y)
-            rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
-            acc1 = moments1_step(acc1, dev["X"], rw, dev["y"] if with_y else None)
-            guard.tick(dev, acc1)
+            for _, dev in stage_chunks(chunk, mesh, dtype, need_y=with_y):
+                rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
+                acc1 = moments1_step(
+                    acc1, dev["X"], rw, dev["y"] if with_y else None
+                )
+                guard.tick(dev, acc1)
     guard.flush(acc1)
     # cross-process allreduce of the first-moment partials (the NCCL
     # allreduce analog; identity single-process)
@@ -486,13 +594,13 @@ def streamed_suffstats(
         prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
     ) as chunks:
         for chunk in chunks:
-            dev = put_chunk(chunk, mesh, dtype, need_y=with_y)
-            rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
-            acc2 = gram2_step(
-                acc2, dev["X"], rw, mean_x,
-                dev["y"] if with_y else None, mean_y,
-            )
-            guard.tick(dev, acc2)
+            for _, dev in stage_chunks(chunk, mesh, dtype, need_y=with_y):
+                rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
+                acc2 = gram2_step(
+                    acc2, dev["X"], rw, mean_x,
+                    dev["y"] if with_y else None, mean_y,
+                )
+                guard.tick(dev, acc2)
     guard.flush(acc2)
     if with_y:
         G_h, Xy_h, yy_h = allreduce_sum_host(acc2["G"], acc2["Xy"], acc2["yy"])
@@ -533,6 +641,7 @@ def streamed_logreg_fit(
     max_iter: int,
     tol: float,
     history: int = 10,
+    checkpointer=None,
 ) -> Dict[str, np.ndarray]:
     """Out-of-core logistic regression: host-driven L-BFGS/OWL-QN where each
     objective evaluation streams the dataset through the device in chunks.
@@ -560,9 +669,11 @@ def streamed_logreg_fit(
         prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
     ) as chunks:
         for chunk in chunks:
-            dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
-            acc1 = moments1_step(acc1, dev["X"], dev["mask"])
-            guard.tick(dev, acc1)
+            for _, dev in stage_chunks(
+                chunk, mesh, dtype, need_y=False, need_w=False
+            ):
+                acc1 = moments1_step(acc1, dev["X"], dev["mask"])
+                guard.tick(dev, acc1)
     guard.flush(acc1)
     n_h, sx_h = allreduce_sum_host(acc1["n"], acc1["sum_x"])
     n = float(n_h)
@@ -577,9 +688,11 @@ def streamed_logreg_fit(
             prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
         ) as chunks:
             for chunk in chunks:
-                dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
-                vacc = var_chunk_step(vacc, dev["X"], dev["mask"], mean)
-                guard.tick(dev, vacc)
+                for _, dev in stage_chunks(
+                    chunk, mesh, dtype, need_y=False, need_w=False
+                ):
+                    vacc = var_chunk_step(vacc, dev["X"], dev["mask"], mean)
+                    guard.tick(dev, vacc)
         guard.flush(vacc)
         (vacc_h,) = allreduce_sum_host(vacc)
         var = jnp.asarray(vacc_h, dtype) / max(n - 1.0, 1.0)
@@ -603,13 +716,14 @@ def streamed_logreg_fit(
             prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
         ) as chunks:
             for chunk in chunks:
-                dev = put_chunk(chunk, mesh, dtype, need_w=False)
-                acc = logreg_chunk_vg_step(
-                    acc, dev["X"], dev["mask"], dev["y"], wd, mean_dev, inv_std,
-                    n_classes=n_classes, multinomial=multinomial,
-                    fit_intercept=fit_intercept, use_center=use_center,
-                )
-                guard.tick(dev, acc)
+                for _, dev in stage_chunks(chunk, mesh, dtype, need_w=False):
+                    acc = logreg_chunk_vg_step(
+                        acc, dev["X"], dev["mask"], dev["y"], wd, mean_dev,
+                        inv_std,
+                        n_classes=n_classes, multinomial=multinomial,
+                        fit_intercept=fit_intercept, use_center=use_center,
+                    )
+                    guard.tick(dev, acc)
         guard.flush(acc)
         # per-evaluation allreduce of (loss, grad) partials — the QN-loop
         # NCCL allreduce of the reference's distributed L-BFGS; every rank
@@ -627,6 +741,7 @@ def streamed_logreg_fit(
         tol=tol,
         l1_weights=(l1 * coef_mask) if l1 > 0.0 else None,
         history=history,
+        checkpointer=checkpointer,
     )
 
     w = np.asarray(res.w)
@@ -656,6 +771,7 @@ def streamed_kmeans_lloyd(
     max_iter: int,
     tol: float,
     matmul_dtype=None,
+    checkpointer=None,
 ):
     """Out-of-core Lloyd: one chunked pass per iteration accumulates
     (sums, counts, cost); centroid state stays tiny (k×d). Matches the
@@ -663,6 +779,11 @@ def streamed_kmeans_lloyd(
     their previous center (Spark behavior), convergence on max center
     shift² <= tol², plus a final cost pass at the converged centers.
     Returns (centers, cost, n_iter) as host values.
+
+    ``checkpointer`` (a ``runtime.FitCheckpointer``, or None) snapshots
+    centers + the last center shift after each Lloyd iteration; resume
+    walks the identical centroid sequence (Lloyd is deterministic given
+    the centers), including the same termination iteration.
     """
     from ..parallel.mesh import allreduce_sum_host
 
@@ -681,11 +802,13 @@ def streamed_kmeans_lloyd(
             prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
         ) as chunks:
             for chunk in chunks:
-                dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
-                acc = kmeans_chunk_step(
-                    acc, dev["X"], dev["mask"], cts, matmul_dtype=mm
-                )
-                guard.tick(dev, acc)
+                for _, dev in stage_chunks(
+                    chunk, mesh, dtype, need_y=False, need_w=False
+                ):
+                    acc = kmeans_chunk_step(
+                        acc, dev["X"], dev["mask"], cts, matmul_dtype=mm
+                    )
+                    guard.tick(dev, acc)
         guard.flush(acc)
         # per-iteration allreduce of (sums, counts, cost) partials — the
         # Lloyd-loop NCCL allreduce; every rank then updates identically
@@ -696,7 +819,15 @@ def streamed_kmeans_lloyd(
 
     it = 0
     prev_shift = np.inf
+    resumed = checkpointer.load() if checkpointer is not None else None
+    if resumed is not None:
+        it, arrays, extra = resumed
+        centers = jnp.asarray(arrays["centers"], dtype)
+        prev_shift = float(extra["prev_shift"])
+        counters.bump("resumed_fits")
+        counters.note("resumed_from", it)
     while it < max_iter and prev_shift > tol * tol:
+        fault_site("sgd:epoch")
         acc = one_pass(centers)
         sums = np.asarray(acc["sums"], np.float64)
         counts = np.asarray(acc["counts"])
@@ -709,10 +840,16 @@ def streamed_kmeans_lloyd(
         )
         centers = jnp.asarray(new_centers, dtype)
         it += 1
+        if checkpointer is not None:
+            checkpointer.maybe_save(
+                it, {"centers": np.asarray(centers)}, {"prev_shift": prev_shift}
+            )
 
     # final cost pass always f32 (bf16 distance expansion cancels near
     # centroids — see kmeans_kernels.kmeans_lloyd)
     final = one_pass(centers, mm=None)
+    if checkpointer is not None:
+        checkpointer.clear()
     return np.asarray(centers), float(final["cost"]), it
 
 
@@ -816,24 +953,30 @@ def streamed_min_sq_dists_update(
         prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
     ) as chunks:
         for chunk in chunks:
-            dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
-            d2 = np.asarray(
-                chunk_min_sq_dists(dev["X"], dev["mask"], cands_dev), np.float64
-            )
-            # the d2 fetch above proves the step completed; release the
-            # chunk's buffers including the raw wire transfer (StreamGuard
-            # rationale — retention otherwise grows with total bytes shipped)
-            for a in dev.values():
-                if a is not None:
-                    try:
-                        a.delete()
-                    except Exception:
-                        pass
-            nv = chunk.n_valid
-            np.minimum(
-                out[offset : offset + nv], d2[:nv], out=out[offset : offset + nv]
-            )
-            offset += nv
+            for piece, dev in stage_chunks(
+                chunk, mesh, dtype, need_y=False, need_w=False
+            ):
+                d2 = np.asarray(
+                    chunk_min_sq_dists(dev["X"], dev["mask"], cands_dev),
+                    np.float64,
+                )
+                # the d2 fetch above proves the step completed; release the
+                # chunk's buffers including the raw wire transfer (StreamGuard
+                # rationale — retention otherwise grows with total bytes
+                # shipped)
+                for a in dev.values():
+                    if a is not None:
+                        try:
+                            a.delete()
+                        except Exception:
+                            pass
+                nv = piece.n_valid
+                np.minimum(
+                    out[offset : offset + nv],
+                    d2[:nv],
+                    out=out[offset : offset + nv],
+                )
+                offset += nv
     return out
 
 
@@ -850,10 +993,12 @@ def streamed_count_closest(
         prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
     ) as chunks:
         for chunk in chunks:
-            dev = put_chunk(chunk, mesh, dtype, need_y=False, need_w=False)
-            counts = count_closest_chunk_step(
-                counts, dev["X"], dev["mask"], cands_dev
-            )
-            guard.tick(dev, counts)
+            for _, dev in stage_chunks(
+                chunk, mesh, dtype, need_y=False, need_w=False
+            ):
+                counts = count_closest_chunk_step(
+                    counts, dev["X"], dev["mask"], cands_dev
+                )
+                guard.tick(dev, counts)
     guard.flush(counts)
     return np.asarray(counts, np.float64)

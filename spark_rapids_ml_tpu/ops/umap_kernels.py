@@ -293,7 +293,7 @@ def epoch_alpha(initial_alpha, e, n_epochs):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_epochs", "negative_sample_rate", "self_table"),
+    static_argnames=("n_epochs", "negative_sample_rate", "self_table", "epoch_span"),
 )
 def optimize_embedding_rows(
     emb_head: jax.Array,    # (n_head, c) embedding being optimized
@@ -311,6 +311,8 @@ def optimize_embedding_rows(
     initial_alpha: float = 1.0,
     negative_sample_rate: int = 5,
     self_table: bool = True,
+    epoch_offset=0,
+    epoch_span: Optional[int] = None,
 ) -> jax.Array:
     """Head-only negative-sampling SGD over CSR-padded rows (see module
     docstring for the cuML-parity argument and the TPU cost model).
@@ -323,6 +325,14 @@ def optimize_embedding_rows(
     ``stack`` of an (R, K, c) base fuses into the gradient computation
     and costs ~0 — 11.9 ms/epoch total either with or without the whole
     repulsive term. pow() is likewise free once fused.
+
+    ``epoch_offset``/``epoch_span`` let a host loop (checkpoint/resume,
+    ``models/umap.py``) run epochs ``[offset, offset + span)`` as one call:
+    RNG (``epoch_rng_keys``) and learning rate (``epoch_alpha``) both
+    derive from the ABSOLUTE epoch index, so segmented execution is
+    bit-identical to the single ``epoch_span=None`` (= ``n_epochs``) call.
+    ``epoch_offset`` is traced — resuming at a new offset recompiles
+    nothing.
     """
     R, K = tails_pad.shape
     n_head, c = emb_head.shape
@@ -340,7 +350,11 @@ def optimize_embedding_rows(
     # Negatives are head-only there too — no scaling.
     attract_scale = 2.0 if self_table else 1.0
 
-    def epoch(e, emb):
+    span = n_epochs if epoch_span is None else int(epoch_span)
+    e0 = jnp.asarray(epoch_offset, jnp.int32)
+
+    def epoch(i, emb):
+        e = e0 + i  # absolute epoch: RNG + alpha match single-shot runs
         src = emb if self_table else table
         k1, k2, k3 = epoch_rng_keys(key, e)
         alpha = epoch_alpha(initial_alpha, e, n_epochs)
@@ -378,7 +392,7 @@ def optimize_embedding_rows(
         )
         return emb + alpha * upd
 
-    return lax.fori_loop(0, n_epochs, epoch, emb_head)
+    return lax.fori_loop(0, span, epoch, emb_head)
 
 
 def default_n_epochs(n: int) -> int:

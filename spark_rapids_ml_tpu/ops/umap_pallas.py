@@ -302,6 +302,7 @@ def sgd_epoch_rows(
     static_argnames=(
         "n_epochs", "a", "b", "gamma", "initial_alpha",
         "negative_sample_rate", "self_table", "rng", "interpret",
+        "epoch_span",
     ),
 )
 def umap_sgd_pallas(
@@ -322,6 +323,8 @@ def umap_sgd_pallas(
     self_table: bool = True,
     rng: str = "xla",
     interpret: bool | None = None,
+    epoch_offset=0,
+    epoch_span: int | None = None,
 ) -> jax.Array:
     """Drop-in engine for ``umap_kernels.optimize_embedding_rows`` with the
     gather/gradient hot loop in the VMEM-resident Pallas kernel.
@@ -331,7 +334,13 @@ def umap_sgd_pallas(
     ``rng="xla"``), negatives reproduce the tiled-permutation + per-sample
     row-roll semantics as precomputed index tiles, and the epoch tail
     (sorted segment_sum, ``emb + alpha*upd``) is byte-for-byte the same
-    code path — so ``rng="xla"`` outputs are same-seed equivalent."""
+    code path — so ``rng="xla"`` outputs are same-seed equivalent.
+
+    ``epoch_offset``/``epoch_span`` (the checkpoint/resume segmenting
+    contract of ``optimize_embedding_rows``): run absolute epochs
+    ``[offset, offset + span)``. All per-epoch state — epoch keys, alpha,
+    the on-chip PRNG's ``seed_base + e`` — is a function of the absolute
+    index, so segmented runs match single-shot ones."""
     from jax import lax
 
     R, K = tails_pad.shape
@@ -358,7 +367,11 @@ def umap_sgd_pallas(
         dtype=jnp.int32,
     )
 
-    def epoch(e, emb):
+    span = n_epochs if epoch_span is None else int(epoch_span)
+    e0 = jnp.asarray(epoch_offset, jnp.int32)
+
+    def epoch(i, emb):
+        e = e0 + i  # absolute epoch: RNG + alpha match single-shot runs
         src = emb if self_table else table
         k1, k2, k3 = epoch_rng_keys(key, e)
         alpha = epoch_alpha(initial_alpha, e, n_epochs)
@@ -394,4 +407,4 @@ def umap_sgd_pallas(
         )
         return emb + alpha * upd
 
-    return lax.fori_loop(0, n_epochs, epoch, emb_head)
+    return lax.fori_loop(0, span, epoch, emb_head)

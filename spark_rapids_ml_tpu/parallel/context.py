@@ -24,6 +24,8 @@ from typing import Optional
 
 import jax
 
+from ..runtime.faults import fault_site
+from ..runtime.retry import with_retries
 from ..utils.logging import get_logger
 
 logger = get_logger("TpuDistContext")
@@ -32,11 +34,48 @@ logger = get_logger("TpuDistContext")
 _process_initialized = False
 
 
+class DistConfigError(ValueError):
+    """Malformed multi-process rendezvous configuration (TPUML_* env)."""
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise DistConfigError(
+            f"{name}={raw!r} is not an integer — the launcher must export a "
+            f"plain base-10 process count/rank"
+        ) from None
+
+
+def _validated_env_topology() -> tuple:
+    """(num_procs, proc_id) from env, with bounds checked up front.
+
+    A malformed launcher env used to surface as a bare ``ValueError`` from
+    ``int()`` deep inside the first mesh touch; validate here so the error
+    names the variable and the constraint.
+    """
+    num_procs = _env_int("TPUML_NUM_PROCS", 1)
+    proc_id = _env_int("TPUML_PROC_ID", 0)
+    if num_procs < 1:
+        raise DistConfigError(f"TPUML_NUM_PROCS={num_procs} must be >= 1")
+    if proc_id < 0:
+        raise DistConfigError(f"TPUML_PROC_ID={proc_id} must be >= 0")
+    if proc_id >= num_procs:
+        raise DistConfigError(
+            f"TPUML_PROC_ID={proc_id} must be < TPUML_NUM_PROCS={num_procs}"
+        )
+    return num_procs, proc_id
+
+
 def distributed_env_configured() -> bool:
     """True when the launcher provided multi-process rendezvous info."""
     return (
         bool(os.environ.get("TPUML_COORDINATOR"))
-        and int(os.environ.get("TPUML_NUM_PROCS", "1")) > 1
+        and _validated_env_topology()[0] > 1
     )
 
 
@@ -81,10 +120,14 @@ class TpuDistContext:
         process_id: Optional[int] = None,
     ):
         self.coordinator = coordinator or os.environ.get("TPUML_COORDINATOR")
-        self.num_processes = num_processes or int(os.environ.get("TPUML_NUM_PROCS", "1"))
-        self.process_id = process_id if process_id is not None else int(
-            os.environ.get("TPUML_PROC_ID", "0")
-        )
+        env_procs, env_pid = _validated_env_topology()
+        self.num_processes = num_processes or env_procs
+        self.process_id = process_id if process_id is not None else env_pid
+        if not (0 <= self.process_id < self.num_processes):
+            raise DistConfigError(
+                f"process_id={self.process_id} must be in "
+                f"[0, num_processes={self.num_processes})"
+            )
         self._initialized_here = False
 
     @property
@@ -106,11 +149,18 @@ class TpuDistContext:
                 "jax.distributed.initialize(coordinator=%s, nprocs=%d, pid=%d)",
                 self.coordinator, self.num_processes, self.process_id,
             )
-            jax.distributed.initialize(
-                coordinator_address=self.coordinator,
-                num_processes=self.num_processes,
-                process_id=self.process_id,
-            )
+            # The common multi-host launch race is rank 0's coordinator not
+            # listening yet when rank N boots; retry with backoff so a pod
+            # slice survives staggered container starts (TPUML_RETRIES).
+            def _connect() -> None:
+                fault_site("init:connect")
+                jax.distributed.initialize(
+                    coordinator_address=self.coordinator,
+                    num_processes=self.num_processes,
+                    process_id=self.process_id,
+                )
+
+            with_retries(_connect, what="jax.distributed.initialize")
             self._initialized_here = True
             _process_initialized = True
         return self

@@ -1,0 +1,52 @@
+"""Resilience runtime: checkpoint/resume, bounded retries, fault injection.
+
+See ``docs/fault_tolerance.md`` for the operator-facing contract. All
+pieces are env-gated and fully inert by default:
+
+- ``TPUML_CKPT_DIR`` / ``TPUML_CKPT_EVERY`` — :class:`FitCheckpointer`
+- ``TPUML_RETRIES`` / ``TPUML_BACKOFF_MS``  — :func:`with_retries`
+- ``TPUML_FAULT_SPEC``                      — :func:`fault_site` hooks
+"""
+
+from . import counters
+from .checkpoint import CKPT_VERSION, FitCheckpointer, array_digest, params_hash
+from .faults import (
+    FaultInjector,
+    FaultSpecError,
+    InjectedFault,
+    InjectedResourceExhausted,
+    SimulatedPreemption,
+    fault_site,
+    fault_sites_active,
+    parse_fault_spec,
+    reset_faults,
+)
+from .retry import (
+    backoff_schedule,
+    is_resource_exhausted,
+    resolve_backoff_ms,
+    resolve_retries,
+    with_retries,
+)
+
+__all__ = [
+    "CKPT_VERSION",
+    "FitCheckpointer",
+    "array_digest",
+    "params_hash",
+    "FaultInjector",
+    "FaultSpecError",
+    "InjectedFault",
+    "InjectedResourceExhausted",
+    "SimulatedPreemption",
+    "fault_site",
+    "fault_sites_active",
+    "parse_fault_spec",
+    "reset_faults",
+    "backoff_schedule",
+    "is_resource_exhausted",
+    "resolve_backoff_ms",
+    "resolve_retries",
+    "with_retries",
+    "counters",
+]

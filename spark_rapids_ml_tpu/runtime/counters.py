@@ -1,0 +1,75 @@
+"""Process-wide resilience counters.
+
+The resilience runtime (checkpoint/retry/fault-injection) reports what it
+did through a tiny thread-safe counter registry instead of logs-only, so
+bench.py can attach ``retries`` / ``resumed_from`` columns to every entry
+and tests can assert the clean path is fully inert (all deltas zero).
+
+Counter names in use:
+
+- ``retries``         — attempts beyond the first made by ``with_retries``.
+- ``chunk_halvings``  — chunk splits performed after RESOURCE_EXHAUSTED
+                        staging failures (``ops/streaming.py``).
+- ``resumed_fits``    — fits that restored optimizer state from a
+                        checkpoint instead of starting at iteration 0.
+- ``resumed_from``    — gauge: iteration/epoch the most recent resume
+                        continued from (0 when nothing resumed).
+- ``cv_failed_fits``  — param combos recorded as worst-metric by the
+                        CrossValidator tolerant mode (``TPUML_CV_FAILFAST=0``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+
+
+def bump(name: str, by: int = 1) -> None:
+    """Increment counter ``name`` by ``by`` (creates it at 0)."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + int(by)
+
+
+def note(name: str, value: int) -> None:
+    """Set gauge ``name`` to ``value`` (last-write-wins semantics)."""
+    with _lock:
+        _counters[name] = int(value)
+
+
+def get(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def snapshot() -> Dict[str, int]:
+    """A point-in-time copy of every counter."""
+    with _lock:
+        return dict(_counters)
+
+
+def delta_since(base: Dict[str, int]) -> Dict[str, int]:
+    """Counter changes since ``base`` (a prior :func:`snapshot`).
+
+    Gauges (``resumed_from``) are reported as their current value when it
+    changed; plain counters as the difference. Keys with zero delta are
+    omitted so the clean path reports ``{}``.
+    """
+    cur = snapshot()
+    out: Dict[str, int] = {}
+    for name, value in cur.items():
+        d = value - base.get(name, 0)
+        if name == "resumed_from":
+            if value != base.get(name, 0):
+                out[name] = value
+        elif d:
+            out[name] = d
+    return out
+
+
+def reset() -> None:
+    """Zero every counter (test isolation)."""
+    with _lock:
+        _counters.clear()

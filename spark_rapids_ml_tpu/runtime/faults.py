@@ -1,0 +1,175 @@
+"""Deterministic env-driven fault injection.
+
+``TPUML_FAULT_SPEC`` is a comma-separated list of entries
+
+    scope:point:index:action
+
+where ``scope:point`` names an instrumented site (``ingest:chunk``,
+``sgd:epoch``, ``init:connect``), ``index`` is the 0-based hit count at
+that site on which the fault fires, and ``action`` is one of
+
+- ``raise``   — raise :class:`InjectedFault` (a generic hard error),
+- ``preempt`` — raise :class:`SimulatedPreemption` (terminal: the retry
+                wrapper never swallows it, modeling a pod preemption that
+                kills the process; recovery is refit-from-checkpoint),
+- ``oom``     — raise :class:`InjectedResourceExhausted` (its message
+                contains ``RESOURCE_EXHAUSTED`` so it takes the staging
+                chunk-halving path).
+
+Each entry fires exactly once: after firing it is spent, so an in-process
+retry or refit sails past the site. Hit counters are per-site and
+monotonically increase for the life of the injector; :func:`reset_faults`
+rebuilds the injector (tests call it between scenarios).
+
+With ``TPUML_FAULT_SPEC`` unset every hook is a no-op costing one dict
+lookup — the production path stays inert.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+SITES = ("ingest:chunk", "sgd:epoch", "init:connect")
+ACTIONS = ("raise", "preempt", "oom")
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``TPUML_FAULT_SPEC`` value."""
+
+
+class InjectedFault(RuntimeError):
+    """Generic injected failure (``raise`` action)."""
+
+
+class SimulatedPreemption(RuntimeError):
+    """Injected preemption (``preempt`` action).
+
+    Terminal by contract: ``with_retries`` re-raises it without retrying,
+    the same way a real preemption is not survivable in-process.
+    """
+
+
+class InjectedResourceExhausted(RuntimeError):
+    """Injected allocator failure (``oom`` action).
+
+    The message embeds ``RESOURCE_EXHAUSTED`` so
+    :func:`spark_rapids_ml_tpu.runtime.retry.is_resource_exhausted`
+    classifies it exactly like a real XLA staging OOM.
+    """
+
+    def __init__(self, site: str) -> None:
+        super().__init__(f"RESOURCE_EXHAUSTED: injected at {site}")
+
+
+def parse_fault_spec(spec: str) -> List[Tuple[str, int, str]]:
+    """Parse ``TPUML_FAULT_SPEC`` into ``[(site, index, action), ...]``."""
+    entries: List[Tuple[str, int, str]] = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) != 4:
+            raise FaultSpecError(
+                f"TPUML_FAULT_SPEC entry {raw!r} is not scope:point:index:action"
+            )
+        scope, point, idx_s, action = (p.strip() for p in parts)
+        site = f"{scope}:{point}"
+        if site not in SITES:
+            raise FaultSpecError(
+                f"TPUML_FAULT_SPEC entry {raw!r}: unknown site {site!r} "
+                f"(expected one of {', '.join(SITES)})"
+            )
+        if action not in ACTIONS:
+            raise FaultSpecError(
+                f"TPUML_FAULT_SPEC entry {raw!r}: unknown action {action!r} "
+                f"(expected one of {', '.join(ACTIONS)})"
+            )
+        try:
+            idx = int(idx_s)
+        except ValueError:
+            raise FaultSpecError(
+                f"TPUML_FAULT_SPEC entry {raw!r}: index {idx_s!r} is not an integer"
+            ) from None
+        if idx < 0:
+            raise FaultSpecError(
+                f"TPUML_FAULT_SPEC entry {raw!r}: index must be >= 0"
+            )
+        entries.append((site, idx, action))
+    return entries
+
+
+class FaultInjector:
+    """Deterministic chaos hooks driven by a parsed fault spec."""
+
+    def __init__(self, spec: str) -> None:
+        self.spec = spec
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        # site -> {index: action}; later entries for the same (site, index)
+        # win, matching "last setting wins" env semantics.
+        self._pending: Dict[str, Dict[int, str]] = {}
+        for site, idx, action in parse_fault_spec(spec):
+            self._pending.setdefault(site, {})[idx] = action
+
+    def active_sites(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(s for s, m in self._pending.items() if m)
+
+    def hit(self, site: str) -> None:
+        """Record one pass through ``site``; raise if a fault is due."""
+        with self._lock:
+            n = self._hits.get(site, 0)
+            self._hits[site] = n + 1
+            action = self._pending.get(site, {}).pop(n, None)
+        if action is None:
+            return
+        if action == "preempt":
+            raise SimulatedPreemption(f"injected preemption at {site}[{n}]")
+        if action == "oom":
+            raise InjectedResourceExhausted(f"{site}[{n}]")
+        raise InjectedFault(f"injected fault at {site}[{n}]")
+
+
+_cache_lock = threading.Lock()
+_cached: Optional[Tuple[str, Optional[FaultInjector]]] = None
+
+
+def _injector() -> Optional[FaultInjector]:
+    global _cached
+    spec = os.environ.get("TPUML_FAULT_SPEC", "")
+    with _cache_lock:
+        if _cached is not None and _cached[0] == spec:
+            return _cached[1]
+        inj = FaultInjector(spec) if spec else None
+        _cached = (spec, inj)
+        return inj
+
+
+def fault_site(site: str) -> None:
+    """Instrumentation hook: call at every pass through ``site``.
+
+    No-op (one env read + cache hit) unless ``TPUML_FAULT_SPEC`` names a
+    pending fault for this site at the current hit index.
+    """
+    inj = _injector()
+    if inj is not None:
+        inj.hit(site)
+
+
+def fault_sites_active(*sites: str) -> bool:
+    """True when any of ``sites`` still has an unfired fault entry."""
+    inj = _injector()
+    if inj is None:
+        return False
+    active = inj.active_sites()
+    return any(s in active for s in sites)
+
+
+def reset_faults() -> None:
+    """Forget fired entries and hit counts (rebuilds from current env)."""
+    global _cached
+    with _cache_lock:
+        _cached = None

@@ -1,0 +1,136 @@
+"""Bounded exponential-backoff retries for transient infrastructure faults.
+
+``with_retries`` wraps the two launch-time races the reference absorbed via
+Spark's barrier-stage rescheduling: the multi-host rendezvous
+(``jax.distributed.initialize`` when the coordinator is not up yet) and
+device staging of a streamed chunk. The budget comes from env so the
+launcher — not the algorithm code — decides how patient a fit is:
+
+- ``TPUML_RETRIES``    — extra attempts after the first (default 0: a
+                         single attempt, no sleeps, fully inert).
+- ``TPUML_BACKOFF_MS`` — base delay of the exponential schedule
+                         (default 100; delay for attempt *a* is
+                         ``min(base * 2**a, 30s)`` with 50-100% jitter).
+
+:class:`~spark_rapids_ml_tpu.runtime.faults.SimulatedPreemption` is
+terminal by contract and is never retried — preemption is survived by
+refit-from-checkpoint, not by waiting.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import time
+from typing import Callable, List, Optional, Tuple, Type, TypeVar
+
+from .counters import bump
+from .faults import SimulatedPreemption
+
+logger = logging.getLogger("spark_rapids_ml_tpu.runtime.retry")
+
+_T = TypeVar("_T")
+
+_BACKOFF_CAP_MS = 30_000.0
+
+
+def resolve_retries() -> int:
+    """``TPUML_RETRIES`` as a non-negative int (default 0 = inert)."""
+    raw = os.environ.get("TPUML_RETRIES", "0")
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"TPUML_RETRIES={raw!r} is not an integer"
+        ) from None
+    if n < 0:
+        raise ValueError(f"TPUML_RETRIES={raw!r} must be >= 0")
+    return n
+
+
+def resolve_backoff_ms() -> float:
+    """``TPUML_BACKOFF_MS`` as a positive float (default 100)."""
+    raw = os.environ.get("TPUML_BACKOFF_MS", "100")
+    try:
+        ms = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"TPUML_BACKOFF_MS={raw!r} is not a number"
+        ) from None
+    if ms <= 0:
+        raise ValueError(f"TPUML_BACKOFF_MS={raw!r} must be > 0")
+    return ms
+
+
+def backoff_schedule(
+    retries: int,
+    backoff_ms: float,
+    *,
+    cap_ms: float = _BACKOFF_CAP_MS,
+    seed: int = 0,
+) -> List[float]:
+    """Delays (ms) before each retry: capped exponential with jitter.
+
+    Attempt *a* (0-based) sleeps ``min(backoff_ms * 2**a, cap_ms)`` scaled
+    by a uniform factor in [0.5, 1.0) — "equal jitter", so delays never
+    collapse to zero but concurrent workers still decorrelate. Seeded so
+    the schedule (and therefore every resilience test) is deterministic.
+    """
+    rng = random.Random(seed)
+    out: List[float] = []
+    for a in range(retries):
+        base = min(backoff_ms * (2.0**a), cap_ms)
+        out.append(base * (0.5 + 0.5 * rng.random()))
+    return out
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """True for allocator-pressure failures (XLA spells it in the message)."""
+    return "RESOURCE_EXHAUSTED" in str(exc)
+
+
+def with_retries(
+    fn: Callable[[], _T],
+    *,
+    what: str,
+    retries: Optional[int] = None,
+    backoff_ms: Optional[float] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    seed: int = 0,
+    sleep: Callable[[float], None] = time.sleep,
+) -> _T:
+    """Call ``fn`` with up to ``retries`` re-attempts on transient failure.
+
+    With the default env (``TPUML_RETRIES`` unset/0) this is exactly one
+    ``fn()`` call — no sleeps, no counter traffic, no behavior change.
+    """
+    budget = resolve_retries() if retries is None else retries
+    if budget <= 0:
+        return fn()
+    delays = backoff_schedule(
+        budget, resolve_backoff_ms() if backoff_ms is None else backoff_ms, seed=seed
+    )
+    last: Optional[BaseException] = None
+    for attempt in range(budget + 1):
+        try:
+            return fn()
+        except SimulatedPreemption:
+            raise  # terminal by contract: survived via checkpoint, not retry
+        except retry_on as exc:
+            last = exc
+            if attempt >= budget:
+                break
+            bump("retries")
+            delay = delays[attempt]
+            logger.warning(
+                "%s failed (attempt %d/%d): %s — retrying in %.0f ms",
+                what,
+                attempt + 1,
+                budget + 1,
+                exc,
+                delay,
+            )
+            sleep(delay / 1000.0)
+    assert last is not None
+    raise last

@@ -15,6 +15,7 @@ folds run on a thread pool (reference ``tuning.py:106-129``).
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 from multiprocessing.pool import ThreadPool
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -25,7 +26,17 @@ from .core import _TpuEstimator, _TpuModel
 from .data.dataframe import DataFrame, kfold
 from .evaluation import Evaluator
 from .params import Param, Params, TypeConverters, _mk
+from .runtime import counters as _res_counters
 from .utils.logging import get_logger
+
+
+def _cv_failfast() -> bool:
+    """``TPUML_CV_FAILFAST`` (default 1 = reference semantics: any failed
+    fold/param fit aborts the grid search). ``0`` records the failed combo
+    as worst-metric and keeps searching — graceful degradation for long
+    grids where one pathological combo (divergent solver, OOM) should not
+    discard every other result."""
+    return os.environ.get("TPUML_CV_FAILFAST", "1") != "0"
 
 # Serializes per-fold device work under parallel CV (see run_fold in
 # CrossValidator.fit): concurrent first-compiles of one jitted fit from
@@ -159,6 +170,11 @@ class CrossValidator(_CrossValidatorParams):
         folds = kfold(dataset, n_folds, self.getSeed())
         collect_sub = bool(self.getOrDefault("collectSubModels"))
 
+        failfast = _cv_failfast()
+        # tolerant mode sentinel: a failed combo can never win the argmax/
+        # argmin (and is visibly ±inf in avgMetrics)
+        worst = -np.inf if eva.isLargerBetter() else np.inf
+
         def run_fold(i: int) -> Tuple[np.ndarray, Optional[List[_TpuModel]]]:
             # Device work is serialized across fold threads: jax 0.4.x can
             # deadlock (futex wedge inside the dispatch lock) when several
@@ -168,18 +184,41 @@ class CrossValidator(_CrossValidatorParams):
             with _FOLD_DEVICE_LOCK:
                 train, validation = folds[i]
                 if single_pass:
-                    # ONE barrier-pass fit of all maps + ONE evaluate pass
-                    models = [m for _, m in est.fitMultiple(train, epm)]
-                    combined = type(models[0])._combine(models)
-                    vals = combined._transformEvaluate(validation, eva)
-                    return (
-                        np.asarray(vals, dtype=np.float64),
-                        models if collect_sub else None,
-                    )
+                    try:
+                        # ONE barrier-pass fit of all maps + ONE evaluate pass
+                        models = [m for _, m in est.fitMultiple(train, epm)]
+                        combined = type(models[0])._combine(models)
+                        vals = combined._transformEvaluate(validation, eva)
+                        return (
+                            np.asarray(vals, dtype=np.float64),
+                            models if collect_sub else None,
+                        )
+                    except Exception:
+                        if failfast:
+                            raise
+                        # the single-pass fit is all-or-nothing; fall through
+                        # to the per-param-map loop so only the offending
+                        # combos are recorded as failed
+                        self.logger.exception(
+                            "fold %d: single-pass fit failed; retrying "
+                            "per-param-map (TPUML_CV_FAILFAST=0)", i
+                        )
                 vals, models = [], []
-                for pm in epm:
-                    model = est.fit(train, pm)
-                    vals.append(eva.evaluate(model.transform(validation)))
+                for j, pm in enumerate(epm):
+                    try:
+                        model = est.fit(train, pm)
+                        vals.append(eva.evaluate(model.transform(validation)))
+                    except Exception:
+                        if failfast:
+                            raise
+                        self.logger.exception(
+                            "fold %d param map %d: fit/evaluate failed; "
+                            "recording worst metric (TPUML_CV_FAILFAST=0)",
+                            i, j,
+                        )
+                        _res_counters.bump("cv_failed_fits")
+                        vals.append(worst)
+                        model = None
                     if collect_sub:
                         models.append(model)
                 return (
@@ -198,6 +237,12 @@ class CrossValidator(_CrossValidatorParams):
 
         avg = np.mean(np.stack(metrics_per_fold), axis=0)
         best_idx = int(np.argmax(avg) if eva.isLargerBetter() else np.argmin(avg))
+        if not np.isfinite(avg[best_idx]):
+            raise RuntimeError(
+                "CrossValidator: every param map failed in tolerant mode "
+                "(TPUML_CV_FAILFAST=0) — no finite metric to select a best "
+                "model from"
+            )
         self.logger.info(
             "CrossValidator: best param map %d with avg metric %.6f",
             best_idx,

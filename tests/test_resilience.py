@@ -1,0 +1,627 @@
+"""Fault-tolerant fit runtime tests (``spark_rapids_ml_tpu/runtime/``).
+
+The acceptance contract: an injected mid-fit fault (``TPUML_FAULT_SPEC``)
+followed by a refit with ``TPUML_CKPT_DIR`` set produces a final model
+same-seed-equivalent to the uninterrupted fit — for KMeans (streamed
+Lloyd), LogisticRegression (host L-BFGS), and UMAP (segmented epoch
+loop) — and with no resilience env set the whole runtime is inert
+(no files, zero counters, unchanged fit path).
+"""
+
+import os
+import traceback
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.runtime import counters
+from spark_rapids_ml_tpu.runtime.checkpoint import (
+    FitCheckpointer,
+    array_digest,
+    params_hash,
+)
+from spark_rapids_ml_tpu.runtime.faults import (
+    FaultInjector,
+    FaultSpecError,
+    InjectedFault,
+    InjectedResourceExhausted,
+    SimulatedPreemption,
+    fault_site,
+    fault_sites_active,
+    parse_fault_spec,
+    reset_faults,
+)
+from spark_rapids_ml_tpu.runtime.retry import (
+    backoff_schedule,
+    is_resource_exhausted,
+    with_retries,
+)
+
+_RES_ENV = (
+    "TPUML_CKPT_DIR",
+    "TPUML_CKPT_EVERY",
+    "TPUML_RETRIES",
+    "TPUML_BACKOFF_MS",
+    "TPUML_FAULT_SPEC",
+    "TPUML_CV_FAILFAST",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state(monkeypatch):
+    for var in _RES_ENV:
+        monkeypatch.delenv(var, raising=False)
+    reset_faults()
+    counters.reset()
+    yield
+    reset_faults()
+    counters.reset()
+
+
+# ---------------------------------------------------------------------------
+# fault-spec grammar + injector semantics
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parses_full_grammar():
+    entries = parse_fault_spec(
+        "ingest:chunk:3:raise, sgd:epoch:5:preempt,init:connect:2:oom"
+    )
+    assert entries == [
+        ("ingest:chunk", 3, "raise"),
+        ("sgd:epoch", 5, "preempt"),
+        ("init:connect", 2, "oom"),
+    ]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "sgd:epoch:raise",            # missing index
+        "bogus:site:0:raise",         # unknown site
+        "sgd:epoch:0:explode",        # unknown action
+        "sgd:epoch:x:raise",          # non-integer index
+        "sgd:epoch:-1:raise",         # negative index
+    ],
+)
+def test_fault_spec_rejects_malformed(bad):
+    with pytest.raises(FaultSpecError):
+        parse_fault_spec(bad)
+
+
+def test_injector_fires_exactly_once_at_index():
+    inj = FaultInjector("sgd:epoch:2:raise")
+    inj.hit("sgd:epoch")
+    inj.hit("sgd:epoch")
+    with pytest.raises(InjectedFault):
+        inj.hit("sgd:epoch")
+    # spent: subsequent passes (the resumed fit) sail through
+    for _ in range(10):
+        inj.hit("sgd:epoch")
+
+
+def test_injector_actions_map_to_exception_types():
+    inj = FaultInjector("ingest:chunk:0:oom,init:connect:0:preempt")
+    with pytest.raises(InjectedResourceExhausted) as ei:
+        inj.hit("ingest:chunk")
+    assert is_resource_exhausted(ei.value)
+    with pytest.raises(SimulatedPreemption):
+        inj.hit("init:connect")
+
+
+def test_fault_site_inert_without_env():
+    for _ in range(5):
+        fault_site("sgd:epoch")  # no env -> no-op
+    assert not fault_sites_active("sgd:epoch")
+
+
+def test_fault_site_env_driven(monkeypatch):
+    monkeypatch.setenv("TPUML_FAULT_SPEC", "sgd:epoch:1:raise")
+    reset_faults()
+    assert fault_sites_active("sgd:epoch")
+    fault_site("sgd:epoch")
+    with pytest.raises(InjectedFault):
+        fault_site("sgd:epoch")
+    assert not fault_sites_active("sgd:epoch")  # spent
+
+
+# ---------------------------------------------------------------------------
+# backoff schedule + with_retries
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_schedule_shape_and_jitter():
+    sched = backoff_schedule(6, 100.0, seed=3)
+    assert len(sched) == 6
+    for a, delay in enumerate(sched):
+        base = min(100.0 * 2**a, 30_000.0)
+        assert 0.5 * base <= delay < base  # equal jitter band
+    # deterministic for a given seed
+    assert sched == backoff_schedule(6, 100.0, seed=3)
+    assert sched != backoff_schedule(6, 100.0, seed=4)
+
+
+def test_backoff_schedule_caps_at_30s():
+    sched = backoff_schedule(12, 100.0, seed=0)
+    assert all(d < 30_000.0 for d in sched)
+    assert sched[-1] >= 15_000.0  # capped base, >= half after jitter
+
+
+def test_with_retries_inert_at_zero_budget():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise RuntimeError("always")
+
+    with pytest.raises(RuntimeError):
+        with_retries(fn, what="t", retries=0)
+    assert len(calls) == 1  # single attempt, no retry machinery
+    assert counters.get("retries") == 0
+
+
+def test_with_retries_recovers_and_counts():
+    sleeps = []
+    state = {"n": 0}
+
+    def fn():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise RuntimeError(f"transient {state['n']}")
+        return "ok"
+
+    out = with_retries(
+        fn, what="t", retries=5, backoff_ms=10.0, sleep=sleeps.append
+    )
+    assert out == "ok"
+    assert state["n"] == 3
+    assert len(sleeps) == 2
+    assert counters.get("retries") == 2
+
+
+def test_with_retries_exhausts_budget():
+    def fn():
+        raise RuntimeError("always")
+
+    with pytest.raises(RuntimeError, match="always"):
+        with_retries(fn, what="t", retries=2, backoff_ms=1.0, sleep=lambda s: None)
+    assert counters.get("retries") == 2
+
+
+def test_with_retries_never_retries_preemption():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise SimulatedPreemption("pod gone")
+
+    with pytest.raises(SimulatedPreemption):
+        with_retries(fn, what="t", retries=5, backoff_ms=1.0, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpointer unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip_and_clear(tmp_path):
+    ckpt = FitCheckpointer("algo", {"k": 3, "seed": 7}, str(tmp_path), every=1)
+    w = np.arange(6, dtype=np.float64).reshape(2, 3)
+    ckpt.save(4, {"w": w}, {"f": 1.5})
+    it, arrays, extra = ckpt.load()
+    assert it == 4
+    np.testing.assert_array_equal(arrays["w"], w)
+    assert extra["f"] == 1.5
+    ckpt.clear()
+    assert ckpt.load() is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_checkpoint_params_hash_mismatch_cold_starts(tmp_path):
+    FitCheckpointer("algo", {"k": 3}, str(tmp_path)).save(2, {"w": np.ones(2)})
+    assert FitCheckpointer("algo", {"k": 4}, str(tmp_path)).load() is None
+    assert FitCheckpointer("other", {"k": 3}, str(tmp_path)).load() is None
+    assert FitCheckpointer("algo", {"k": 3}, str(tmp_path)).load() is not None
+
+
+def test_checkpoint_corruption_cold_starts(tmp_path):
+    ckpt = FitCheckpointer("algo", {"k": 3}, str(tmp_path))
+    ckpt.save(1, {"w": np.ones(2)})
+    npz = [p for p in tmp_path.iterdir() if p.suffix == ".npz"]
+    npz[0].write_bytes(b"not an npz")
+    assert ckpt.load() is None  # never raises
+
+
+def test_checkpoint_maybe_save_cadence(tmp_path):
+    ckpt = FitCheckpointer("algo", {}, str(tmp_path), every=3)
+    for it in range(1, 7):
+        ckpt.maybe_save(it, {"w": np.full(2, it)})
+        expected = (it // 3) * 3
+        if expected:
+            assert ckpt.load()[0] == expected
+        else:
+            assert ckpt.load() is None
+
+
+def test_checkpoint_disabled_is_noop(tmp_path):
+    ckpt = FitCheckpointer.from_env("algo", {"k": 1})  # no TPUML_CKPT_DIR
+    assert not ckpt.enabled
+    ckpt.save(1, {"w": np.ones(2)})
+    assert ckpt.load() is None
+    ckpt.clear()
+
+
+def test_params_hash_covers_array_digests():
+    a = np.arange(8, dtype=np.float32)
+    h1 = params_hash({"x": array_digest(a)})
+    h2 = params_hash({"x": array_digest(a + 1)})
+    assert h1 != h2
+    assert array_digest(a) == array_digest(a.copy())
+
+
+# ---------------------------------------------------------------------------
+# chunk halving
+# ---------------------------------------------------------------------------
+
+
+def test_split_chunk_preserves_rows_and_validity():
+    from spark_rapids_ml_tpu.data.chunks import Chunk
+    from spark_rapids_ml_tpu.ops.streaming import _split_chunk
+
+    X = np.arange(64 * 3, dtype=np.float32).reshape(64, 3)
+    y = np.arange(64, dtype=np.float32)
+    c = Chunk(X=X, n_valid=40, y=y)
+    a, b = _split_chunk(c, row_mult=8)
+    assert a.X.shape[0] % 8 == 0 and b.X.shape[0] % 8 == 0
+    assert a.X.shape[0] + b.X.shape[0] == 64
+    assert a.n_valid + b.n_valid == 40
+    np.testing.assert_array_equal(np.concatenate([a.X, b.X]), X)
+    np.testing.assert_array_equal(np.concatenate([a.y, b.y]), y)
+    # unsplittable: below 2x the row multiple
+    assert _split_chunk(Chunk(X=X[:8], n_valid=8), row_mult=8) is None
+
+
+def test_streamed_fit_survives_injected_oom_by_halving(monkeypatch, rng):
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    X = rng.normal(size=(256, 5)).astype(np.float64)
+    X[:64] += 4.0
+    X[64:128] -= 4.0
+    df = DataFrame({"features": X})
+
+    def fit():
+        return KMeans(
+            k=4, maxIter=6, tol=1e-8, seed=5, num_workers=4,
+            streaming=True, stream_chunk_rows=64,
+        ).setFeaturesCol("features").fit(df)
+
+    clean = fit()
+
+    monkeypatch.setenv("TPUML_RETRIES", "2")
+    monkeypatch.setenv("TPUML_BACKOFF_MS", "1")
+    monkeypatch.setenv("TPUML_FAULT_SPEC", "ingest:chunk:1:oom")
+    reset_faults()
+    base = counters.snapshot()
+    degraded = fit()
+    delta = counters.delta_since(base)
+    assert delta.get("chunk_halvings", 0) >= 1
+    # a split chunk folds into the same sums (up to fp reassociation)
+    np.testing.assert_allclose(
+        degraded.cluster_centers_, clean.cluster_centers_, rtol=1e-4, atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# prefetch exception propagation
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_propagates_worker_traceback():
+    from spark_rapids_ml_tpu.ops.streaming import prefetch_chunks
+
+    def bad_source():
+        yield "c0"
+        raise ValueError("boom-in-producer")
+
+    with pytest.raises(ValueError, match="boom-in-producer") as ei:
+        list(prefetch_chunks(bad_source(), depth=2))
+    frames = "".join(traceback.format_tb(ei.value.__traceback__))
+    assert "bad_source" in frames  # original producer frame, not a rewrap
+
+
+# ---------------------------------------------------------------------------
+# distributed bootstrap config validation + retry
+# ---------------------------------------------------------------------------
+
+
+def test_dist_env_validation(monkeypatch):
+    from spark_rapids_ml_tpu.parallel.context import (
+        DistConfigError,
+        TpuDistContext,
+        distributed_env_configured,
+    )
+
+    monkeypatch.setenv("TPUML_COORDINATOR", "127.0.0.1:9999")
+    monkeypatch.setenv("TPUML_NUM_PROCS", "abc")
+    with pytest.raises(DistConfigError, match="TPUML_NUM_PROCS"):
+        distributed_env_configured()
+
+    monkeypatch.setenv("TPUML_NUM_PROCS", "2")
+    monkeypatch.setenv("TPUML_PROC_ID", "2")
+    with pytest.raises(DistConfigError, match="TPUML_PROC_ID"):
+        TpuDistContext()
+
+    monkeypatch.setenv("TPUML_NUM_PROCS", "0")
+    monkeypatch.delenv("TPUML_PROC_ID")
+    with pytest.raises(DistConfigError, match="must be >= 1"):
+        TpuDistContext()
+
+    with pytest.raises(DistConfigError):
+        TpuDistContext(
+            coordinator="127.0.0.1:9999", num_processes=2, process_id=3
+        )
+
+
+def test_dist_bootstrap_retries_connect_faults(monkeypatch):
+    import spark_rapids_ml_tpu.parallel.context as ctx
+
+    monkeypatch.setenv("TPUML_COORDINATOR", "127.0.0.1:9999")
+    monkeypatch.setenv("TPUML_NUM_PROCS", "2")
+    monkeypatch.setenv("TPUML_PROC_ID", "0")
+    monkeypatch.setenv("TPUML_RETRIES", "3")
+    monkeypatch.setenv("TPUML_BACKOFF_MS", "1")
+    # first two connect attempts die; the third must succeed
+    monkeypatch.setenv(
+        "TPUML_FAULT_SPEC", "init:connect:0:raise,init:connect:1:raise"
+    )
+    reset_faults()
+
+    connects = []
+    monkeypatch.setattr(
+        ctx.jax.distributed, "initialize", lambda **kw: connects.append(kw)
+    )
+    monkeypatch.setattr(ctx, "_process_initialized", False)
+    c = ctx.TpuDistContext()
+    c.__enter__()
+    assert len(connects) == 1  # the successful (third) attempt reached jax
+    assert counters.get("retries") == 2
+    monkeypatch.setattr(ctx, "_process_initialized", False)
+
+
+# ---------------------------------------------------------------------------
+# interrupted-then-resumed == uninterrupted (the tentpole contract)
+# ---------------------------------------------------------------------------
+
+
+def _ckpt_files(d):
+    return sorted(os.listdir(d))
+
+
+def test_kmeans_preempt_resume_same_seed_equivalent(monkeypatch, tmp_path, rng):
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    X = rng.normal(size=(256, 5)).astype(np.float64)
+    X[:64] += 4.0
+    X[64:128] -= 4.0
+    df = DataFrame({"features": X})
+
+    def fit():
+        return KMeans(
+            k=4, maxIter=8, tol=1e-12, seed=5, num_workers=4,
+            streaming=True, stream_chunk_rows=64,
+        ).setFeaturesCol("features").fit(df)
+
+    clean = fit()
+
+    monkeypatch.setenv("TPUML_CKPT_DIR", str(tmp_path))
+    monkeypatch.setenv("TPUML_CKPT_EVERY", "1")
+    monkeypatch.setenv("TPUML_FAULT_SPEC", "sgd:epoch:2:preempt")
+    reset_faults()
+    with pytest.raises(SimulatedPreemption):
+        fit()
+    assert _ckpt_files(tmp_path)  # snapshot committed before the fault
+
+    monkeypatch.delenv("TPUML_FAULT_SPEC")
+    reset_faults()
+    base = counters.snapshot()
+    resumed = fit()
+    delta = counters.delta_since(base)
+    assert delta.get("resumed_fits") == 1
+    assert delta.get("resumed_from") == 2
+    assert resumed._resilience_report.get("resumed_fits") == 1
+    np.testing.assert_allclose(
+        resumed.cluster_centers_, clean.cluster_centers_, rtol=0, atol=1e-12
+    )
+    assert resumed.trainingCost == pytest.approx(clean.trainingCost, rel=1e-12)
+    assert _ckpt_files(tmp_path) == []  # cleared on success
+
+
+def test_logreg_preempt_resume_same_seed_equivalent(monkeypatch, tmp_path, rng):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    X = rng.normal(size=(200, 4)).astype(np.float64)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    df = DataFrame({"features": X, "label": y})
+
+    def fit():
+        return LogisticRegression(
+            maxIter=15, regParam=0.01, tol=1e-12, num_workers=4,
+            streaming=True, stream_chunk_rows=64,
+        ).setFeaturesCol("features").fit(df)
+
+    clean = fit()
+
+    monkeypatch.setenv("TPUML_CKPT_DIR", str(tmp_path))
+    monkeypatch.setenv("TPUML_CKPT_EVERY", "1")
+    monkeypatch.setenv("TPUML_FAULT_SPEC", "sgd:epoch:3:preempt")
+    reset_faults()
+    with pytest.raises(SimulatedPreemption):
+        fit()
+    assert _ckpt_files(tmp_path)
+
+    monkeypatch.delenv("TPUML_FAULT_SPEC")
+    reset_faults()
+    base = counters.snapshot()
+    resumed = fit()
+    delta = counters.delta_since(base)
+    assert delta.get("resumed_fits") == 1
+    assert delta.get("resumed_from") == 3
+    # the restored f64 carry (w/f/g/S/Y) makes the resumed walk identical
+    np.testing.assert_allclose(
+        resumed.coefficients, clean.coefficients, rtol=0, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        resumed.intercept, clean.intercept, rtol=0, atol=1e-12
+    )
+    assert _ckpt_files(tmp_path) == []
+
+
+def test_umap_preempt_resume_same_seed_equivalent(monkeypatch, tmp_path, rng):
+    from spark_rapids_ml_tpu.umap import UMAP
+
+    X = rng.normal(size=(60, 6)).astype(np.float32)
+    df = DataFrame({"features": X})
+
+    def fit():
+        return UMAP(
+            n_neighbors=8, random_state=3, init="random", n_epochs=20,
+            num_workers=1,
+        ).setFeaturesCol("features").fit(df)
+
+    clean = fit()
+
+    monkeypatch.setenv("TPUML_CKPT_DIR", str(tmp_path))
+    monkeypatch.setenv("TPUML_CKPT_EVERY", "5")
+    # segment boundaries are the fault sites: index 2 -> epoch 10
+    monkeypatch.setenv("TPUML_FAULT_SPEC", "sgd:epoch:2:preempt")
+    reset_faults()
+    with pytest.raises(SimulatedPreemption):
+        fit()
+    assert _ckpt_files(tmp_path)
+
+    monkeypatch.delenv("TPUML_FAULT_SPEC")
+    reset_faults()
+    base = counters.snapshot()
+    resumed = fit()
+    delta = counters.delta_since(base)
+    assert delta.get("resumed_fits") == 1
+    assert delta.get("resumed_from") == 10
+    # absolute-epoch RNG/alpha: segmented+resumed == single fused loop
+    np.testing.assert_allclose(
+        resumed.embedding_, clean.embedding_, rtol=1e-5, atol=1e-5
+    )
+    assert _ckpt_files(tmp_path) == []
+
+
+def test_umap_engine_segmented_epochs_match_fused(rng):
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.umap_kernels import optimize_embedding_rows
+
+    n, c, R, K = 32, 2, 32, 4
+    emb0 = jnp.asarray(rng.normal(size=(n, c)).astype(np.float32))
+    row_heads = jnp.asarray(np.sort(rng.integers(0, n, size=R)).astype(np.int32))
+    tails = jnp.asarray(rng.integers(0, n, size=(R, K)).astype(np.int32))
+    p = jnp.asarray(rng.uniform(0.2, 1.0, size=(R, K)).astype(np.float32))
+    key = jax.random.PRNGKey(11)
+    kwargs = dict(n_epochs=9, a=1.6, b=0.9, negative_sample_rate=3)
+
+    fused = optimize_embedding_rows(emb0, emb0, row_heads, tails, p, key, **kwargs)
+    emb = emb0
+    for e0, span in ((0, 4), (4, 4), (8, 1)):
+        emb = optimize_embedding_rows(
+            emb, emb, row_heads, tails, p, key,
+            epoch_offset=e0, epoch_span=span, **kwargs,
+        )
+    np.testing.assert_allclose(
+        np.asarray(emb), np.asarray(fused), rtol=1e-6, atol=1e-6
+    )
+
+
+# ---------------------------------------------------------------------------
+# CrossValidator graceful degradation
+# ---------------------------------------------------------------------------
+
+
+def _cv_setup():
+    from spark_rapids_ml_tpu.evaluation import RegressionEvaluator
+    from spark_rapids_ml_tpu.regression import LinearRegression
+    from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    class FlakyLR(LinearRegression):
+        POISON = 12345.0
+
+        def _supportsTransformEvaluate(self, eva):
+            return False  # exercise the per-param-map loop
+
+        def fit(self, dataset, params=None):
+            if params and any(v == self.POISON for v in params.values()):
+                raise RuntimeError("injected fit failure (poison combo)")
+            return super().fit(dataset, params)
+
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(240, 5))
+    w = rng.normal(size=5)
+    y = X @ w + 0.1 * rng.normal(size=240)
+    df = DataFrame({"features": X, "label": y})
+    est = FlakyLR(float32_inputs=False).setFeaturesCol("features")
+    grid = (
+        ParamGridBuilder()
+        .addGrid(est.getParam("regParam"), [0.0, 0.01, FlakyLR.POISON])
+        .build()
+    )
+    cv = CrossValidator(
+        estimator=est,
+        estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(metricName="rmse"),
+        numFolds=3,
+        seed=1,
+    )
+    return cv, df
+
+
+def test_cv_default_is_failfast():
+    cv, df = _cv_setup()
+    with pytest.raises(RuntimeError, match="poison"):
+        cv.fit(df)
+
+
+def test_cv_tolerant_mode_records_worst_metric(monkeypatch):
+    monkeypatch.setenv("TPUML_CV_FAILFAST", "0")
+    cv, df = _cv_setup()
+    base = counters.snapshot()
+    model = cv.fit(df)
+    delta = counters.delta_since(base)
+    assert delta.get("cv_failed_fits") == 3  # poison combo x 3 folds
+    # rmse: smaller is better -> failed combo recorded as +inf, never wins
+    assert model.avgMetrics[2] == np.inf
+    assert np.isfinite(model.avgMetrics[0]) and np.isfinite(model.avgMetrics[1])
+    from spark_rapids_ml_tpu.evaluation import RegressionEvaluator
+
+    assert RegressionEvaluator(metricName="r2").evaluate(model.transform(df)) > 0.9
+
+
+# ---------------------------------------------------------------------------
+# inertness: no resilience env -> zero behavior change
+# ---------------------------------------------------------------------------
+
+
+def test_clean_path_is_fully_inert(rng):
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.runtime.retry import resolve_retries
+
+    assert resolve_retries() == 0
+    X = rng.normal(size=(192, 4)).astype(np.float64)
+    df = DataFrame({"features": X})
+    base = counters.snapshot()
+    model = (
+        KMeans(k=3, maxIter=5, seed=2, num_workers=4,
+               streaming=True, stream_chunk_rows=64)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    assert counters.delta_since(base) == {}
+    assert model._resilience_report == {}
