@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.runtime import envspec
 
 from .gen_data import make_dataframe
 
@@ -53,9 +54,9 @@ class BenchmarkBase:
         """(rank, nprocs) from the distributed-launcher env (the same
         TPUML_* contract parallel/context.py bootstraps from)."""
         try:
-            n = int(os.environ.get("TPUML_NUM_PROCS", "1"))
-            r = int(os.environ.get("TPUML_PROC_ID", "0"))
-        except ValueError:
+            n = int(envspec.get("TPUML_NUM_PROCS"))
+            r = int(envspec.get("TPUML_PROC_ID"))
+        except envspec.EnvSpecError:
             return 0, 1
         return (r, n) if n > 1 else (0, 1)
 

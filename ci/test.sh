@@ -1,14 +1,20 @@
 #!/usr/bin/env bash
 # CI entry point (the reference's ci/test.sh:20-57 runs lint+typecheck, the
-# pytest suite, then a benchmark smoke). Lint/typecheck steps run when the
-# tools are installed and are skipped (with a notice) otherwise — the
+# pytest suite, then a benchmark smoke). tpuml-lint (stdlib-only, see
+# docs/static_analysis.md) always runs; the third-party format/typecheck
+# tools run when installed and are skipped (with a notice) otherwise — the
 # framework environments are hermetic images where pip installs are not
 # always possible.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== static checks =="
-python -m compileall -q spark_rapids_ml_tpu benchmark tests bench.py benchmark_runner.py
+python -m compileall -q spark_rapids_ml_tpu benchmark tests tpuml_lint bench.py benchmark_runner.py
+# tpuml-lint is stdlib-only so (unlike the tools below) it always runs:
+# TPU/JAX invariants + env-var registry/doc drift. Rule catalog and
+# suppression syntax: docs/static_analysis.md.
+python -m tpuml_lint spark_rapids_ml_tpu benchmark tests scripts ci bench.py benchmark_runner.py
+python scripts/gen_config_docs.py --check
 if python -c "import black" 2>/dev/null; then
     python -m black --check spark_rapids_ml_tpu tests benchmark
 else
@@ -20,7 +26,7 @@ else
     echo "isort not installed; skipping import-order check"
 fi
 if python -c "import mypy" 2>/dev/null; then
-    python -m mypy spark_rapids_ml_tpu
+    python -m mypy spark_rapids_ml_tpu tpuml_lint
 else
     echo "mypy not installed; skipping typecheck"
 fi

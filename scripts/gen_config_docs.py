@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Regenerate the env-var table in docs/configuration.md from the
+typed registry (spark_rapids_ml_tpu/runtime/envspec.py).
+
+The table lives between the ``tpuml-envspec:begin/end`` markers; prose
+outside the markers (framework kwargs, the non-TPUML ``JAX_PLATFORMS``
+row, algorithm params) is never touched. ``tpuml_lint`` rule TPU002
+fails CI when the committed table drifts from the registry, so the
+workflow for a new knob is: register it in envspec.py, run this script,
+commit both.
+
+Usage:
+    python scripts/gen_config_docs.py           # rewrite in place
+    python scripts/gen_config_docs.py --check   # exit 1 if stale
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENVSPEC = os.path.join(REPO_ROOT, "spark_rapids_ml_tpu", "runtime", "envspec.py")
+DOC = os.path.join(REPO_ROOT, "docs", "configuration.md")
+
+
+def load_envspec():
+    # by-file-path import: envspec.py is stdlib-only by contract, so this
+    # works without jax (and without importing the package)
+    spec = importlib.util.spec_from_file_location("_gen_config_envspec", ENVSPEC)
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="verify the committed table is current; no writes")
+    args = ap.parse_args()
+
+    envspec = load_envspec()
+    expected = list(envspec.doc_table_lines())
+
+    with open(DOC, "r", encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    try:
+        b = lines.index(envspec.TABLE_BEGIN)
+        e = lines.index(envspec.TABLE_END)
+    except ValueError:
+        print(f"error: tpuml-envspec markers not found in {DOC}; restore "
+              f"the begin/end comment lines and re-run", file=sys.stderr)
+        return 2
+
+    current = lines[b : e + 1]
+    if current == expected:
+        print("docs/configuration.md env table is current "
+              f"({len(envspec.SPEC)} variables)")
+        return 0
+    if args.check:
+        print("docs/configuration.md env table is STALE — run "
+              "python scripts/gen_config_docs.py", file=sys.stderr)
+        return 1
+
+    out = lines[:b] + expected + lines[e + 1:]
+    with open(DOC, "w", encoding="utf-8") as f:
+        f.write("\n".join(out) + "\n")
+    print(f"rewrote env table in docs/configuration.md "
+          f"({len(envspec.SPEC)} variables)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,8 @@ SETUP = """\
 import os, sys, time
 sys.path.insert(0, os.path.abspath(os.path.join(os.getcwd(), "..")))
 import jax
+# raw read: must run before any spark_rapids_ml_tpu import so the CPU pin
+# lands before a backend touch  # tpuml: ignore[TPU001]
 if os.environ.get("TPUML_NB_CPU"):  # CI: run headless on CPU
     jax.config.update("jax_platforms", "cpu")
 import numpy as np

@@ -147,6 +147,8 @@ def main():
         min_samples_split=2, bootstrap=False)
     bins_reps = [jax.block_until_ready(jnp.asarray((np.asarray(bins)+(r+1)) % NB, jnp.uint8)) for r in range(3)]
     for knock in ["full", "nosort", "noglue", "nogather", "nokernel", "nosegsum"]:
+        # each knockout variant IS a distinct program; compiled once per
+        # variant and reused across the timed reps  # tpuml: ignore[TPU003]
         fn = jax.jit(lambda b, kn=knock: build_tree(
             b, stats, valid, jax.random.PRNGKey(1), cfg, kn))
         jax.block_until_ready(fn(bins))

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 
 from .data.dataframe import DataFrame, _is_sparse
 from .params import Params, _TpuParams, HasLabelCol, HasPredictionCol, HasWeightCol
+from .runtime import envspec
 from .parallel.mesh import (
     global_row_count,
     make_mesh,
@@ -162,8 +163,8 @@ def _default_stream_threshold_bytes() -> int:
     Overridable via ``TPUML_STREAM_THRESHOLD_BYTES``. Default: 60% of one
     device's reported memory (the design matrix must leave room for Gram
     temporaries), or 8 GiB when the backend doesn't report memory (CPU)."""
-    env = os.environ.get("TPUML_STREAM_THRESHOLD_BYTES")
-    if env:
+    env = envspec.get("TPUML_STREAM_THRESHOLD_BYTES")
+    if env is not None:
         return int(env)
     try:
         stats = jax.local_devices()[0].memory_stats()

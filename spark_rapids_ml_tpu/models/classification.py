@@ -19,8 +19,6 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional
 
-import os
-
 import numpy as np
 
 import jax
@@ -46,6 +44,7 @@ from ..params import (
     _mk,
 )
 from ..ops.logreg_kernels import logreg_fit, logreg_predict
+from ..runtime import envspec
 from ..utils.logging import get_logger
 
 
@@ -54,8 +53,7 @@ def _resolve_objective_dtype(params: Dict[str, Any]) -> str:
     unset; typos error rather than silently running f32)."""
     v = (
         params.get("objective_dtype")
-        or os.environ.get("TPUML_LOGREG_OBJECTIVE_DTYPE")
-        or "float32"
+        or envspec.get("TPUML_LOGREG_OBJECTIVE_DTYPE")
     )
     v = str(v)
     if v not in ("float32", "bfloat16"):

@@ -17,7 +17,6 @@ TPU-native fit (vs cuML's NCCL-allreduce Lloyd):
 
 from __future__ import annotations
 
-import os
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -39,6 +38,7 @@ from ..params import (
     _mk,
 )
 from ..ops.kmeans_kernels import count_closest, kmeans_lloyd, min_sq_dists
+from ..runtime import envspec
 
 _CHUNK = 4096
 
@@ -153,10 +153,11 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
     def _resolve_matmul_dtype(params):
         """Validated (early, before any seeding work) bf16-matmul option;
         returns a jnp dtype or None. Kwarg beats TPUML_KMEANS_MATMUL_DTYPE."""
-        # `or None`: empty-string env (a shell-default pattern) means unset
+        # registry read: empty-string env (a shell-default pattern) means
+        # unset, and a malformed env value names the variable in the error
         mm = (
             params.get("matmul_dtype")
-            or os.environ.get("TPUML_KMEANS_MATMUL_DTYPE")
+            or envspec.get("TPUML_KMEANS_MATMUL_DTYPE")
             or None
         )
         if mm is not None and str(mm) not in ("float32", "bfloat16"):
@@ -172,9 +173,7 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
         centers stay zero, distances/costs unchanged) and TPU tiles the
         minor dim to 128 physically anyway, so the padding is HBM-free.
         ``TPUML_LANE_PAD`` overrides (CI exercises the path on CPU)."""
-        import os
-
-        env = os.environ.get("TPUML_LANE_PAD")
+        env = envspec.get("TPUML_LANE_PAD")
         if env is not None:
             return int(env)
         import jax

@@ -21,7 +21,6 @@ trees across workers (``tree.py:256-267``), zero collectives during growth.
 from __future__ import annotations
 
 import math
-import os
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -56,6 +55,7 @@ from ..ops.tree_kernels import (
     rf_classify,
     rf_regress,
 )
+from ..runtime import envspec
 
 _MAX_SUPPORTED_DEPTH = 18  # full binary layout: 2^(d+1)-1 nodes per tree
 
@@ -378,18 +378,12 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
             # upgrade over the reference's partition-local trees), "local"
             # keeps the reference's exact per-worker semantics, "auto"
             # gathers when the gathered operands fit a memory budget
-            mode = os.environ.get("TPUML_RF_ROWS_PER_TREE", "auto")
-            if mode not in ("auto", "all", "local"):
-                raise ValueError(
-                    f"TPUML_RF_ROWS_PER_TREE must be auto|all|local, got {mode!r}"
-                )
+            mode = envspec.get("TPUML_RF_ROWS_PER_TREE")
             n_pad_global = bins.shape[0]
             gathered_bytes = n_pad_global * (
                 d_pad + n_stats * stats.dtype.itemsize + 4
             )
-            budget = float(
-                os.environ.get("TPUML_RF_GATHER_BUDGET_BYTES", 4e9)
-            )
+            budget = float(envspec.get("TPUML_RF_GATHER_BUDGET_BYTES"))
             gather = n_dp > 1 and (
                 mode == "all" or (mode == "auto" and gathered_bytes <= budget)
             )
@@ -489,12 +483,7 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
         descent (incl. CPU, for parity tests), =packed the packed-forest
         lockstep engine (falls back down the chain if its kernel cannot
         lower); auto prefers packed > bins > legacy on TPU."""
-        mode = os.environ.get("TPUML_RF_APPLY", "auto")
-        if mode not in ("auto", "legacy", "bins", "packed"):
-            raise ValueError(
-                f"TPUML_RF_APPLY must be auto|legacy|bins|packed, got {mode!r}"
-            )
-        return mode
+        return str(envspec.get("TPUML_RF_APPLY"))
 
     def _bins_apply_ready(self) -> bool:
         """True when transform can use the bin-space descents: the model
@@ -573,7 +562,7 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
         edges = jnp.asarray(np.asarray(self._model_attributes["bin_edges"]))
         d = edges.shape[0]
         d_pad = -(-d // 4) * 4  # word-packing alignment
-        if os.environ.get("TPUML_RF_CHECK_FINITE", "0") == "1":
+        if envspec.get("TPUML_RF_CHECK_FINITE"):
             # opt-in serving-boundary guard for the finite-input contract
             # (binize routes NaN to bin 0; see its docstring + the fit
             # boundary check) — a full host pass per batch, so off by

@@ -17,6 +17,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..runtime import envspec
+
 _REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
@@ -29,9 +31,9 @@ _ABI_VERSION = 2
 
 
 def _lib_path() -> str:
-    env = os.environ.get("TPUML_LIB")
+    env = envspec.get("TPUML_LIB")
     if env:
-        return env
+        return str(env)
     return os.path.join(_BUILD_DIR, "libtpuml.so")
 
 
@@ -67,9 +69,9 @@ def _candidate_blas_paths() -> list:
     first, then numpy's 64-bit-int build."""
     import glob
 
-    env = os.environ.get("TPUML_BLAS_LIB")
+    env = envspec.get("TPUML_BLAS_LIB")
     if env:
-        return [env]
+        return [str(env)]
     site = os.path.dirname(os.path.dirname(np.__file__))
     out = []
     for pkg in ("scipy", "numpy"):

@@ -48,12 +48,9 @@ def resolve_knn_topk() -> str:
     ``lax.top_k`` (no PartialReduce at all). Resolved by CALLERS outside
     jit and passed as a static arg — an env read inside the traced
     function would be silently ignored on jit cache hits."""
-    import os
+    from ..runtime import envspec
 
-    mode = os.environ.get("TPUML_KNN_TOPK", "auto")
-    if mode not in ("auto", "sort", "partial"):
-        raise ValueError(f"TPUML_KNN_TOPK must be auto|sort|partial, got {mode!r}")
-    return mode
+    return str(envspec.get("TPUML_KNN_TOPK"))
 
 
 def _tile_top_k(neg_d2: jax.Array, k: int, topk_impl: str):

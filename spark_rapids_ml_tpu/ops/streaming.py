@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from ..data.chunks import Chunk, ChunkSource
 from ..parallel.mesh import row_sharding
-from ..runtime import counters
+from ..runtime import counters, envspec
 from ..runtime.faults import SimulatedPreemption, fault_site
 from ..runtime.retry import (
     backoff_schedule,
@@ -50,9 +50,7 @@ _res_logger = get_logger("streaming.resilience")
 
 # host-side backpressure period for streaming loops (chunks between syncs);
 # 0 disables
-import os as _os
-
-_SYNC_EVERY = int(_os.environ.get("TPUML_STREAM_SYNC_EVERY", "4"))
+_SYNC_EVERY = int(envspec.get("TPUML_STREAM_SYNC_EVERY"))
 
 
 class StreamGuard:
@@ -139,13 +137,7 @@ def prefetch_chunks(it, depth: Optional[int] = None):
     full queue holding the source open.
     """
     if depth is None:
-        raw = _os.environ.get("TPUML_STREAM_PREFETCH", "2")
-        try:
-            depth = int(raw)
-        except ValueError:
-            raise ValueError(
-                f"TPUML_STREAM_PREFETCH must be an integer, got {raw!r}"
-            )
+        depth = int(envspec.get("TPUML_STREAM_PREFETCH"))
     if depth <= 0:
         yield from it
         return

@@ -36,6 +36,7 @@ from ._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DP_AXIS
+from ..runtime import envspec
 
 # elements per (F, nodes, bins, stats) histogram tile; bounds peak HBM of the
 # deepest level (tile is float32: 1<<22 elems = 16 MiB)
@@ -50,16 +51,14 @@ _HIST_BUDGET = 1 << 22
 # flops" — i.e. every level until n_nodes*n_bins ~ 2.5e5 — by up to two
 # orders of magnitude at shallow levels. Overridable for re-tuning on other
 # chip generations.
-import os as _os
-
-_SCATTER_EQ_FLOPS = float(_os.environ.get("TPUML_RF_SCATTER_EQ_FLOPS", 5e5))
+_SCATTER_EQ_FLOPS = float(envspec.get("TPUML_RF_SCATTER_EQ_FLOPS"))
 
 # HBM budget for the fused-selection path's residents. Resolved ONCE at
 # import (the _SCATTER_EQ_FLOPS pattern — a per-trace env read would be
 # silently ignored on jit cache hits): env override, else 3/4 of the
 # device's reported memory, else a 16 GB-class default. Device memory is
 # process-stable, so deriving it at first use cannot go stale.
-_SEL_HBM_BUDGET_ENV = _os.environ.get("TPUML_RF_SEL_HBM_BUDGET")
+_SEL_HBM_BUDGET_ENV = envspec.get("TPUML_RF_SEL_HBM_BUDGET")
 
 
 def _sel_hbm_budget() -> float:
@@ -85,12 +84,7 @@ def resolve_contract_gather() -> str:
     "auto" (TPU at moderate widths), "on", or "off". Rides the static
     ForestConfig so it participates in the jit cache key — a module flag
     read at trace time would be silently ignored on cache hits."""
-    v = _os.environ.get("TPUML_RF_CONTRACT_GATHER") or "auto"
-    if v not in ("auto", "on", "off"):
-        raise ValueError(
-            f"RF contract-gather strategy must be auto|on|off, got {v!r}"
-        )
-    return v
+    return str(envspec.get("TPUML_RF_CONTRACT_GATHER"))
 # rows per matmul accumulation chunk: bounds the (C, n_nodes) node-onehot
 # and (C, F*nb) bin-onehot intermediates (C=8192, level 12, F*nb=512:
 # 8192*4096*4 = 128 MB node-onehot is the largest, still < HBM noise)
@@ -106,12 +100,7 @@ def resolve_hist_strategy() -> str:
     falls back to scatter on levels where it is not — the fused-kernel
     analog of knn's "auto", kept as its own name so "auto" can keep
     meaning "per-level cost model" as strategies evolve."""
-    v = _os.environ.get("TPUML_RF_FORCE_STRATEGY") or "auto"
-    if v not in ("auto", "matmul", "scatter", "compact"):
-        raise ValueError(
-            f"RF histogram strategy must be auto|matmul|scatter|compact, got {v!r}"
-        )
-    return v
+    return str(envspec.get("TPUML_RF_FORCE_STRATEGY"))
 
 
 class ForestConfig(NamedTuple):
@@ -1104,7 +1093,7 @@ def forest_apply(
 # import (the callers-outside-jit rule: an env read inside the traced
 # functions would be silently ignored on jit cache hits; a module-level
 # read is likewise cache-safe — the value is fixed per process).
-_RF_BYTE_GATHER = _os.environ.get("TPUML_RF_BYTE_GATHER", "0") == "1"
+_RF_BYTE_GATHER = bool(envspec.get("TPUML_RF_BYTE_GATHER"))
 
 
 # --- two-hop subtree descent (bin space, zero per-row gathers) -------------

@@ -50,12 +50,12 @@ from __future__ import annotations
 
 import functools
 import logging
-import os
 
 import jax
 import jax.numpy as jnp
 
 from ._compat import pallas_tpu_compiler_params, pallas_tpu_prng
+from ..runtime import envspec
 from .umap_kernels import epoch_alpha, epoch_rng_keys
 
 # Test hook (mirrors ops.rf_pallas.FORCE_INTERPRET): run the kernel
@@ -71,17 +71,9 @@ _LOWERING_OK: dict = {}
 # (4096 and 256); transform batches are padded up to it with inert rows.
 BLOCK_ROWS = 256
 
-_MODES = ("auto", "pallas", "xla")
-
-
 def resolve_umap_opt() -> str:
     """Validated ``TPUML_UMAP_OPT`` (auto | pallas | xla)."""
-    mode = os.environ.get("TPUML_UMAP_OPT", "auto").strip().lower()
-    if mode not in _MODES:
-        raise ValueError(
-            f"TPUML_UMAP_OPT must be one of {_MODES}, got {mode!r}"
-        )
-    return mode
+    return str(envspec.get("TPUML_UMAP_OPT"))
 
 
 def default_rng_mode() -> str:

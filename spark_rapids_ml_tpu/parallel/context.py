@@ -19,11 +19,12 @@ don't hang — the analog of ``nccl.abort()`` (``cuml_context.py:155-160``).
 
 from __future__ import annotations
 
-import os
+
 from typing import Optional
 
 import jax
 
+from ..runtime import envspec
 from ..runtime.faults import fault_site
 from ..runtime.retry import with_retries
 from ..utils.logging import get_logger
@@ -38,32 +39,25 @@ class DistConfigError(ValueError):
     """Malformed multi-process rendezvous configuration (TPUML_* env)."""
 
 
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
+def _env_topology_var(name: str) -> int:
+    """Registry read re-raised as :class:`DistConfigError` (the launcher
+    contract error type) with the variable named in the message."""
     try:
-        return int(raw)
-    except ValueError:
-        raise DistConfigError(
-            f"{name}={raw!r} is not an integer — the launcher must export a "
-            f"plain base-10 process count/rank"
-        ) from None
+        return int(envspec.get(name))
+    except envspec.EnvSpecError as e:
+        raise DistConfigError(str(e)) from None
 
 
 def _validated_env_topology() -> tuple:
     """(num_procs, proc_id) from env, with bounds checked up front.
 
     A malformed launcher env used to surface as a bare ``ValueError`` from
-    ``int()`` deep inside the first mesh touch; validate here so the error
-    names the variable and the constraint.
+    ``int()`` deep inside the first mesh touch; the registry read names
+    the variable and the constraint (type + lower bound); the cross-var
+    bound is checked here.
     """
-    num_procs = _env_int("TPUML_NUM_PROCS", 1)
-    proc_id = _env_int("TPUML_PROC_ID", 0)
-    if num_procs < 1:
-        raise DistConfigError(f"TPUML_NUM_PROCS={num_procs} must be >= 1")
-    if proc_id < 0:
-        raise DistConfigError(f"TPUML_PROC_ID={proc_id} must be >= 0")
+    num_procs = _env_topology_var("TPUML_NUM_PROCS")
+    proc_id = _env_topology_var("TPUML_PROC_ID")
     if proc_id >= num_procs:
         raise DistConfigError(
             f"TPUML_PROC_ID={proc_id} must be < TPUML_NUM_PROCS={num_procs}"
@@ -74,7 +68,7 @@ def _validated_env_topology() -> tuple:
 def distributed_env_configured() -> bool:
     """True when the launcher provided multi-process rendezvous info."""
     return (
-        bool(os.environ.get("TPUML_COORDINATOR"))
+        envspec.is_set("TPUML_COORDINATOR")
         and _validated_env_topology()[0] > 1
     )
 
@@ -119,7 +113,7 @@ class TpuDistContext:
         num_processes: Optional[int] = None,
         process_id: Optional[int] = None,
     ):
-        self.coordinator = coordinator or os.environ.get("TPUML_COORDINATOR")
+        self.coordinator = coordinator or envspec.get("TPUML_COORDINATOR")
         env_procs, env_pid = _validated_env_topology()
         self.num_processes = num_processes or env_procs
         self.process_id = process_id if process_id is not None else env_pid

@@ -205,23 +205,36 @@ def shard_aligned(v: np.ndarray, mesh: Mesh, total_rows: int) -> jax.Array:
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _replicate_jit(mesh: Mesh):
+    """One compiled reshard-to-replicated program per mesh — building the
+    jit per call would retrace on every fetch (the cache keys on the
+    callable object)."""
+    return jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_replicated_jit(mesh: Mesh):
+    return jax.jit(
+        lambda a, i: jnp.take(a, i, axis=0),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+
 def fetch_global(arr: jax.Array, mesh: Mesh) -> np.ndarray:
     """``np.asarray`` that also works for row-sharded multi-host arrays:
     reshard to fully-replicated (one all_gather over ICI/DCN) so every
     process can read the complete value."""
     if jax.process_count() <= 1:
         return np.asarray(arr)
-    rep = jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))(arr)
+    rep = _replicate_jit(mesh)(arr)
     return np.asarray(rep.addressable_shards[0].data)
 
 
 def gather_rows_global(x: jax.Array, idx: np.ndarray, mesh: Mesh) -> np.ndarray:
     """Host-fetch selected rows of a (possibly multi-host) row-sharded
     matrix: device-side gather with a replicated output, then one fetch."""
-    out = jax.jit(
-        lambda a, i: jnp.take(a, i, axis=0),
-        out_shardings=NamedSharding(mesh, P()),
-    )(x, np.asarray(idx))
+    out = _gather_replicated_jit(mesh)(x, np.asarray(idx))
     if jax.process_count() <= 1:
         return np.asarray(out)
     return np.asarray(out.addressable_shards[0].data)

@@ -40,6 +40,8 @@ from typing import Any, Dict, Mapping, Optional, Tuple
 
 import numpy as np
 
+from . import envspec
+
 logger = logging.getLogger("spark_rapids_ml_tpu.runtime.checkpoint")
 
 CKPT_VERSION = 1
@@ -86,14 +88,8 @@ class FitCheckpointer:
     @classmethod
     def from_env(cls, algo: str, params: Mapping[str, Any]) -> "FitCheckpointer":
         """Build from ``TPUML_CKPT_DIR`` / ``TPUML_CKPT_EVERY`` (default 1)."""
-        ckpt_dir = os.environ.get("TPUML_CKPT_DIR") or None
-        raw = os.environ.get("TPUML_CKPT_EVERY", "1")
-        try:
-            every = int(raw)
-        except ValueError:
-            raise ValueError(f"TPUML_CKPT_EVERY={raw!r} is not an integer") from None
-        if every < 1:
-            raise ValueError(f"TPUML_CKPT_EVERY={raw!r} must be >= 1")
+        ckpt_dir = envspec.get("TPUML_CKPT_DIR")
+        every = envspec.get("TPUML_CKPT_EVERY")
         return cls(algo, params, ckpt_dir, every)
 
     @property

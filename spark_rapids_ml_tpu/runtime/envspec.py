@@ -1,0 +1,420 @@
+"""Typed registry of every ``TPUML_*`` environment knob.
+
+Single source of truth for name, type, default, validation domain, and
+one-line doc of each variable. All library reads go through
+:func:`get` — ``tpuml_lint`` rule TPU001 rejects raw ``os.environ``
+access to ``TPUML_*`` names anywhere else, and TPU002 cross-checks this
+registry against the committed docs tables (``scripts/gen_config_docs.py``
+regenerates them from here).
+
+Deliberately stdlib-only (no jax/numpy, no relative imports): the linter
+and the doc generator load this file directly via ``importlib`` without
+importing the package, so the doc-drift check runs even where jax does
+not.
+
+Parse conventions (uniform across every variable, unlike the ad-hoc
+``int(os.environ[...])`` reads this replaced):
+
+- unset or empty string -> the registered default (shell ``FOO= cmd``
+  patterns mean "unset", never "parse the empty string");
+- bools accept ``1/0, true/false, yes/no, on/off`` case-insensitively;
+- choice values are stripped and lowercased before matching;
+- any other malformed value raises :class:`EnvSpecError` naming the
+  variable, the offending value, and the accepted domain.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+
+class EnvSpecError(ValueError):
+    """A ``TPUML_*`` variable failed to parse or validate.
+
+    Subclasses ``ValueError`` so pre-registry callers that caught
+    ``ValueError`` from bare ``int()`` parses keep working.
+    """
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One registered knob. ``type`` is int|float|bool|str|path|choice."""
+
+    name: str
+    type: str
+    default: Any
+    doc: str
+    choices: Optional[Tuple[str, ...]] = None
+    minimum: Optional[float] = None  # inclusive lower bound (int/float)
+    exclusive_minimum: Optional[float] = None  # strict lower bound
+    category: str = "general"
+    # docs files (repo-relative) whose prose must mention this variable;
+    # TPU002 enforces membership. configuration.md is implied for all.
+    also_documented_in: Tuple[str, ...] = ()
+
+    def domain(self) -> str:
+        """Human-readable accepted domain, used in error messages."""
+        if self.type == "choice":
+            assert self.choices is not None
+            return "one of " + "|".join(self.choices)
+        if self.type == "bool":
+            return "a boolean (1/0, true/false, yes/no, on/off)"
+        bound = ""
+        if self.minimum is not None:
+            bound = f" >= {self.minimum:g}"
+        elif self.exclusive_minimum is not None:
+            bound = f" > {self.exclusive_minimum:g}"
+        return {"int": "an integer", "float": "a number"}.get(
+            self.type, "a string"
+        ) + bound
+
+    def default_repr(self) -> str:
+        """Default as shown in the generated docs table."""
+        if self.default is None:
+            return "unset"
+        if self.type == "bool":
+            return "1" if self.default else "0"
+        if self.type == "float":
+            return f"{self.default:g}"
+        return str(self.default)
+
+
+_TRUE = frozenset(("1", "true", "yes", "on"))
+_FALSE = frozenset(("0", "false", "no", "off"))
+
+
+def _registry(*specs: EnvVar) -> Dict[str, EnvVar]:
+    out: Dict[str, EnvVar] = {}
+    for s in specs:
+        assert s.name not in out, f"duplicate registration {s.name}"
+        out[s.name] = s
+    return out
+
+
+SPEC: Dict[str, EnvVar] = _registry(
+    # --- multi-process rendezvous (parallel/context.py) -------------------
+    EnvVar(
+        "TPUML_COORDINATOR", "str", None,
+        "Address of process 0 (e.g. `10.0.0.1:8476`) for the multi-host "
+        "rendezvous; provided by the launcher (the reference's NCCL-uid "
+        "allGather bootstrap). Unset = single-process.",
+        category="distributed",
+    ),
+    EnvVar(
+        "TPUML_NUM_PROCS", "int", 1,
+        "Total process count of the multi-host world; provided by the "
+        "launcher together with `TPUML_COORDINATOR`.",
+        minimum=1, category="distributed",
+    ),
+    EnvVar(
+        "TPUML_PROC_ID", "int", 0,
+        "This process's rank in `[0, TPUML_NUM_PROCS)`; provided by the "
+        "launcher together with `TPUML_COORDINATOR`.",
+        minimum=0, category="distributed",
+    ),
+    # --- ingest / streaming ----------------------------------------------
+    EnvVar(
+        "TPUML_STREAM_THRESHOLD_BYTES", "int", None,
+        "Dataset size above which fits stream automatically instead of "
+        "materializing (default: 60% of one device's reported memory, or "
+        "8 GiB when the backend reports none).",
+        exclusive_minimum=0, category="streaming",
+    ),
+    EnvVar(
+        "TPUML_STREAM_PREFETCH", "int", 2,
+        "Look-ahead depth of the streaming decode thread (host memory: "
+        "that many chunk buffers); `0` disables prefetch entirely.",
+        minimum=0, category="streaming",
+    ),
+    EnvVar(
+        "TPUML_STREAM_SYNC_EVERY", "int", 4,
+        "Host-side backpressure period of streaming loops, in chunks "
+        "between blocking device syncs (bounds pending-transfer host "
+        "memory); `0` disables the periodic sync.",
+        minimum=0, category="streaming",
+    ),
+    # --- native layer -----------------------------------------------------
+    EnvVar(
+        "TPUML_LIB", "path", None,
+        "Path to a prebuilt `libtpuml.so` (skips the cmake build).",
+        category="native",
+    ),
+    EnvVar(
+        "TPUML_BLAS_LIB", "path", None,
+        "Path to a cblas shared object for the native layer (default: "
+        "auto-discovered from the numpy/scipy wheels).",
+        category="native",
+    ),
+    # --- kmeans -----------------------------------------------------------
+    EnvVar(
+        "TPUML_LANE_PAD", "int", None,
+        "KMeans feature lane-padding multiple override (default: 128 on "
+        "TPU, off elsewhere). Padding to the lane multiple is HBM-free on "
+        "TPU and removes XLA's defensive copy of X around the Lloyd loop "
+        "at `d % 128 != 0`.",
+        minimum=0, category="kmeans",
+    ),
+    EnvVar(
+        "TPUML_KMEANS_MATMUL_DTYPE", "choice", None,
+        "Operand dtype of KMeans' two MXU contractions (f32 accumulation; "
+        "the final cost pass always runs f32). Also an estimator kwarg "
+        "`matmul_dtype`, which wins over the env.",
+        choices=("float32", "bfloat16"), category="kmeans",
+    ),
+    # --- logreg -----------------------------------------------------------
+    EnvVar(
+        "TPUML_LOGREG_OBJECTIVE_DTYPE", "choice", "float32",
+        "Dtype of the X copy the L-BFGS objective reads (statistics/"
+        "params/accumulation stay f32; bf16 halves HBM bytes of the "
+        "bandwidth-bound eval). Also an estimator kwarg `objective_dtype`, "
+        "which wins over the env.",
+        choices=("float32", "bfloat16"), category="logreg",
+    ),
+    # --- random forest ----------------------------------------------------
+    EnvVar(
+        "TPUML_RF_ROWS_PER_TREE", "choice", "auto",
+        "`all`: every tree sees the full dataset (one `all_gather` of the "
+        "uint8 binned matrix); `local`: only its worker's partition (the "
+        "reference's exact semantics); `auto`: gather when the gathered "
+        "operands fit `TPUML_RF_GATHER_BUDGET_BYTES`.",
+        choices=("auto", "all", "local"), category="random-forest",
+    ),
+    EnvVar(
+        "TPUML_RF_GATHER_BUDGET_BYTES", "float", 4e9,
+        "Gathered-operand budget for `TPUML_RF_ROWS_PER_TREE=auto`.",
+        exclusive_minimum=0, category="random-forest",
+    ),
+    EnvVar(
+        "TPUML_RF_SCATTER_EQ_FLOPS", "float", 5e5,
+        "Histogram strategy cost-model constant: per-level crossover "
+        "between MXU one-hot matmuls and scatter-adds; re-tune for other "
+        "chip generations (see `docs/rf_performance.md`).",
+        exclusive_minimum=0, category="random-forest",
+    ),
+    EnvVar(
+        "TPUML_RF_SEL_HBM_BUDGET", "float", None,
+        "HBM budget in bytes for the fused-selection histogram path's "
+        "residents (default: 3/4 of the device's reported memory, or a "
+        "16 GB-class fallback).",
+        exclusive_minimum=0, category="random-forest",
+    ),
+    EnvVar(
+        "TPUML_RF_FORCE_STRATEGY", "choice", "auto",
+        "Histogram build strategy: `auto` = per-level cost model, "
+        "`matmul`/`scatter` pin one formulation, `compact` forces the "
+        "node-contiguous Pallas path on every eligible level (falls back "
+        "to scatter where its lowering is not).",
+        choices=("auto", "matmul", "scatter", "compact"),
+        category="random-forest",
+    ),
+    EnvVar(
+        "TPUML_RF_CONTRACT_GATHER", "choice", "auto",
+        "Subset-extraction strategy of the fused-selection path: `auto` "
+        "(TPU at moderate widths), `on`, or `off`. Rides the static "
+        "ForestConfig so it participates in the jit cache key.",
+        choices=("auto", "on", "off"), category="random-forest",
+    ),
+    EnvVar(
+        "TPUML_RF_APPLY", "choice", "auto",
+        "Forest inference path: `auto` prefers the FIL-style packed-forest "
+        "lockstep engine on TPU (bit-identical to both descents), falling "
+        "back to the two-hop bin-space descent then the raw-threshold "
+        "descent; `legacy`/`bins`/`packed` pin one engine (see "
+        "`docs/rf_performance.md`).",
+        choices=("auto", "legacy", "bins", "packed"), category="random-forest",
+    ),
+    EnvVar(
+        "TPUML_RF_CHECK_FINITE", "bool", False,
+        "Opt-in NaN/Inf screen on every transform batch at the serving "
+        "boundary (a full host pass, so off by default). Fit always "
+        "rejects non-finite features; without this flag, transform-time "
+        "NaN silently routes to bin 0 in the bin-space descents.",
+        category="random-forest",
+    ),
+    EnvVar(
+        "TPUML_RF_BYTE_GATHER", "bool", False,
+        "Opt-in Pallas lane-shuffle byte gather in the two-hop descent. "
+        "Measured 3x slower in situ on the current toolchain "
+        "(call-boundary de-fusion; `docs/rf_performance.md` round 5) — a "
+        "documented negative result kept for future toolchains.",
+        category="random-forest",
+    ),
+    # --- knn / umap -------------------------------------------------------
+    EnvVar(
+        "TPUML_KNN_TOPK", "choice", "auto",
+        "Tile top-k implementation: `auto` = fused Pallas distance+top-k "
+        "kernel when eligible, else the partial-reduce tile path; "
+        "`partial` forces the XLA tile path with `lax.approx_max_k` "
+        "(routes around the fused kernel); `sort` forces full `lax.top_k`.",
+        choices=("auto", "sort", "partial"), category="knn",
+    ),
+    EnvVar(
+        "TPUML_UMAP_OPT", "choice", "auto",
+        "UMAP SGD engine for fit and the transform refine pass: `auto` "
+        "prefers the VMEM-resident Pallas engine when the lowering probe "
+        "accepts the config, falling back to the jitted XLA epoch loop; "
+        "`pallas` forces the kernel where eligible (warns + falls back "
+        "when not); `xla` pins the epoch loop (see "
+        "`docs/umap_performance.md`).",
+        choices=("auto", "pallas", "xla"), category="umap",
+    ),
+    # --- CI / notebooks ---------------------------------------------------
+    EnvVar(
+        "TPUML_NB_CPU", "bool", False,
+        "Pin the generated notebooks to the CPU backend when executing "
+        "headless (exported by `ci/run_notebooks.py`); unset = default "
+        "backend, i.e. the TPU.",
+        category="ci",
+    ),
+    # --- resilience (docs/fault_tolerance.md) -----------------------------
+    EnvVar(
+        "TPUML_CKPT_DIR", "path", None,
+        "Directory for periodic fit snapshots of the iterative solvers "
+        "(streamed KMeans Lloyd, L-BFGS host loop, UMAP SGD); unset = "
+        "checkpointing off. A refit with the same params/seed resumes "
+        "from the last committed snapshot and matches an uninterrupted "
+        "fit exactly.",
+        category="resilience",
+        also_documented_in=("docs/fault_tolerance.md",),
+    ),
+    EnvVar(
+        "TPUML_CKPT_EVERY", "int", 1,
+        "Snapshot cadence in solver iterations (UMAP: epochs). Only read "
+        "when `TPUML_CKPT_DIR` is set.",
+        minimum=1, category="resilience",
+        also_documented_in=("docs/fault_tolerance.md",),
+    ),
+    EnvVar(
+        "TPUML_RETRIES", "int", 0,
+        "Retry budget for transient failures at the distributed bootstrap "
+        "and host-to-device chunk staging (default 0 = single attempt). "
+        "`RESOURCE_EXHAUSTED` staging errors additionally degrade by "
+        "halving the chunk within the budget.",
+        minimum=0, category="resilience",
+        also_documented_in=("docs/fault_tolerance.md",),
+    ),
+    EnvVar(
+        "TPUML_BACKOFF_MS", "float", 100.0,
+        "Base delay for the exponential-backoff-with-jitter retry "
+        "schedule (doubles per attempt, capped at 30 s, equal jitter).",
+        exclusive_minimum=0, category="resilience",
+        also_documented_in=("docs/fault_tolerance.md",),
+    ),
+    EnvVar(
+        "TPUML_FAULT_SPEC", "str", "",
+        "Deterministic fault injection for resilience testing: comma-"
+        "separated `scope:point:index:action` entries (`ingest:chunk` / "
+        "`sgd:epoch` / `init:connect` sites; `raise`/`preempt`/`oom` "
+        "actions; 0-based per-site hit index, each entry fires once).",
+        category="resilience",
+        also_documented_in=("docs/fault_tolerance.md",),
+    ),
+    EnvVar(
+        "TPUML_CV_FAILFAST", "bool", True,
+        "`1` (reference semantics): any failed fold/param fit aborts "
+        "`CrossValidator.fit`. `0` records the failed combo as worst-"
+        "metric (±inf in `avgMetrics`) and keeps searching; raises only "
+        "if every combo failed.",
+        category="resilience",
+        also_documented_in=("docs/fault_tolerance.md",),
+    ),
+)
+
+
+def registered_names() -> Tuple[str, ...]:
+    return tuple(SPEC)
+
+
+def parse(name: str, raw: Optional[str]) -> Any:
+    """Parse+validate a raw string for ``name`` (None/"" -> default)."""
+    try:
+        var = SPEC[name]
+    except KeyError:
+        raise EnvSpecError(
+            f"{name} is not a registered TPUML_* variable "
+            f"(spark_rapids_ml_tpu/runtime/envspec.py is the registry)"
+        ) from None
+    if raw is None or raw == "":
+        return var.default
+
+    if var.type in ("str", "path"):
+        return raw
+    if var.type == "choice":
+        v = raw.strip().lower()
+        assert var.choices is not None
+        if v not in var.choices:
+            raise EnvSpecError(f"{name}={raw!r} must be {var.domain()}")
+        return v
+    if var.type == "bool":
+        v = raw.strip().lower()
+        if v in _TRUE:
+            return True
+        if v in _FALSE:
+            return False
+        raise EnvSpecError(f"{name}={raw!r} must be {var.domain()}")
+    # numeric
+    try:
+        num: Any = int(raw) if var.type == "int" else float(raw)
+    except ValueError:
+        raise EnvSpecError(
+            f"{name}={raw!r} is not {var.domain()}"
+        ) from None
+    if var.minimum is not None and num < var.minimum:
+        raise EnvSpecError(f"{name}={raw!r} must be >= {var.minimum:g}")
+    if var.exclusive_minimum is not None and num <= var.exclusive_minimum:
+        raise EnvSpecError(
+            f"{name}={raw!r} must be > {var.exclusive_minimum:g}"
+        )
+    return num
+
+
+def get(name: str, *, env: Optional[Mapping[str, str]] = None) -> Any:
+    """The parsed, validated value of registered variable ``name``.
+
+    Reads the live environment on every call (tests flip these between
+    fits); callers that need trace-cache safety resolve once outside jit
+    or at module import and pass the value through static args — see
+    `docs/static_analysis.md` (TPU003).
+    """
+    source = os.environ if env is None else env
+    return parse(name, source.get(name))
+
+
+def get_raw(name: str) -> Optional[str]:
+    """Raw string value (no parsing); None when unset. ``name`` must be
+    registered — unregistered names raise like :func:`get`."""
+    if name not in SPEC:
+        return parse(name, None)  # raises EnvSpecError naming the registry
+    return os.environ.get(name)
+
+
+def is_set(name: str) -> bool:
+    """True when ``name`` is present AND non-empty in the environment."""
+    if name not in SPEC:
+        parse(name, None)  # raises EnvSpecError naming the registry
+    return bool(os.environ.get(name))
+
+
+# --- docs table generation (scripts/gen_config_docs.py + TPU002) ----------
+
+TABLE_BEGIN = "<!-- tpuml-envspec:begin (generated by scripts/gen_config_docs.py — edit envspec.py, not this table) -->"
+TABLE_END = "<!-- tpuml-envspec:end -->"
+
+
+def doc_table_lines() -> Tuple[str, ...]:
+    """The generated markdown table for ``docs/configuration.md``,
+    including the begin/end markers TPU002 anchors its drift check on."""
+    rows = [
+        TABLE_BEGIN,
+        "| variable | type | default | meaning |",
+        "|---|---|---|---|",
+    ]
+    for var in SPEC.values():
+        typ = var.type if var.type != "choice" else "|".join(var.choices or ())
+        rows.append(
+            f"| `{var.name}` | {typ} | {var.default_repr()} | {var.doc} |"
+        )
+    rows.append(TABLE_END)
+    return tuple(rows)

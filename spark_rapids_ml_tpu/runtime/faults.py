@@ -27,9 +27,10 @@ lookup — the production path stays inert.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Dict, List, Optional, Tuple
+
+from . import envspec
 
 SITES = ("ingest:chunk", "sgd:epoch", "init:connect")
 ACTIONS = ("raise", "preempt", "oom")
@@ -139,7 +140,7 @@ _cached: Optional[Tuple[str, Optional[FaultInjector]]] = None
 
 def _injector() -> Optional[FaultInjector]:
     global _cached
-    spec = os.environ.get("TPUML_FAULT_SPEC", "")
+    spec = envspec.get("TPUML_FAULT_SPEC")
     with _cache_lock:
         if _cached is not None and _cached[0] == spec:
             return _cached[1]

@@ -20,11 +20,11 @@ refit-from-checkpoint, not by waiting.
 from __future__ import annotations
 
 import logging
-import os
 import random
 import time
 from typing import Callable, List, Optional, Tuple, Type, TypeVar
 
+from . import envspec
 from .counters import bump
 from .faults import SimulatedPreemption
 
@@ -37,30 +37,12 @@ _BACKOFF_CAP_MS = 30_000.0
 
 def resolve_retries() -> int:
     """``TPUML_RETRIES`` as a non-negative int (default 0 = inert)."""
-    raw = os.environ.get("TPUML_RETRIES", "0")
-    try:
-        n = int(raw)
-    except ValueError:
-        raise ValueError(
-            f"TPUML_RETRIES={raw!r} is not an integer"
-        ) from None
-    if n < 0:
-        raise ValueError(f"TPUML_RETRIES={raw!r} must be >= 0")
-    return n
+    return envspec.get("TPUML_RETRIES")
 
 
 def resolve_backoff_ms() -> float:
     """``TPUML_BACKOFF_MS`` as a positive float (default 100)."""
-    raw = os.environ.get("TPUML_BACKOFF_MS", "100")
-    try:
-        ms = float(raw)
-    except ValueError:
-        raise ValueError(
-            f"TPUML_BACKOFF_MS={raw!r} is not a number"
-        ) from None
-    if ms <= 0:
-        raise ValueError(f"TPUML_BACKOFF_MS={raw!r} must be > 0")
-    return ms
+    return float(envspec.get("TPUML_BACKOFF_MS"))
 
 
 def backoff_schedule(

@@ -27,6 +27,7 @@ from .data.dataframe import DataFrame, kfold
 from .evaluation import Evaluator
 from .params import Param, Params, TypeConverters, _mk
 from .runtime import counters as _res_counters
+from .runtime import envspec
 from .utils.logging import get_logger
 
 
@@ -36,7 +37,7 @@ def _cv_failfast() -> bool:
     as worst-metric and keeps searching — graceful degradation for long
     grids where one pathological combo (divergent solver, OOM) should not
     discard every other result."""
-    return os.environ.get("TPUML_CV_FAILFAST", "1") != "0"
+    return bool(envspec.get("TPUML_CV_FAILFAST"))
 
 # Serializes per-fold device work under parallel CV (see run_fold in
 # CrossValidator.fit): concurrent first-compiles of one jitted fit from

@@ -43,7 +43,8 @@ _WORKER = textwrap.dedent(
     from spark_rapids_ml_tpu.classification import LogisticRegression
     from spark_rapids_ml_tpu.clustering import KMeans
 
-    pid = int(os.environ["TPUML_PROC_ID"])
+    from spark_rapids_ml_tpu.runtime import envspec
+    pid = int(envspec.get("TPUML_PROC_ID"))
 
     # deterministic dataset; each process holds ITS partition only
     # (uneven split: exercises the cross-process shard agreement)
@@ -84,7 +85,7 @@ _WORKER = textwrap.dedent(
 
     if pid == 0:
         np.savez(
-            os.environ["TPUML_TEST_OUT"],
+            os.environ["SRMT_TEST_OUT"],
             components=m.components_,
             mean=m.mean_,
             ev=m.explained_variance_,
@@ -115,7 +116,7 @@ def test_two_process_fit_matches_single_process(tmp_path):
             TPUML_COORDINATOR=coord,
             TPUML_NUM_PROCS="2",
             TPUML_PROC_ID=str(pid),
-            TPUML_TEST_OUT=out,
+            SRMT_TEST_OUT=out,
             JAX_PLATFORMS="cpu",
         )
         procs.append(
@@ -229,7 +230,8 @@ _KNN_WORKER = textwrap.dedent(
     from spark_rapids_ml_tpu.data import DataFrame
     from spark_rapids_ml_tpu.knn import NearestNeighbors
 
-    pid = int(os.environ["TPUML_PROC_ID"])
+    from spark_rapids_ml_tpu.runtime import envspec
+    pid = int(envspec.get("TPUML_PROC_ID"))
     rng = np.random.default_rng(11)
     Xi = rng.normal(size=(157, 6)).astype(np.float32)
     Xq = rng.normal(size=(63, 6)).astype(np.float32)
@@ -338,7 +340,8 @@ _STREAM_WORKER = textwrap.dedent(
     from spark_rapids_ml_tpu.classification import LogisticRegression
     from spark_rapids_ml_tpu.clustering import KMeans
 
-    pid = int(os.environ["TPUML_PROC_ID"])
+    from spark_rapids_ml_tpu.runtime import envspec
+    pid = int(envspec.get("TPUML_PROC_ID"))
     rng = np.random.default_rng(42)
     X = (rng.normal(size=(357, 7)) + 2.0).astype(np.float32)
     w = rng.normal(size=(7,))
@@ -355,7 +358,7 @@ _STREAM_WORKER = textwrap.dedent(
     km = KMeans(k=3, seed=5, maxIter=25, **kw).fit(DataFrame({{"features": X[sl]}}))
     if pid == 0:
         np.savez(
-            os.environ["TPUML_TEST_OUT"],
+            os.environ["SRMT_TEST_OUT"],
             pca=np.asarray(pca.components_),
             lin=np.asarray(lin.coefficients),
             log=np.asarray(log.coefficientMatrix),
@@ -381,7 +384,7 @@ def test_two_process_streaming_matches_single_process(tmp_path):
             TPUML_COORDINATOR=coord,
             TPUML_NUM_PROCS="2",
             TPUML_PROC_ID=str(pid),
-            TPUML_TEST_OUT=out,
+            SRMT_TEST_OUT=out,
             JAX_PLATFORMS="cpu",
         )
         procs.append(

@@ -1,0 +1,458 @@
+"""tpuml_lint: per-rule positive/suppressed/negative fixtures, baseline
+mechanics, envspec parse semantics, and the whole-repo integration run
+(the tree must lint clean with the committed — empty — baseline)."""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import tpuml_lint
+from tpuml_lint import (
+    tpu001_raw_env,
+    tpu003_jit_in_loop,
+    tpu004_nondeterminism,
+    tpu005_static_args,
+    tpu006_lane_align,
+)
+from tpuml_lint.core import (
+    Finding,
+    SourceFile,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_snippet(rule, code, path="pkg/mod.py"):
+    """Run one per-file rule over a source snippet; suppressions applied."""
+    text = textwrap.dedent(code)
+    sf = SourceFile(
+        path=path, abspath="/" + path, text=text,
+        tree=ast.parse(text),
+    )
+    return [f for f in rule.check_file(sf) if not sf.suppressed(f)]
+
+
+# --- TPU001: raw env reads -------------------------------------------------
+
+
+def test_tpu001_flags_all_read_forms():
+    findings = lint_snippet(tpu001_raw_env, """
+        import os
+        from os import environ, getenv
+
+        a = os.environ.get("TPUML_RETRIES")
+        b = os.getenv("TPUML_CKPT_DIR", "x")
+        c = os.environ["TPUML_NUM_PROCS"]
+        d = "TPUML_COORDINATOR" in os.environ
+        e = environ.get("TPUML_LIB")
+        f = getenv("TPUML_BLAS_LIB")
+    """)
+    assert len(findings) == 6
+    assert all(f.rule == "TPU001" for f in findings)
+    assert "envspec" in findings[0].fixit
+
+
+def test_tpu001_aliased_import():
+    findings = lint_snippet(tpu001_raw_env, """
+        import os as _os
+        v = _os.environ.get("TPUML_UMAP_OPT", "auto")
+    """)
+    assert len(findings) == 1
+
+
+def test_tpu001_allows_writes_and_non_tpuml():
+    findings = lint_snippet(tpu001_raw_env, """
+        import os
+        os.environ["TPUML_RETRIES"] = "3"     # write: allowed
+        os.environ.pop("TPUML_RETRIES", None) # write: allowed
+        del os.environ["TPUML_CKPT_DIR"]      # write: allowed
+        path = os.environ.get("HOME")         # not TPUML_*
+    """)
+    assert findings == []
+
+
+def test_tpu001_exempts_envspec_itself():
+    findings = lint_snippet(
+        tpu001_raw_env,
+        'import os\nx = os.environ.get("TPUML_RETRIES")\n',
+        path="spark_rapids_ml_tpu/runtime/envspec.py",
+    )
+    assert findings == []
+
+
+def test_tpu001_suppression_comment():
+    findings = lint_snippet(tpu001_raw_env, """
+        import os
+        x = os.environ.get("TPUML_NB_CPU")  # tpuml: ignore[TPU001]
+        # tpuml: ignore[TPU001]
+        y = os.environ.get("TPUML_NB_CPU")
+        z = os.environ.get("TPUML_NB_CPU")  # tpuml: ignore[TPU003]
+    """)
+    assert len(findings) == 1  # wrong code doesn't suppress
+
+
+# --- TPU003: jit construction hazards --------------------------------------
+
+
+def test_tpu003_jit_in_loop():
+    findings = lint_snippet(tpu003_jit_in_loop, """
+        import jax
+        def fit(chunks):
+            for c in chunks:
+                f = jax.jit(lambda x: x + 1)
+                f(c)
+    """)
+    assert len(findings) == 1
+    assert "loop" in findings[0].message
+
+
+def test_tpu003_partial_jit_and_comprehension():
+    findings = lint_snippet(tpu003_jit_in_loop, """
+        import functools
+        import jax
+        def fit(fns):
+            return [functools.partial(jax.jit, static_argnames=("n",))(f)
+                    for f in fns]
+    """)
+    assert len(findings) == 1
+
+
+def test_tpu003_construct_and_invoke_per_call():
+    findings = lint_snippet(tpu003_jit_in_loop, """
+        import jax
+        def fetch(arr):
+            return jax.jit(lambda a: a * 2)(arr)
+    """)
+    assert len(findings) == 1
+    assert "per call" in findings[0].message
+
+
+def test_tpu003_clean_patterns():
+    findings = lint_snippet(tpu003_jit_in_loop, """
+        import functools
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def g(x, n):
+            return x * n
+
+        h = jax.jit(lambda x: x)  # module-level: constructed once
+
+        def fit(chunks):
+            for c in chunks:
+                f(c)  # calling a cached jit in a loop is the whole point
+    """)
+    assert findings == []
+
+
+# --- TPU004: nondeterminism ------------------------------------------------
+
+
+def test_tpu004_numpy_global_rng():
+    findings = lint_snippet(tpu004_nondeterminism, """
+        import numpy as np
+        def init(shape):
+            np.random.seed(0)
+            return np.random.randn(*shape)
+    """)
+    assert len(findings) == 2
+    assert "default_rng" in findings[0].fixit
+
+
+def test_tpu004_stdlib_random_module_calls():
+    findings = lint_snippet(tpu004_nondeterminism, """
+        import random
+        def jitter():
+            return random.uniform(0, 1)
+    """)
+    assert len(findings) == 1
+
+
+def test_tpu004_allows_seeded_instances():
+    findings = lint_snippet(tpu004_nondeterminism, """
+        import random
+        import numpy as np
+        rng = random.Random(1234)
+        gen = np.random.default_rng(0)
+        v = rng.uniform(0, 1)
+    """)
+    assert findings == []
+
+
+def test_tpu004_clock_in_traced_code():
+    findings = lint_snippet(tpu004_nondeterminism, """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            t0 = time.time()
+            return x + t0
+
+        def host_timer():
+            return time.time()  # outside trace: fine
+
+        def add_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + time.time()
+    """)
+    assert len(findings) == 2
+    assert {"step", "add_kernel"} == {
+        f.message.split("'")[1] for f in findings
+    }
+
+
+def test_tpu004_prngkey_in_loop():
+    findings = lint_snippet(tpu004_nondeterminism, """
+        import jax
+        def fit(n, base):
+            for epoch in range(n):
+                k = jax.random.PRNGKey(epoch)
+            for epoch in range(n):
+                k = jax.random.fold_in(base, epoch)  # the sanctioned form
+    """)
+    assert len(findings) == 1
+    assert "fold_in" in findings[0].fixit
+
+
+# --- TPU005: static arg hazards --------------------------------------------
+
+
+def test_tpu005_unknown_static_argname():
+    findings = lint_snippet(tpu005_static_args, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n_bins",))
+        def hist(x, nbins):
+            return x * nbins
+    """)
+    assert len(findings) == 1
+    assert "n_bins" in findings[0].message
+
+
+def test_tpu005_unhashable_default():
+    findings = lint_snippet(tpu005_static_args, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("shape",))
+        def zeros(x, shape=[8, 128]):
+            return x
+    """)
+    assert len(findings) == 1
+    assert "unhashable" in findings[0].message
+
+
+def test_tpu005_argnums_out_of_range():
+    findings = lint_snippet(tpu005_static_args, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def f(x, y):
+            return x + y
+    """)
+    assert len(findings) == 1
+
+
+def test_tpu005_assigned_jit_of_local_def():
+    findings = lint_snippet(tpu005_static_args, """
+        import jax
+
+        def _impl(x, cfg):
+            return x
+
+        f = jax.jit(_impl, static_argnames=("config",))
+    """)
+    assert len(findings) == 1
+
+
+def test_tpu005_clean():
+    findings = lint_snippet(tpu005_static_args, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n", "shape"))
+        def f(x, n, shape=(8, 128)):
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("opt",))
+        def g(x, **opts):
+            return x  # **kwargs can absorb any static name
+    """)
+    assert findings == []
+
+
+# --- TPU006: lane alignment ------------------------------------------------
+
+
+def test_tpu006_unaligned_minor_dim():
+    findings = lint_snippet(tpu006_lane_align, """
+        import jax.experimental.pallas as pl
+        spec = pl.BlockSpec((8, 100), lambda i: (i, 0))
+    """)
+    assert len(findings) == 1
+    assert "128" in findings[0].message
+
+
+def test_tpu006_clean_specs():
+    findings = lint_snippet(tpu006_lane_align, """
+        import jax.experimental.pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        a = pl.BlockSpec((8, 256), lambda i: (i, 0))     # aligned
+        b = pl.BlockSpec((bn, feat_pad), lambda i: (i, 0))  # symbolic
+        c = pl.BlockSpec((1, 1), memory_space=pltpu.SMEM)   # scalar
+        d = pl.BlockSpec((1, 1), lambda i: (0, 0))          # (1,1) scalar
+    """)
+    assert findings == []
+
+
+# --- baseline + suppression mechanics --------------------------------------
+
+
+def _finding(path="a.py", rule="TPU001", context="x = 1"):
+    return Finding(rule=rule, path=path, line=3, col=1,
+                   message="m", context=context)
+
+
+def test_baseline_roundtrip_and_churn_tolerance(tmp_path):
+    f = _finding()
+    p = str(tmp_path / "baseline.json")
+    write_baseline(p, [f])
+    baseline = load_baseline(p)
+    # same finding on a DIFFERENT line (code above it churned): absorbed
+    moved = Finding(rule=f.rule, path=f.path, line=99, col=5,
+                    message=f.message, context=f.context)
+    new, stale = apply_baseline([moved], baseline)
+    assert new == [] and stale == []
+    # different context line: new finding + stale entry
+    other = _finding(context="y = 2")
+    new, stale = apply_baseline([other], baseline)
+    assert len(new) == 1 and len(stale) == 1
+
+
+def test_committed_baseline_is_empty():
+    p = os.path.join(REPO_ROOT, "tpuml_lint", "baseline.json")
+    with open(p) as fh:
+        assert json.load(fh)["findings"] == []
+
+
+# --- envspec parse semantics ------------------------------------------------
+
+
+def test_envspec_parse_errors_name_variable_and_domain():
+    from spark_rapids_ml_tpu.runtime import envspec
+
+    with pytest.raises(envspec.EnvSpecError, match="TPUML_NUM_PROCS"):
+        envspec.parse("TPUML_NUM_PROCS", "zero")
+    with pytest.raises(envspec.EnvSpecError, match="must be >= 1"):
+        envspec.parse("TPUML_NUM_PROCS", "0")
+    with pytest.raises(envspec.EnvSpecError, match="auto|sort|partial"):
+        envspec.parse("TPUML_KNN_TOPK", "bogus")
+    with pytest.raises(envspec.EnvSpecError, match="boolean"):
+        envspec.parse("TPUML_RF_CHECK_FINITE", "maybe")
+    # EnvSpecError is a ValueError for pre-registry except clauses
+    assert issubclass(envspec.EnvSpecError, ValueError)
+
+
+def test_envspec_defaults_and_empty_means_unset():
+    from spark_rapids_ml_tpu.runtime import envspec
+
+    assert envspec.parse("TPUML_RETRIES", None) == 0
+    assert envspec.parse("TPUML_RETRIES", "") == 0
+    assert envspec.parse("TPUML_CV_FAILFAST", "off") is False
+    assert envspec.parse("TPUML_UMAP_OPT", " Pallas ") == "pallas"
+    assert envspec.get("TPUML_CKPT_EVERY", env={}) == 1
+    assert envspec.get("TPUML_CKPT_EVERY", env={"TPUML_CKPT_EVERY": "7"}) == 7
+
+
+def test_envspec_is_stdlib_only():
+    """The by-file-path loaders (tpuml_lint, gen_config_docs) depend on
+    envspec importing nothing beyond the stdlib."""
+    path = os.path.join(
+        REPO_ROOT, "spark_rapids_ml_tpu", "runtime", "envspec.py"
+    )
+    with open(path) as fh:
+        tree = ast.parse(fh.read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            assert node.level == 0, "no relative imports in envspec.py"
+            assert node.module.split(".")[0] in ("os", "dataclasses", "typing", "__future__")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                assert a.name.split(".")[0] in ("os", "dataclasses", "typing")
+
+
+def test_every_registered_var_is_in_docs_table():
+    from spark_rapids_ml_tpu.runtime import envspec
+
+    with open(os.path.join(REPO_ROOT, "docs", "configuration.md")) as fh:
+        doc = fh.read()
+    for name in envspec.registered_names():
+        assert name in doc, f"{name} missing from docs/configuration.md"
+
+
+# --- integration ------------------------------------------------------------
+
+
+def _run_lint(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tpuml_lint", *args],
+        cwd=cwd, capture_output=True, text=True,
+    )
+
+
+def test_repo_lints_clean():
+    """The acceptance gate: the tree has zero non-baselined findings."""
+    r = _run_lint("spark_rapids_ml_tpu", "tests", "bench.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_lint_fails_on_each_rule(tmp_path):
+    bad = {
+        "TPU001": 'import os\nx = os.environ.get("TPUML_RETRIES")\n',
+        "TPU003": (
+            "import jax\n"
+            "def f(cs):\n"
+            "    for c in cs:\n"
+            "        jax.jit(lambda x: x)(c)\n"
+        ),
+        "TPU004": "import numpy as np\nnp.random.seed(0)\n",
+        "TPU005": (
+            "import functools, jax\n"
+            "@functools.partial(jax.jit, static_argnames=('typo',))\n"
+            "def f(x):\n"
+            "    return x\n"
+        ),
+        "TPU006": (
+            "import jax.experimental.pallas as pl\n"
+            "s = pl.BlockSpec((8, 100), lambda i: (i, 0))\n"
+        ),
+    }
+    for code, src in bad.items():
+        p = tmp_path / f"{code.lower()}_fixture.py"
+        p.write_text(src)
+        r = _run_lint(str(p), "--no-baseline", "--rule", code)
+        assert r.returncode == 1, f"{code} not detected:\n{r.stdout}"
+        assert code in r.stdout
+
+
+def test_gen_config_docs_check_mode():
+    r = subprocess.run(
+        [sys.executable, "scripts/gen_config_docs.py", "--check"],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr

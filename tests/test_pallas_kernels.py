@@ -253,6 +253,7 @@ def test_lloyd_step_pallas_matches_xla_chunk_stats(matmul_dtype):
     orig_ok = kmeans_pallas.kmeans_pallas_ok
     kmeans_pallas.kmeans_pallas_ok = lambda *a: False
     try:
+        # single-call reference computation  # tpuml: ignore[TPU003]
         sums_x, counts_x, cost_x = jax.jit(
             lambda X, m, c: _chunk_stats(X, m, c, csize=1024, matmul_dtype=md)
         )(X, mask, centers)

@@ -1,0 +1,92 @@
+"""tpuml-lint — AST-based invariant checker for spark-tpu-ml.
+
+Run as ``python -m tpuml_lint <paths>``. Stdlib-only; see
+``docs/static_analysis.md`` for the rule catalog and suppression
+syntax (``# tpuml: ignore[TPU00N]``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from . import (
+    tpu001_raw_env,
+    tpu002_env_docs,
+    tpu003_jit_in_loop,
+    tpu004_nondeterminism,
+    tpu005_static_args,
+    tpu006_lane_align,
+)
+from .core import (
+    Finding,
+    SourceFile,
+    apply_baseline,
+    iter_py_files,
+    load_baseline,
+    load_source,
+    write_baseline,
+)
+from .envinfo import repo_root_from
+
+__version__ = "0.1.0"
+
+#: per-file rules expose check_file(sf); project rules expose
+#: check_project(files, repo_root).
+FILE_RULES = (
+    tpu001_raw_env,
+    tpu003_jit_in_loop,
+    tpu004_nondeterminism,
+    tpu005_static_args,
+    tpu006_lane_align,
+)
+PROJECT_RULES = (tpu002_env_docs,)
+ALL_RULES = FILE_RULES + PROJECT_RULES
+
+
+def run(
+    paths: Sequence[str],
+    repo_root: str,
+    rules: Sequence[str] = (),
+) -> Tuple[List[Finding], List[SourceFile]]:
+    """Lint ``paths``; returns (unsuppressed findings, parsed files).
+
+    ``rules`` restricts to the given codes (empty = all). Project rules
+    see every parsed file regardless of which file a finding lands in;
+    suppression comments are honoured only for findings in parsed files
+    (doc-file findings from TPU002 can't carry python comments).
+    """
+    selected = {r.upper() for r in rules}
+
+    def want(code: str) -> bool:
+        return not selected or code in selected
+
+    findings: List[Finding] = []
+    files: List[SourceFile] = []
+    by_path = {}
+    for ap in iter_py_files(paths, repo_root):
+        sf, err = load_source(ap, repo_root)
+        if err is not None:
+            findings.append(err)
+            continue
+        files.append(sf)
+        by_path[sf.path] = sf
+
+    for sf in files:
+        for rule in FILE_RULES:
+            if not want(rule.CODE):
+                continue
+            for f in rule.check_file(sf):
+                if not sf.suppressed(f):
+                    findings.append(f)
+
+    for rule in PROJECT_RULES:
+        if not want(rule.CODE):
+            continue
+        for f in rule.check_project(files, repo_root):
+            sf = by_path.get(f.path)
+            if sf is not None and sf.suppressed(f):
+                continue
+            findings.append(f)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, files

@@ -1,0 +1,268 @@
+"""tpuml-lint core: findings, suppressions, file walking, baseline.
+
+Stdlib-only by design (``ast``, ``json``, ``tokenize`` — no third-party
+deps), so the CI stage that runs it can never get the "not installed;
+skipping" treatment black/mypy get in hermetic images. Rules live in
+sibling ``tpu00N_*.py`` modules; each exposes ``CODE``, ``NAME``, and
+either ``check_file(sf)`` (per-file AST pass) or
+``check_project(files, repo_root)`` (whole-tree invariants like the
+env-var doc-drift check).
+
+Suppression syntax (`docs/static_analysis.md`): a ``# tpuml:
+ignore[TPU003]`` trailing comment on the flagged line, or on a
+comment-only line directly above it (for findings on long wrapped
+calls). Multiple codes: ``# tpuml: ignore[TPU001,TPU004]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_IGNORE_RE = re.compile(r"#\s*tpuml:\s*ignore\[([A-Z0-9,\s]+)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``context`` (the stripped source line) is the
+    churn-tolerant third of the baseline fingerprint — line numbers move
+    on every edit, the offending line text rarely does."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    fixit: str = ""
+    context: str = ""
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.path, self.rule, self.context)
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc}: {self.rule} {self.message}"
+        if self.fixit:
+            out += f"\n    fix: {self.fixit}"
+        return out
+
+
+@dataclass
+class SourceFile:
+    """A parsed python file handed to per-file rules."""
+
+    path: str  # repo-relative, forward slashes
+    abspath: str
+    text: str
+    tree: ast.AST
+    lines: List[str] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.lines:
+            self.lines = self.text.splitlines()
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(
+        self,
+        rule: str,
+        node: ast.AST,
+        message: str,
+        fixit: str = "",
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0) + 1
+        return Finding(
+            rule=rule,
+            path=self.path,
+            line=line,
+            col=col,
+            message=message,
+            fixit=fixit,
+            context=self.line_at(line),
+        )
+
+    def suppressed(self, f: Finding) -> bool:
+        """True when the finding's line (or a comment-only line directly
+        above) carries a matching ``# tpuml: ignore[...]`` marker."""
+        for lineno in (f.line, f.line - 1):
+            if not (1 <= lineno <= len(self.lines)):
+                continue
+            raw = self.lines[lineno - 1]
+            if lineno != f.line and not raw.strip().startswith("#"):
+                continue
+            m = _IGNORE_RE.search(raw)
+            if m and f.rule in {c.strip() for c in m.group(1).split(",")}:
+                return True
+        return False
+
+
+def iter_py_files(paths: Sequence[str], repo_root: str) -> List[str]:
+    """Expand CLI path operands into a sorted list of .py files."""
+    out: List[str] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap):
+            if ap.endswith(".py"):
+                out.append(ap)
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", ".pytest_cache")
+            ]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def load_source(abspath: str, repo_root: str) -> Tuple[Optional[SourceFile], Optional[Finding]]:
+    rel = os.path.relpath(abspath, repo_root).replace(os.sep, "/")
+    try:
+        with open(abspath, "r", encoding="utf-8") as f:
+            text = f.read()
+        tree = ast.parse(text, filename=abspath)
+    except (OSError, SyntaxError, ValueError) as e:
+        return None, Finding(
+            rule="TPU000",
+            path=rel,
+            line=getattr(e, "lineno", 1) or 1,
+            col=1,
+            message=f"file could not be parsed: {e}",
+        )
+    return SourceFile(path=rel, abspath=abspath, text=text, tree=tree), None
+
+
+# --- baseline --------------------------------------------------------------
+
+
+def load_baseline(path: str) -> List[Tuple[str, str, str]]:
+    """Grandfathered fingerprints; missing file = empty baseline."""
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return [
+        (e["path"], e["rule"], e.get("context", ""))
+        for e in data.get("findings", [])
+    ]
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    data = {
+        "comment": (
+            "Grandfathered tpuml-lint findings. Target: empty. New code "
+            "must fix or inline-suppress, never extend this file."
+        ),
+        "findings": [
+            {"path": f.path, "rule": f.rule, "context": f.context}
+            for f in sorted(findings, key=lambda f: (f.path, f.line))
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Sequence[Tuple[str, str, str]]
+) -> Tuple[List[Finding], List[Tuple[str, str, str]]]:
+    """(new findings, stale baseline entries). Each baseline fingerprint
+    absorbs any number of identical findings (a context line duplicated
+    within one file counts once — good enough for a target-empty file)."""
+    allowed = set(baseline)
+    new = [f for f in findings if f.fingerprint() not in allowed]
+    seen = {f.fingerprint() for f in findings}
+    stale = [b for b in baseline if b not in seen]
+    return new, stale
+
+
+# --- shared AST helpers ----------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def os_environ_aliases(tree: ast.AST) -> Tuple[set, set, set]:
+    """(os module aliases, bare 'environ' aliases, bare 'getenv' aliases)
+    bound by imports in this module."""
+    os_names, environ_names, getenv_names = set(), set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "os":
+                    os_names.add(a.asname or "os")
+        elif isinstance(node, ast.ImportFrom) and node.module == "os":
+            for a in node.names:
+                if a.name == "environ":
+                    environ_names.add(a.asname or "environ")
+                elif a.name == "getenv":
+                    getenv_names.add(a.asname or "getenv")
+    return os_names, environ_names, getenv_names
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def parents_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    """child -> parent for every node (one pass; rules share it)."""
+    out: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            out[child] = parent
+    return out
+
+
+LOOP_NODES = (ast.For, ast.While, ast.AsyncFor)
+COMPREHENSION_NODES = (
+    ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp
+)
+
+
+def enclosing(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST], kinds: tuple
+) -> Optional[ast.AST]:
+    """Nearest ancestor of one of ``kinds`` (not crossing function defs
+    unless the def itself matches)."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def enclosing_within_function(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST], kinds: tuple
+) -> Optional[ast.AST]:
+    """Like :func:`enclosing` but stops at the nearest enclosing function
+    boundary — a loop OUTSIDE the def that merely calls a helper is not a
+    per-iteration construction of anything inside the helper."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return None
+        cur = parents.get(cur)
+    return None

@@ -1,0 +1,48 @@
+"""Load the typed env-var registry without importing the package.
+
+``spark_rapids_ml_tpu/runtime/envspec.py`` is stdlib-only by contract,
+so it can be executed directly by file path — the doc-drift rule
+(TPU002) and ``scripts/gen_config_docs.py`` both work in environments
+where jax is absent.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import Any, Optional
+
+ENVSPEC_RELPATH = os.path.join(
+    "spark_rapids_ml_tpu", "runtime", "envspec.py"
+)
+
+_cache: dict = {}
+
+
+def repo_root_from(start: str) -> Optional[str]:
+    """Walk up from ``start`` to the directory containing the registry."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.exists(os.path.join(cur, ENVSPEC_RELPATH)):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return None
+        cur = nxt
+
+
+def load_envspec(repo_root: str) -> Any:
+    """The executed envspec module (cached per path)."""
+    path = os.path.join(repo_root, ENVSPEC_RELPATH)
+    if path in _cache:
+        return _cache[path]
+    spec = importlib.util.spec_from_file_location("_tpuml_lint_envspec", path)
+    assert spec is not None and spec.loader is not None, path
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass creation resolves the defining module through
+    # sys.modules, so the module must be registered before exec.
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    _cache[path] = mod
+    return mod

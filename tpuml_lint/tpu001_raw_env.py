@@ -1,0 +1,121 @@
+"""TPU001 — raw ``os.environ`` read of a ``TPUML_*`` name.
+
+Every ``TPUML_*`` knob is registered in
+``spark_rapids_ml_tpu/runtime/envspec.py``; reads must go through
+``envspec.get`` so parse failures name the variable and its accepted
+domain instead of dying in a bare ``int()``. Writes
+(``os.environ[k] = v``, ``pop``, ``del``, ``monkeypatch.setenv``) are
+allowed — tests must be able to set knobs; only *reads* bypass the
+registry's typing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import (
+    Finding,
+    SourceFile,
+    dotted_name,
+    os_environ_aliases,
+    str_const,
+)
+
+CODE = "TPU001"
+NAME = "raw-env-read"
+
+_READ_METHODS = ("get", "setdefault")
+
+
+def _is_environ(node: ast.AST, os_names: set, environ_names: set) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        base = dotted_name(node.value)
+        return base in os_names
+    if isinstance(node, ast.Name):
+        return node.id in environ_names
+    return False
+
+
+def _tpuml_arg(call: ast.Call) -> str:
+    for arg in call.args[:1]:
+        s = str_const(arg)
+        if s and s.startswith("TPUML_"):
+            return s
+    return ""
+
+
+def check_file(sf: SourceFile) -> Iterator[Finding]:
+    if sf.path.endswith("runtime/envspec.py"):
+        return
+    os_names, environ_names, getenv_names = os_environ_aliases(sf.tree)
+
+    def fixit(name: str) -> str:
+        return (
+            f"read it via the typed registry: "
+            f"envspec.get({name!r}) "
+            f"(from spark_rapids_ml_tpu.runtime import envspec)"
+        )
+
+    for node in ast.walk(sf.tree):
+        # os.environ.get("TPUML_X", ...) / os.environ.setdefault(...)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _READ_METHODS and _is_environ(
+                node.func.value, os_names, environ_names
+            ):
+                name = _tpuml_arg(node)
+                if name:
+                    yield sf.finding(
+                        CODE, node,
+                        f"raw os.environ.{node.func.attr} of {name!r} "
+                        f"bypasses the typed registry",
+                        fixit(name),
+                    )
+        # os.getenv("TPUML_X") / bare getenv(...)
+        if isinstance(node, ast.Call):
+            fn = dotted_name(node.func)
+            if fn is not None and (
+                any(fn == f"{o}.getenv" for o in os_names)
+                or fn in getenv_names
+            ):
+                name = _tpuml_arg(node)
+                if name:
+                    yield sf.finding(
+                        CODE, node,
+                        f"raw os.getenv of {name!r} bypasses the typed "
+                        f"registry",
+                        fixit(name),
+                    )
+        # os.environ["TPUML_X"] in Load context
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, ast.Load)
+            and _is_environ(node.value, os_names, environ_names)
+        ):
+            sl = node.slice
+            s = str_const(sl)
+            if s and s.startswith("TPUML_"):
+                yield sf.finding(
+                    CODE, node,
+                    f"raw os.environ[{s!r}] read bypasses the typed "
+                    f"registry",
+                    fixit(s),
+                )
+        # "TPUML_X" in os.environ
+        if isinstance(node, ast.Compare) and any(
+            isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+        ):
+            operands = [node.left] + list(node.comparators)
+            for left, right in zip(operands, operands[1:]):
+                s = str_const(left)
+                if (
+                    s
+                    and s.startswith("TPUML_")
+                    and _is_environ(right, os_names, environ_names)
+                ):
+                    yield sf.finding(
+                        CODE, node,
+                        f"membership test of {s!r} against os.environ "
+                        f"bypasses the typed registry",
+                        f"use envspec.is_set({s!r})",
+                    )

@@ -1,0 +1,165 @@
+"""TPU002 — env-var registry / docs drift.
+
+Project-level rule, four checks:
+
+1. every ``TPUML_*`` name the code touches (``envspec.get/...`` calls,
+   raw ``os.environ`` access, test ``setenv``) is registered in
+   ``runtime/envspec.py``;
+2. every registered variable appears in ``docs/configuration.md``, plus
+   any extra files its registration names (``also_documented_in`` —
+   e.g. the resilience knobs must appear in ``docs/fault_tolerance.md``);
+3. every ``TPUML_*`` token mentioned in those docs is registered (a doc
+   describing a deleted knob is drift too);
+4. the generated env table in ``docs/configuration.md`` (between the
+   ``tpuml-envspec`` markers) byte-matches what
+   ``scripts/gen_config_docs.py`` would emit from the registry today.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Tuple
+
+from .core import Finding, SourceFile, dotted_name, str_const
+from .envinfo import ENVSPEC_RELPATH, load_envspec
+
+CODE = "TPU002"
+NAME = "env-doc-drift"
+
+_DOC_FILES = ("docs/configuration.md", "docs/fault_tolerance.md")
+_TOKEN_RE = re.compile(r"\bTPUML_[A-Z0-9_]+\b")
+# registry functions whose first string arg is an env-var use
+_ENVSPEC_FNS = ("get", "get_raw", "is_set", "parse")
+# env writers whose first string arg asserts the var exists
+_WRITER_FNS = ("setenv", "delenv")
+
+
+def _used_names(sf: SourceFile) -> Iterator[Tuple[str, ast.AST]]:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = dotted_name(node.func)
+        if fn is None:
+            continue
+        leaf = fn.rsplit(".", 1)[-1]
+        if leaf in _ENVSPEC_FNS and "envspec" in fn:
+            s = str_const(node.args[0]) if node.args else None
+            if s and s.startswith("TPUML_"):
+                yield s, node
+        elif leaf in _WRITER_FNS or (leaf in ("get", "pop") and "environ" in fn):
+            s = str_const(node.args[0]) if node.args else None
+            if s and s.startswith("TPUML_"):
+                yield s, node
+
+
+def check_project(files: List[SourceFile], repo_root: str) -> Iterator[Finding]:
+    try:
+        envspec = load_envspec(repo_root)
+    except Exception as e:  # registry must at least load
+        yield Finding(
+            rule=CODE,
+            path=ENVSPEC_RELPATH.replace(os.sep, "/"),
+            line=1,
+            col=1,
+            message=f"could not load the env registry: {e}",
+        )
+        return
+    registered = set(envspec.SPEC)
+    spec_relpath = ENVSPEC_RELPATH.replace(os.sep, "/")
+
+    # registration line of each var (for fix-it anchors)
+    reg_lines: Dict[str, int] = {}
+    spec_path = os.path.join(repo_root, ENVSPEC_RELPATH)
+    with open(spec_path, "r", encoding="utf-8") as f:
+        spec_lines = f.read().splitlines()
+    for i, line in enumerate(spec_lines, 1):
+        for tok in _TOKEN_RE.findall(line):
+            reg_lines.setdefault(tok, i)
+
+    # 1. used-but-unregistered
+    for sf in files:
+        if sf.path == spec_relpath:
+            continue
+        for name, node in _used_names(sf):
+            if name not in registered:
+                yield sf.finding(
+                    CODE, node,
+                    f"{name} is used in code but not registered in "
+                    f"{spec_relpath}",
+                    f"add an EnvVar({name!r}, ...) entry to the registry "
+                    f"and run scripts/gen_config_docs.py",
+                )
+
+    # 2. registered-but-undocumented + 3. documented-but-unregistered
+    doc_text: Dict[str, str] = {}
+    for rel in _DOC_FILES:
+        p = os.path.join(repo_root, rel)
+        if os.path.exists(p):
+            with open(p, "r", encoding="utf-8") as f:
+                doc_text[rel] = f.read()
+
+    for name, var in envspec.SPEC.items():
+        required = ("docs/configuration.md",) + tuple(
+            getattr(var, "also_documented_in", ())
+        )
+        for rel in required:
+            text = doc_text.get(rel)
+            if text is None:
+                yield Finding(
+                    rule=CODE, path=rel, line=1, col=1,
+                    message=f"documentation file missing (required for "
+                            f"{name})",
+                )
+            elif name not in text:
+                yield Finding(
+                    rule=CODE,
+                    path=spec_relpath,
+                    line=reg_lines.get(name, 1),
+                    col=1,
+                    message=f"{name} is registered but absent from {rel}",
+                    fixit="run scripts/gen_config_docs.py (configuration.md "
+                          "table) or mention the variable in the doc's prose",
+                    context=name,
+                )
+
+    for rel, text in doc_text.items():
+        for i, line in enumerate(text.splitlines(), 1):
+            for tok in sorted(set(_TOKEN_RE.findall(line))):
+                if tok not in registered:
+                    yield Finding(
+                        rule=CODE, path=rel, line=i, col=1,
+                        message=f"{tok} is documented here but not "
+                                f"registered in {spec_relpath}",
+                        fixit="register the variable or delete the stale "
+                              "doc reference",
+                        context=tok,
+                    )
+
+    # 4. generated-table drift
+    conf = doc_text.get("docs/configuration.md")
+    if conf is not None:
+        expected = list(envspec.doc_table_lines())
+        begin, end = envspec.TABLE_BEGIN, envspec.TABLE_END
+        lines = conf.splitlines()
+        try:
+            b = lines.index(begin)
+            e = lines.index(end)
+            actual = lines[b : e + 1]
+        except ValueError:
+            yield Finding(
+                rule=CODE, path="docs/configuration.md", line=1, col=1,
+                message="generated env-var table markers not found "
+                        "(tpuml-envspec:begin/end)",
+                fixit="run scripts/gen_config_docs.py",
+            )
+            return
+        if actual != expected:
+            yield Finding(
+                rule=CODE, path="docs/configuration.md", line=b + 1, col=1,
+                message="generated env-var table is stale (does not match "
+                        "the registry)",
+                fixit="run scripts/gen_config_docs.py",
+                context="<envspec table>",
+            )

@@ -1,0 +1,117 @@
+"""TPU003 — jit/pallas_call constructed per iteration or per call.
+
+``jax.jit(fn)`` keys its compilation cache on the *callable object*; a
+fresh lambda (or a fresh ``functools.partial``) on every loop iteration
+or every call of an outer function means a fresh cache entry and a full
+XLA recompile each time. Same story for ``pl.pallas_call`` built inside
+a loop. The fix is always the same: hoist the construction to module
+level (or decorate a module-level def) so one traced program is reused.
+
+Flagged:
+
+* ``jax.jit(...)`` / ``functools.partial(jax.jit, ...)`` /
+  ``pl.pallas_call(...)`` whose nearest statement-level ancestor within
+  the enclosing function is a loop or comprehension;
+* ``jax.jit(<lambda or local fn>)(args)`` — construct-and-invoke inside
+  any function body, the sneakier per-call variant of the same bug.
+
+Not flagged: jit as a decorator, jit assigned at module level, and
+``pallas_call(...)(operands)`` immediately invoked — the pallas_call
+object itself is cheap and the repo's kernel wrappers are themselves
+module-level-cached jits.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .core import (
+    COMPREHENSION_NODES,
+    Finding,
+    LOOP_NODES,
+    SourceFile,
+    dotted_name,
+    enclosing_within_function,
+    parents_map,
+)
+
+CODE = "TPU003"
+NAME = "jit-in-loop"
+
+_JIT_NAMES = ("jax.jit", "jit")
+_PALLAS_NAMES = ("pl.pallas_call", "pallas_call", "jax.experimental.pallas.pallas_call")
+_PARTIAL_NAMES = ("functools.partial", "partial")
+
+
+def _call_kind(node: ast.Call) -> Optional[str]:
+    """'jit' | 'pallas_call' | None for the construction this call does."""
+    fn = dotted_name(node.func)
+    if fn in _JIT_NAMES:
+        return "jit"
+    if fn in _PALLAS_NAMES:
+        return "pallas_call"
+    if fn in _PARTIAL_NAMES and node.args:
+        inner = dotted_name(node.args[0])
+        if inner in _JIT_NAMES:
+            return "jit"
+        if inner in _PALLAS_NAMES:
+            return "pallas_call"
+    return None
+
+
+def _is_decorator(node: ast.Call, parents) -> bool:
+    parent = parents.get(node)
+    return isinstance(
+        parent, (ast.FunctionDef, ast.AsyncFunctionDef)
+    ) and node in parent.decorator_list
+
+
+def check_file(sf: SourceFile) -> Iterator[Finding]:
+    parents = parents_map(sf.tree)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _call_kind(node)
+        if kind is None or _is_decorator(node, parents):
+            continue
+
+        loop = enclosing_within_function(
+            node, parents, LOOP_NODES + COMPREHENSION_NODES
+        )
+        if loop is not None:
+            yield sf.finding(
+                CODE, node,
+                f"{kind} constructed inside a loop — every iteration gets "
+                f"a fresh compilation cache entry (recompile hazard)",
+                "hoist the construction to module level (or a @functools."
+                "lru_cache'd factory keyed on static config) and reuse it",
+            )
+            continue
+
+        # jax.jit(<fresh callable>)(...) immediately invoked inside a def:
+        # recompiles on every call of the enclosing function.
+        if kind == "jit":
+            parent = parents.get(node)
+            if (
+                isinstance(parent, ast.Call)
+                and parent.func is node
+                and _in_function(node, parents)
+            ):
+                yield sf.finding(
+                    CODE, node,
+                    "jax.jit(...) constructed and invoked per call — the "
+                    "jit cache keys on the callable object, so this "
+                    "retraces every time the enclosing function runs",
+                    "bind the jitted callable once at module level and "
+                    "call the cached object here",
+                )
+
+
+def _in_function(node: ast.AST, parents) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return True
+        cur = parents.get(cur)
+    return False

@@ -1,0 +1,167 @@
+"""TPU004 — nondeterminism hazards in fit / kernel code.
+
+The repo's checkpoint/resume contract (PR 3) requires bit-identical
+replays: a fit interrupted at epoch k and resumed must produce the same
+model as an uninterrupted run. That only holds when every random stream
+is derived from an explicit seed and every epoch's key comes from
+``jax.random.fold_in(base, absolute_epoch)``.
+
+Flagged:
+
+* module-global numpy RNG: ``np.random.seed/rand/randn/randint/
+  uniform/normal/shuffle/permutation/choice`` (shared mutable state;
+  use ``np.random.default_rng(seed)``);
+* stdlib ``random.<fn>()`` module-level calls — ``random.Random(seed)``
+  / ``random.SystemRandom()`` instances are fine (retry jitter uses a
+  seeded instance deliberately);
+* wall-clock reads (``time.time``/``time.time_ns``/
+  ``datetime.datetime.now``/``utcnow``) inside a jit-decorated function
+  or a pallas kernel body — under tracing these bake in a constant from
+  compile time, which is both nondeterministic across runs and silently
+  stale across cache hits;
+* ``jax.random.PRNGKey``/``jax.random.key`` constructed inside a loop —
+  per-epoch keys must come from ``fold_in`` on an absolute step index,
+  not repeated key construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from .core import (
+    COMPREHENSION_NODES,
+    Finding,
+    LOOP_NODES,
+    SourceFile,
+    dotted_name,
+    enclosing_within_function,
+    parents_map,
+)
+
+CODE = "TPU004"
+NAME = "nondeterminism"
+
+_NP_GLOBAL_RNG = frozenset({
+    "seed", "rand", "randn", "randint", "random", "uniform", "normal",
+    "shuffle", "permutation", "choice", "standard_normal",
+})
+_NP_ALIASES = ("np.random.", "numpy.random.")
+_RANDOM_OK = frozenset({"Random", "SystemRandom", "getstate", "setstate"})
+_CLOCK_NAMES = frozenset({
+    "time.time", "time.time_ns", "time.perf_counter",
+    "time.monotonic", "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.now", "datetime.utcnow",
+})
+_KEY_NAMES = ("jax.random.PRNGKey", "jax.random.key", "jrandom.PRNGKey", "jr.PRNGKey")
+_JIT_DECOR = ("jax.jit", "jit", "pl.pallas_call", "pallas_call")
+_PARTIALS = ("functools.partial", "partial")
+
+
+def _stdlib_random_aliases(tree: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "random":
+                    names.add(a.asname or "random")
+    return names
+
+
+def _decorator_is_traced(dec: ast.AST) -> bool:
+    """True for @jax.jit, @partial(jax.jit, ...), @pl.pallas_call-ish."""
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        if fn in _JIT_DECOR:
+            return True
+        if fn in _PARTIALS and dec.args:
+            return dotted_name(dec.args[0]) in _JIT_DECOR
+        return False
+    return dotted_name(dec) in _JIT_DECOR
+
+
+def _kernel_like(fn: ast.AST) -> bool:
+    """Heuristic for pallas kernel bodies: `*_kernel(... ref ...)` defs."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    if fn.name.endswith("_kernel") or fn.name == "kernel":
+        return True
+    args = [a.arg for a in fn.args.args]
+    return sum(1 for a in args if a.endswith("_ref") or a == "ref") >= 2
+
+
+def _traced_context(node: ast.AST, parents) -> Optional[str]:
+    """Name of the enclosing jit-decorated or kernel-like def, if any."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_decorator_is_traced(d) for d in cur.decorator_list):
+                return cur.name
+            if _kernel_like(cur):
+                return cur.name
+        cur = parents.get(cur)
+    return None
+
+
+def check_file(sf: SourceFile) -> Iterator[Finding]:
+    parents = parents_map(sf.tree)
+    random_aliases = _stdlib_random_aliases(sf.tree)
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = dotted_name(node.func)
+        if fn is None:
+            continue
+
+        # numpy module-global RNG
+        for prefix in _NP_ALIASES:
+            if fn.startswith(prefix) and fn[len(prefix):] in _NP_GLOBAL_RNG:
+                yield sf.finding(
+                    CODE, node,
+                    f"{fn}() draws from numpy's shared module-global RNG "
+                    f"(order-dependent, not seedable per-fit)",
+                    "use a local generator: rng = np.random.default_rng("
+                    "seed); rng.<method>(...)",
+                )
+                break
+
+        # stdlib random module-level calls
+        for alias in random_aliases:
+            if fn.startswith(alias + "."):
+                leaf = fn[len(alias) + 1:]
+                if "." not in leaf and leaf not in _RANDOM_OK:
+                    yield sf.finding(
+                        CODE, node,
+                        f"{fn}() uses the process-global stdlib RNG",
+                        "use a seeded instance: rng = random.Random(seed)",
+                    )
+                break
+
+        # wall clock inside traced/kernel code
+        if fn in _CLOCK_NAMES:
+            ctx = _traced_context(node, parents)
+            if ctx is not None:
+                yield sf.finding(
+                    CODE, node,
+                    f"{fn}() inside traced/kernel function {ctx!r} is "
+                    f"evaluated once at trace time and baked into the "
+                    f"compiled program",
+                    "time outside the jitted call, or pass the value in "
+                    "as an argument",
+                )
+
+        # PRNGKey construction inside a loop
+        if fn in _KEY_NAMES:
+            loop = enclosing_within_function(
+                node, parents, LOOP_NODES + COMPREHENSION_NODES
+            )
+            if loop is not None:
+                yield sf.finding(
+                    CODE, node,
+                    f"{fn} constructed inside a loop — per-epoch keys "
+                    f"built this way break the segmented==fused resume "
+                    f"contract",
+                    "derive per-step keys from one base key: "
+                    "jax.random.fold_in(base_key, absolute_step)",
+                )

@@ -1,0 +1,157 @@
+"""TPU005 — static_argnames/static_argnums hazards.
+
+``jax.jit(..., static_argnames=...)`` retraces whenever a static
+argument's value changes, and dies with an unhashable-type error when a
+traced array (or any unhashable value) lands in a static slot. Two
+classes of bug are pure-statically detectable:
+
+* a ``static_argnames`` entry that names no parameter of the decorated
+  function (typo, or a rename that forgot the decorator) — jax only
+  errors on some versions, silently ignores on others;
+* a parameter declared static whose *default* is unhashable
+  (list/dict/set) — every defaulted call site dies at the jit cache
+  lookup;
+* a ``static_argnums`` index outside the function's positional arity.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from .core import Finding, SourceFile, dotted_name, str_const
+
+CODE = "TPU005"
+NAME = "static-args"
+
+_JIT_NAMES = ("jax.jit", "jit")
+_PARTIALS = ("functools.partial", "partial")
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+
+def _jit_call(dec: ast.AST) -> Optional[ast.Call]:
+    """The jit(...) Call behind a decorator/assignment RHS, if any."""
+    if not isinstance(dec, ast.Call):
+        return None
+    fn = dotted_name(dec.func)
+    if fn in _JIT_NAMES:
+        return dec
+    if fn in _PARTIALS and dec.args and dotted_name(dec.args[0]) in _JIT_NAMES:
+        return dec
+    return None
+
+
+def _static_spec(call: ast.Call) -> Tuple[List[Tuple[str, ast.AST]], List[Tuple[int, ast.AST]]]:
+    """(names, nums) declared static, each with the AST node to anchor on."""
+    names: List[Tuple[str, ast.AST]] = []
+    nums: List[Tuple[int, ast.AST]] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            s = str_const(v)
+            if s is not None:
+                names.append((s, v))
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for elt in v.elts:
+                    s = str_const(elt)
+                    if s is not None:
+                        names.append((s, elt))
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.append((v.value, v))
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                        nums.append((elt.value, elt))
+    return names, nums
+
+
+def _check_against(
+    sf: SourceFile, call: ast.Call, fn: ast.FunctionDef
+) -> Iterator[Finding]:
+    names, nums = _static_spec(call)
+    if not names and not nums:
+        return
+
+    pos_args = list(fn.args.posonlyargs) + list(fn.args.args)
+    all_params = pos_args + list(fn.args.kwonlyargs)
+    param_names = {a.arg for a in all_params}
+    has_kwargs = fn.args.kwarg is not None
+
+    # defaults align to the tail of pos_args / all of kwonlyargs
+    default_of = {}
+    for a, d in zip(pos_args[len(pos_args) - len(fn.args.defaults):], fn.args.defaults):
+        default_of[a.arg] = d
+    for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if d is not None:
+            default_of[a.arg] = d
+
+    for name, node in names:
+        if name not in param_names and not has_kwargs:
+            yield sf.finding(
+                CODE, node,
+                f"static_argnames entry {name!r} names no parameter of "
+                f"{fn.name}() (params: {', '.join(sorted(param_names))})",
+                "fix the name — some jax versions silently ignore unknown "
+                "static_argnames, so the argument is traced and every "
+                "distinct value recompiles",
+            )
+            continue
+        d = default_of.get(name)
+        if d is not None and isinstance(d, _UNHASHABLE):
+            yield sf.finding(
+                CODE, node,
+                f"static parameter {name!r} of {fn.name}() defaults to an "
+                f"unhashable {type(d).__name__.lower()} — defaulted calls "
+                f"fail at the jit cache lookup",
+                "use a hashable default (tuple / frozenset / None)",
+            )
+
+    arity = len(pos_args)
+    for num, node in nums:
+        if num >= arity or num < -arity:
+            yield sf.finding(
+                CODE, node,
+                f"static_argnums index {num} is outside {fn.name}()'s "
+                f"{arity} positional parameter(s)",
+                "point static_argnums at a real positional parameter",
+            )
+        else:
+            a = pos_args[num]
+            d = default_of.get(a.arg)
+            if d is not None and isinstance(d, _UNHASHABLE):
+                yield sf.finding(
+                    CODE, node,
+                    f"static parameter {a.arg!r} (argnum {num}) of "
+                    f"{fn.name}() defaults to an unhashable "
+                    f"{type(d).__name__.lower()}",
+                    "use a hashable default (tuple / frozenset / None)",
+                )
+
+
+def check_file(sf: SourceFile) -> Iterator[Finding]:
+    for node in ast.walk(sf.tree):
+        # @jax.jit / @partial(jax.jit, static_argnames=...) decorators
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                call = _jit_call(dec)
+                if call is not None and isinstance(node, ast.FunctionDef):
+                    yield from _check_against(sf, call, node)
+        # name = jax.jit(local_fn, static_argnames=...) where local_fn's
+        # def is visible in the same module
+        if isinstance(node, ast.Assign):
+            call = _jit_call(node.value)
+            if call is not None and call.args:
+                target = dotted_name(call.args[0])
+                if target is not None and "." not in target:
+                    fndef = _find_def(sf.tree, target)
+                    if fndef is not None:
+                        yield from _check_against(sf, call, fndef)
+
+
+def _find_def(tree: ast.AST, name: str) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None

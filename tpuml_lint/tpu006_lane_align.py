@@ -1,0 +1,97 @@
+"""TPU006 — Pallas block shapes with an unaligned minor dimension.
+
+The TPU vector unit operates on (8, 128) tiles; a BlockSpec (or
+``pltpu.PrefetchScalarGridSpec`` block shape) whose *minor* (last)
+dimension is a literal not divisible by 128 forces the Mosaic compiler
+into padded, partially-masked lanes — or fails to lower outright.
+Symbolic dims (``bn``, ``feat_pad``, …) are assumed already rounded by
+the caller (the repo rounds with ``_round_up(x, 128)`` helpers);
+only literal offenders are flagged.
+
+Exempt: 0-d/1-element scalar specs and specs whose ``memory_space`` is
+SMEM/ANY — scalars don't live in lanes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from .core import Finding, SourceFile, dotted_name
+
+CODE = "TPU006"
+NAME = "lane-align"
+
+_BLOCKSPEC_NAMES = ("pl.BlockSpec", "BlockSpec", "pallas.BlockSpec")
+_SMEM_MARKERS = ("SMEM", "ANY", "smem")
+LANE = 128
+
+
+def _shape_tuple(node: ast.AST) -> Optional[List[ast.AST]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return list(node.elts)
+    return None
+
+
+def _minor_literal(elts: List[ast.AST]) -> Optional[int]:
+    """Value of the last dim if it's an int literal, else None."""
+    if not elts:
+        return None
+    last = elts[-1]
+    if isinstance(last, ast.Constant) and isinstance(last.value, int):
+        return last.value
+    return None
+
+
+def _spec_is_scalar_space(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "memory_space":
+            src = ast.dump(kw.value)
+            return any(m in src for m in _SMEM_MARKERS)
+    return False
+
+
+def _block_shape_arg(call: ast.Call) -> Optional[List[ast.AST]]:
+    """The block-shape tuple of a BlockSpec call, positional or kw."""
+    for kw in call.keywords:
+        if kw.arg == "block_shape":
+            return _shape_tuple(kw.value)
+    # modern signature: BlockSpec(block_shape, index_map); legacy:
+    # BlockSpec(index_map, block_shape) — try any tuple positional.
+    for arg in call.args:
+        t = _shape_tuple(arg)
+        if t is not None:
+            return t
+    return None
+
+
+def check_file(sf: SourceFile) -> Iterator[Finding]:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = dotted_name(node.func)
+        if fn not in _BLOCKSPEC_NAMES:
+            continue
+        if _spec_is_scalar_space(node):
+            continue
+        elts = _block_shape_arg(node)
+        if elts is None or len(elts) < 2:
+            # 0-d/1-d scalar-ish specs: lane tiling doesn't apply the
+            # same way; the repo's (1, 1) specs are SMEM scalars.
+            continue
+        minor = _minor_literal(elts)
+        if minor is None:
+            continue
+        if minor == 1 and all(
+            isinstance(e, ast.Constant) and e.value == 1 for e in elts
+        ):
+            continue  # (1, 1) scalar spec
+        if minor % LANE != 0:
+            yield sf.finding(
+                CODE, node,
+                f"BlockSpec minor dimension {minor} is not a multiple of "
+                f"{LANE} — TPU lanes are {LANE}-wide, so this block is "
+                f"padded and partially masked on every access",
+                f"round the minor dim up to a multiple of {LANE} (pad the "
+                f"array) or derive it from a _round_up(x, {LANE}) helper",
+            )
