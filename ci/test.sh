@@ -84,6 +84,48 @@ os.environ.pop("TPUML_UMAP_OPT")
 print("umap engine dispatch smoke OK")
 EOF
 
+echo "== ivf graph + ann kneighbors smoke =="
+# TPUML_UMAP_GRAPH=ivf must drive a full UMAP fit through the IVF-Flat
+# graph engine, and the ApproximateNearestNeighbors estimator must answer
+# kneighbors through the probe search at recall >= 0.95 on blobs.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+import numpy as np
+from sklearn.datasets import make_blobs
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+from spark_rapids_ml_tpu.umap import UMAP
+
+X, _ = make_blobs(n_samples=2000, n_features=16, centers=12, random_state=7)
+X = X.astype(np.float32)
+
+os.environ["TPUML_UMAP_GRAPH"] = "ivf"
+model = UMAP(
+    n_neighbors=10, n_epochs=10, random_state=0, init="random",
+    num_workers=1,
+).fit(DataFrame({"features": X}))
+assert model._fit_report["graph_engine"] == "ivf", model._fit_report
+os.environ.pop("TPUML_UMAP_GRAPH")
+
+os.environ["TPUML_ANN_GATE_ROWS"] = "1"
+ann = ApproximateNearestNeighbors(k=15, num_workers=1).fit(
+    DataFrame({"features": X})
+)
+_, _, knn_df = ann.kneighbors(DataFrame({"features": X[:128]}))
+assert ann._ann_report["engine"] == "ivf", ann._ann_report
+os.environ.pop("TPUML_ANN_GATE_ROWS")
+
+from sklearn.neighbors import NearestNeighbors as SkNN
+
+_, exact = SkNN(n_neighbors=15, algorithm="brute").fit(X).kneighbors(X[:128])
+got = np.asarray(knn_df["indices"])
+recall = np.mean([len(set(g) & set(e)) / 15 for g, e in zip(got, exact)])
+assert recall >= 0.95, f"ann recall {recall:.4f} < 0.95"
+print(f"ivf graph + ann smoke OK (recall {recall:.4f})")
+EOF
+
 echo "== fault-injection + checkpoint/resume smoke =="
 # Resilience contract (docs/fault_tolerance.md): a fit killed mid-iteration
 # by an injected preemption, refit with TPUML_CKPT_DIR set, resumes from

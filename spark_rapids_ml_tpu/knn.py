@@ -1,6 +1,16 @@
 """Drop-in module alias: ``spark_rapids_ml_tpu.knn`` ≙ reference
 ``spark_rapids_ml.knn`` (``/root/reference/python/src/spark_rapids_ml/knn.py``)."""
 
-from .models.knn import NearestNeighbors, NearestNeighborsModel
+from .models.knn import (
+    ApproximateNearestNeighbors,
+    ApproximateNearestNeighborsModel,
+    NearestNeighbors,
+    NearestNeighborsModel,
+)
 
-__all__ = ["NearestNeighbors", "NearestNeighborsModel"]
+__all__ = [
+    "ApproximateNearestNeighbors",
+    "ApproximateNearestNeighborsModel",
+    "NearestNeighbors",
+    "NearestNeighborsModel",
+]

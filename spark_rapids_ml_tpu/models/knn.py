@@ -258,18 +258,7 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
             idx = np.asarray(idx)[:nq]
             item_ids = ids_arr
 
-        distances = np.sqrt(np.maximum(d2, 0.0)).astype(np.float32)
-        indices = item_ids[np.clip(idx, 0, len(item_ids) - 1)]
-
-        query_ids = np.asarray(query_df_withid.column(id_col))
-        order = np.argsort(query_ids, kind="stable")
-        knn_df = DataFrame(
-            {
-                f"query_{id_col}": query_ids[order],
-                "indices": indices[order],
-                "distances": distances[order],
-            }
-        )
+        knn_df = self._knn_result_df(query_df_withid, d2, idx, item_ids)
         return item_df, query_df_withid, knn_df
 
     def exactNearestNeighborsJoin(
@@ -359,6 +348,31 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
         data[distCol] = flat_dist
         return DataFrame(data)
 
+    # -- id mapping + result assembly (shared with the ANN subclass) -------
+    def _knn_result_df(
+        self,
+        query_df_withid: DataFrame,
+        d2: np.ndarray,
+        idx: np.ndarray,
+        item_ids: np.ndarray,
+    ) -> DataFrame:
+        """Assemble the ``(query_<id>, indices, distances)`` result frame
+        from squared distances + global item positions, sorted by query id
+        — one definition for the exact ring and the IVF probe search so
+        the output contract cannot diverge."""
+        id_col = self.getIdCol()
+        distances = np.sqrt(np.maximum(d2, 0.0)).astype(np.float32)
+        indices = item_ids[np.clip(idx, 0, len(item_ids) - 1)]
+        query_ids = np.asarray(query_df_withid.column(id_col))
+        order = np.argsort(query_ids, kind="stable")
+        return DataFrame(
+            {
+                f"query_{id_col}": query_ids[order],
+                "indices": indices[order],
+                "distances": distances[order],
+            }
+        )
+
     # -- unsupported surfaces (parity with reference) ----------------------
     def transform(self, dataset: DataFrame) -> DataFrame:
         raise NotImplementedError(
@@ -378,3 +392,290 @@ class NearestNeighborsModel(NearestNeighborsClass, _TpuModel, _NearestNeighborsP
         raise NotImplementedError(
             "NearestNeighborsModel does not support saving/loading, just re-fit the estimator to re-create a model."
         )
+
+
+# ==========================================================================
+# Approximate nearest neighbors (IVF-Flat) — reference ``knn.py:693-1170``
+# ==========================================================================
+
+_ANN_ALGO_KEYS = frozenset(("nlist", "nprobe", "seed"))
+
+
+def _algo_params_conv(value: Any) -> Optional[Dict[str, int]]:
+    """``algoParams`` converter: None or a {nlist, nprobe, seed} -> int
+    mapping (the reference's cuvs ``algo_params`` dict, restricted to the
+    keys the TPU IVF-Flat engine understands). Unknown keys raise rather
+    than silently doing nothing."""
+    if value is None:
+        return None
+    if not isinstance(value, dict):
+        raise TypeError(
+            f"algoParams must be a dict or None, got {type(value).__name__}"
+        )
+    unknown = set(value) - _ANN_ALGO_KEYS
+    if unknown:
+        raise ValueError(
+            f"algoParams keys {sorted(unknown)} not supported; "
+            f"accepted: {sorted(_ANN_ALGO_KEYS)}"
+        )
+    return {k: int(v) for k, v in value.items()}
+
+
+class ApproximateNearestNeighborsClass(NearestNeighborsClass):
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        return {
+            "k": "n_neighbors",
+            "algorithm": "algorithm",
+            "algoParams": "algoParams",
+        }
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {"n_neighbors": 5, "algorithm": "ivfflat", "algoParams": None}
+
+
+class _ApproximateNearestNeighborsParams(_NearestNeighborsParams):
+    algorithm = _mk(
+        "algorithm",
+        "ANN algorithm (only ivfflat is supported)",
+        TypeConverters.toString,
+    )
+    algoParams = _mk(
+        "algoParams",
+        "algorithm tuning dict: nlist, nprobe, seed (unset keys fall back "
+        "to TPUML_ANN_NLIST/TPUML_ANN_NPROBE, then heuristics)",
+        _algo_params_conv,
+    )
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(algorithm="ivfflat")
+
+    def getAlgorithm(self) -> str:
+        return self.getOrDefault("algorithm")
+
+    def setAlgorithm(self, value: str) -> "_ApproximateNearestNeighborsParams":
+        self._set_params(algorithm=value)  # type: ignore[attr-defined]
+        return self
+
+    def getAlgoParams(self) -> Optional[Dict[str, int]]:
+        return (
+            self.getOrDefault("algoParams")
+            if self.isDefined("algoParams") and self.isSet("algoParams")
+            else None
+        )
+
+    def setAlgoParams(
+        self, value: Optional[Dict[str, int]]
+    ) -> "_ApproximateNearestNeighborsParams":
+        self._set_params(algoParams=value)  # type: ignore[attr-defined]
+        return self
+
+    def _check_algorithm(self) -> None:
+        algo = self.getAlgorithm()
+        if algo != "ivfflat":
+            raise ValueError(
+                f"algorithm={algo!r} is not supported; only 'ivfflat' is "
+                "(the reference's cagra/ivfpq backends have no TPU engine)"
+            )
+
+    def _resolved_algo_params(self, n_items: int) -> Tuple[int, int, int]:
+        """Validated (nlist, nprobe, seed) for an ``n_items`` index:
+        ``algoParams`` wins over the ``TPUML_ANN_*`` env overrides, which
+        win over the sqrt(n) heuristics. Raises ``ValueError`` on
+        out-of-domain values."""
+        from ..ops.ivf_kernels import resolve_ann_params
+
+        ap = self.getAlgoParams() or {}
+        nlist, nprobe = resolve_ann_params(
+            n_items, nlist=ap.get("nlist"), nprobe=ap.get("nprobe")
+        )
+        return nlist, nprobe, int(ap.get("seed", 0))
+
+
+class ApproximateNearestNeighbors(
+    ApproximateNearestNeighborsClass,
+    _TpuEstimator,
+    _ApproximateNearestNeighborsParams,
+):
+    """``ApproximateNearestNeighbors(k=3, algorithm="ivfflat",
+    algoParams={"nlist": 64, "nprobe": 8}).fit(item_df)`` — IVF-Flat
+    approximate kNN (reference ``knn.py:693-905``). ``kneighbors`` output
+    is identical in shape and semantics to the exact estimator's; below
+    ``TPUML_ANN_GATE_ROWS`` items the model answers with the exact ring
+    (the probe overhead beats nothing at small n — and the result is then
+    exact, not approximate)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        _TpuEstimator.__init__(self)
+        _ApproximateNearestNeighborsParams.__init__(self)
+        if kwargs.pop("float32_inputs", True) is False:
+            self.logger.warning(
+                "This estimator does not support double precision inputs; ignoring"
+            )
+        self._set_params(**kwargs)
+
+    def fit(
+        self, dataset: DataFrame, params: Optional[Dict[Any, Any]] = None
+    ) -> "ApproximateNearestNeighborsModel":
+        if params:
+            est = self.copy()
+            self._copy_tpu_params(est)
+            kw = {p.name if hasattr(p, "name") else p: v for p, v in params.items()}
+            est._set_params(**kw)
+            return est.fit(dataset)
+        # fail fast on a bad algorithm/algoParams surface — before any
+        # query-time compute (reference validates in the constructor)
+        self._check_algorithm()
+        _algo_params_conv(self.getAlgoParams())
+        item_df_withid = self._ensureIdCol(dataset)
+        model = ApproximateNearestNeighborsModel(item_df=item_df_withid)
+        self._copyValues(model)
+        self._copy_tpu_params(model)
+        return model
+
+    def _fit(self, dataset: DataFrame) -> "ApproximateNearestNeighborsModel":
+        return self.fit(dataset)
+
+    def _get_tpu_fit_func(self, dataset: DataFrame):  # pragma: no cover
+        raise NotImplementedError("ApproximateNearestNeighbors overrides fit directly")
+
+    def _create_model(self, result: Dict[str, Any]):  # pragma: no cover
+        raise NotImplementedError("ApproximateNearestNeighbors overrides fit directly")
+
+    def write(self) -> Any:
+        raise NotImplementedError(
+            "ApproximateNearestNeighbors does not support saving/loading, just re-create the estimator."
+        )
+
+    @classmethod
+    def read(cls) -> Any:
+        raise NotImplementedError(
+            "ApproximateNearestNeighbors does not support saving/loading, just re-create the estimator."
+        )
+
+
+class ApproximateNearestNeighborsModel(
+    ApproximateNearestNeighborsClass,
+    NearestNeighborsModel,
+    _ApproximateNearestNeighborsParams,
+):
+    """Reference ``knn.py:908-1170``. ``kneighbors`` runs the IVF-Flat
+    probe search (``ops/ivf_kernels.py``) against an index built lazily on
+    first use and cached on the model; below the row gate (or on an
+    infeasible shape) it falls back to the exact ring via the parent."""
+
+    def __init__(self, item_df: DataFrame, **attrs: Any) -> None:
+        _TpuModel.__init__(self, **attrs)
+        _ApproximateNearestNeighborsParams.__init__(self)
+        self._item_df_withid = item_df
+
+    def _ivf_index(self, Xi: np.ndarray, nlist: int, seed: int):
+        """Build-once index cache: keyed by the parameters that change the
+        layout (the item set is frozen at fit)."""
+        from ..ops.ivf_kernels import build_ivf_index
+
+        cache = getattr(self, "_ivf_index_cache", None)
+        if cache is None:
+            cache = self._ivf_index_cache = {}
+        key = (nlist, seed, Xi.shape[0])
+        if key not in cache:
+            cache[key] = build_ivf_index(Xi, nlist=nlist, seed=seed)
+        return cache[key]
+
+    def kneighbors(
+        self, query_df: DataFrame
+    ) -> Tuple[DataFrame, DataFrame, DataFrame]:
+        from ..ops.ivf_kernels import (
+            ivf_feasible,
+            ivf_search,
+            resolve_ann_gate_rows,
+        )
+        from ..parallel.context import ensure_distributed
+        from ..parallel.mesh import (
+            allgather_ragged_any,
+            allgather_ragged_rows,
+            global_row_count,
+            local_row_block,
+        )
+        from ..utils.profiling import StageTimer
+
+        ensure_distributed()  # idempotent (package import already ran it)
+        self._check_algorithm()
+        nproc = jax.process_count()
+        k = self.getK()
+        item_df = self._item_df_withid
+        n_items = global_row_count(item_df.count())
+        if k > n_items:
+            raise ValueError(f"k={k} must be <= number of item rows {n_items}")
+        # resolve + validate FIRST: bad nlist/nprobe must raise even when
+        # the gate would route this call to the exact engine anyway
+        nlist, nprobe, seed = self._resolved_algo_params(n_items)
+        gated = n_items >= resolve_ann_gate_rows()
+        feasible = ivf_feasible(n_items, k, nlist, nprobe)
+        if not (gated and feasible):
+            if gated:
+                self.logger.warning(
+                    "ivfflat infeasible for shape (n_items=%d, k=%d, "
+                    "nlist=%d, nprobe=%d); answering with the exact ring",
+                    n_items, k, nlist, nprobe,
+                )
+            out = super().kneighbors(query_df)
+            self._ann_report = {
+                "engine": "exact", "nlist": nlist, "nprobe": nprobe,
+            }
+            return out
+
+        query_df_withid = self._ensureIdCol(query_df)
+        id_col = self.getIdCol()
+        Xi = self._resolve_features(item_df)
+        Xq = self._resolve_features(query_df_withid)
+        if Xi.shape[1] != Xq.shape[1]:
+            raise ValueError(
+                f"item/query dims differ: {Xi.shape[1]} vs {Xq.shape[1]}"
+            )
+        ids_arr = np.asarray(item_df.column(id_col))
+        if nproc > 1:
+            # the IVF index is REPLICATED over the global item set (like a
+            # broadcast FAISS shard): gather features + ids in rank order
+            # so index positions map 1:1 onto the gathered id vector. The
+            # ragged byte gather keeps the user's id dtype exact.
+            Xi = allgather_ragged_rows(Xi)
+            ids_arr = allgather_ragged_any(ids_arr)
+
+        timer = StageTimer("ann.kneighbors")
+        with timer.stage("build"):
+            index = self._ivf_index(Xi, nlist, seed)
+        mesh = make_mesh(self.num_workers)
+        with timer.stage("search"):
+            Xq_d, _ = shard_rows(Xq, mesh)
+            d2, idx = ivf_search(
+                Xq_d, index, k=k, nprobe=nprobe,
+                topk_impl=resolve_knn_topk(), mesh=mesh,
+            )
+            nq = Xq.shape[0]
+            if nproc > 1:
+                d2 = local_row_block(d2)[:nq]
+                idx = local_row_block(idx)[:nq]
+            else:
+                d2 = np.asarray(d2)[:nq]
+                idx = np.asarray(idx)[:nq]
+        knn_df = self._knn_result_df(query_df_withid, d2, idx, ids_arr)
+        stages = dict(timer.totals)
+        self._ann_report = {
+            "engine": "ivf",
+            "nlist": nlist,
+            "nprobe": nprobe,
+            "build_seconds": round(stages.get("build", 0.0), 4),
+            "search_seconds": round(stages.get("search", 0.0), 4),
+        }
+        return item_df, query_df_withid, knn_df
+
+    def approxSimilarityJoin(
+        self, query_df: DataFrame, distCol: str = "distCol"
+    ) -> DataFrame:
+        """Reference ``knn.py:1098-1170``: explode the ANN result into one
+        row per (item, query) pair — identical join semantics to the exact
+        estimator's join, riding this model's (approximate) kneighbors."""
+        return self.exactNearestNeighborsJoin(query_df, distCol)

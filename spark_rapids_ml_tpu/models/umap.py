@@ -38,6 +38,12 @@ from ..params import (
     TypeConverters,
     _mk,
 )
+from ..ops.ivf_kernels import (
+    build_ivf_index,
+    ivf_search,
+    resolve_ann_params,
+    select_graph_engine,
+)
 from ..ops.kmeans_kernels import pairwise_sq_dists
 from ..ops.knn_kernels import _tile_top_k, resolve_knn_topk
 from ..parallel.mesh import allgather_ragged_rows
@@ -154,6 +160,37 @@ def knn_brute(
     d2 = d2.reshape(-1, k)[:nq]
     idx = idx.reshape(-1, k)[:nq]
     return jnp.sqrt(jnp.maximum(d2, 0.0)), idx
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def drop_self_column(dists: jax.Array, idx: jax.Array, *, k: int):
+    """Remove the self entry from a (n, k+1) self-kNN result, on device.
+
+    Column semantics are identical to the historical host path (fetch k+1,
+    drop the FIRST index-match column, else the last column): with
+    duplicate rows top-k tie-breaking can put self anywhere in the tie
+    run, so dropping column 0 would discard a real neighbor and keep a
+    self-loop. Keeping the drop on device means the graph stage transfers
+    the (n, k) result once instead of round-tripping the full (n, k+1)
+    arrays through numpy for a boolean-mask reshape.
+
+    Returns (dists (n, k), idx (n, k)) — a pure order-preserving gather of
+    the input values, so the kept entries are bit-identical to the host
+    formulation's.
+    """
+    n = idx.shape[0]
+    rows = jnp.arange(n, dtype=idx.dtype)[:, None]
+    self_mask = idx == rows
+    has_self = self_mask.any(axis=1)
+    drop_col = jnp.where(has_self, jnp.argmax(self_mask, axis=1), k)
+    # column j of the output reads input column j, shifted past the
+    # dropped one: j + (j >= drop_col)
+    cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+    src = cols + (cols >= drop_col[:, None]).astype(jnp.int32)
+    return (
+        jnp.take_along_axis(dists, src, axis=1),
+        jnp.take_along_axis(idx, src, axis=1),
+    )
 
 
 class UMAPClass:
@@ -341,24 +378,31 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         # async dispatch cannot smear across the split
         timer = StageTimer("umap.fit")
 
+        # graph-engine dispatch (TPUML_UMAP_GRAPH, gate + warn-fallback):
+        # the exact brute-force sweep vs the IVF-Flat approximate engine
+        # (ops/ivf_kernels.py). Resolved OUTSIDE the jitted kernels; k+1
+        # because the self entry is fetched then dropped.
+        graph_engine = select_graph_engine(n, k + 1)
+        ann_nlist = ann_nprobe = None
         with timer.stage("graph"):
-            # 1) kNN graph: fetch k+1 and drop the SELF entry by index
-            # match — with duplicate rows, top_k tie-breaking can put self
-            # anywhere in the tie run, so dropping column 0 would discard
-            # a real neighbor and keep a self-loop
+            # 1) kNN graph: fetch k+1 and drop the SELF entry on device
+            # (see drop_self_column for the tie-run column semantics)
             Xd = jnp.asarray(X)
-            dists, idx = knn_brute(
-                Xd, Xd, k=k + 1, topk_impl=resolve_knn_topk()
-            )
-            idx_np = np.asarray(idx)
-            dists_np = np.asarray(dists)
-            self_mask = idx_np == np.arange(n)[:, None]
-            has_self = self_mask.any(axis=1)
-            drop_col = np.where(has_self, self_mask.argmax(axis=1), k)
-            keep = np.ones_like(self_mask)
-            keep[np.arange(n), drop_col] = False
-            knn_i = idx_np[keep].reshape(n, k)
-            knn_d = dists_np[keep].reshape(n, k)
+            if graph_engine == "ivf":
+                ann_nlist, ann_nprobe = resolve_ann_params(n)
+                ivf_index = build_ivf_index(X, nlist=ann_nlist, seed=seed)
+                d2, idx = ivf_search(
+                    Xd, ivf_index, k=k + 1, nprobe=ann_nprobe,
+                    topk_impl=resolve_knn_topk(),
+                )
+                dists = jnp.sqrt(jnp.maximum(d2, 0.0))
+            else:
+                dists, idx = knn_brute(
+                    Xd, Xd, k=k + 1, topk_impl=resolve_knn_topk()
+                )
+            knn_d_dev, knn_i_dev = drop_self_column(dists, idx, k=k)
+            knn_i = np.asarray(knn_i_dev)
+            knn_d = np.asarray(knn_d_dev)
 
             # 2) fuzzy simplicial set (+ categorical label intersection
             # when supervised)
@@ -484,7 +528,13 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
             "sgd_seconds": round(sgd_s, 4),
             "epoch_ms": round(sgd_s / max(int(n_epochs), 1) * 1e3, 3),
             "sgd_engine": engine,
+            "graph_engine": graph_engine,
         }
+        if graph_engine == "ivf":
+            # the bench recall probe rebuilds the (deterministic) index
+            # from exactly these parameters
+            model._fit_report["ann_nlist"] = ann_nlist
+            model._fit_report["ann_nprobe"] = ann_nprobe
         # UMAP overrides fit() and skips the core per-fit loop, so attach
         # the resilience delta here (same contract as core._fit_internal)
         model._resilience_report = counters.delta_since(res_base)
@@ -540,6 +590,31 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
             cache[key] = select_sgd_engine(n_tab, K, C, neg)
         return cache[key]
 
+    def _transform_ivf_index(self, k: int):
+        """IVF index over the frozen training rows for the transform kNN,
+        memoized per (nlist, nprobe, seed): the build (sample + Lloyd +
+        balance) runs once, then every transform micro-batch reuses the
+        device-resident arrays. Returns ``(index, nprobe)`` or ``None``
+        when the engine resolution picks the exact sweep for this config
+        (``TPUML_UMAP_GRAPH`` participates in the memo key so tests
+        flipping the env are not pinned to a stale choice)."""
+        from ..ops.ivf_kernels import resolve_umap_graph
+
+        n_train = int(self.raw_data_.shape[0])
+        if select_graph_engine(n_train, k) != "ivf":
+            return None
+        nlist, nprobe = resolve_ann_params(n_train)
+        seed = int(self._tpu_params.get("random_state") or 0)
+        cache = getattr(self, "_ivf_index_cache", None)
+        if cache is None:
+            cache = self._ivf_index_cache = {}
+        key = (nlist, nprobe, seed, resolve_umap_graph())
+        if key not in cache:
+            cache[key] = build_ivf_index(
+                self.raw_data_, nlist=nlist, seed=seed
+            )
+        return cache[key], nprobe
+
     def _get_tpu_transform_func(
         self, dataset: Optional[DataFrame] = None
     ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
@@ -562,10 +637,22 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
 
         def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
             nq = Xb.shape[0]
-            dists, idx = knn_brute(
-                train_X, jnp.asarray(Xb, jnp.float32), k=k,
-                topk_impl=resolve_knn_topk(),
-            )
+            # same graph-engine dispatch as fit: the transform kNN runs
+            # against the frozen training rows, so the memoized IVF index
+            # amortizes across micro-batches (None = exact sweep)
+            ivf = self._transform_ivf_index(k)
+            if ivf is not None:
+                index, nprobe = ivf
+                d2, idx = ivf_search(
+                    jnp.asarray(Xb, jnp.float32), index, k=k,
+                    nprobe=nprobe, topk_impl=resolve_knn_topk(),
+                )
+                dists = jnp.sqrt(jnp.maximum(d2, 0.0))
+            else:
+                dists, idx = knn_brute(
+                    train_X, jnp.asarray(Xb, jnp.float32), k=k,
+                    topk_impl=resolve_knn_topk(),
+                )
             rho, sigma = smooth_knn_dist(dists, lc)
             w = membership_strengths(dists, rho, sigma)       # (nq, k)
             wn = w / jnp.maximum(w.sum(axis=1, keepdims=True), 1e-12)
@@ -599,6 +686,7 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
             self._transform_report = {
                 "sgd_engine": engine,
                 "refine_epochs": refine,
+                "graph_engine": "ivf" if ivf is not None else "exact",
             }
             return {out_col: np.asarray(emb)}
 

@@ -251,6 +251,44 @@ SPEC: Dict[str, EnvVar] = _registry(
         choices=("auto", "sort", "partial"), category="knn",
     ),
     EnvVar(
+        "TPUML_UMAP_GRAPH", "choice", "auto",
+        "UMAP kNN-graph engine: `exact` pins the brute-force sweep; `ivf` "
+        "requests the IVF-Flat approximate engine (warns + falls back to "
+        "exact when the shape is infeasible); `auto` uses IVF only at or "
+        "above `TPUML_ANN_GATE_ROWS` rows, so defaults stay bit-identical "
+        "to the exact graph (see `docs/ann_performance.md`).",
+        choices=("auto", "exact", "ivf"), category="umap",
+        also_documented_in=(
+            "docs/ann_performance.md", "docs/umap_performance.md",
+        ),
+    ),
+    EnvVar(
+        "TPUML_ANN_NLIST", "int", None,
+        "IVF-Flat coarse-quantizer list count override (default: a "
+        "`sqrt(n_rows)`-scaled heuristic). Applies to the "
+        "`ApproximateNearestNeighbors` estimator (where `algoParams` wins "
+        "over the env) and the `TPUML_UMAP_GRAPH=ivf` graph stage.",
+        minimum=2, category="knn",
+        also_documented_in=("docs/ann_performance.md",),
+    ),
+    EnvVar(
+        "TPUML_ANN_NPROBE", "int", None,
+        "IVF-Flat probe count override — lists scanned per query (default: "
+        "`max(6, nlist/8)`, a ~12%-of-lists scan fraction). Recall/throughput "
+        "knob; `algoParams` wins over the env on the estimator.",
+        minimum=1, category="knn",
+        also_documented_in=("docs/ann_performance.md",),
+    ),
+    EnvVar(
+        "TPUML_ANN_GATE_ROWS", "int", 131072,
+        "Row count at which `auto` graph/ANN dispatch starts preferring "
+        "the IVF engine over the exact sweep (below it the index build + "
+        "probe overhead beats nothing). Tests lower it to force the IVF "
+        "path on small fixtures.",
+        minimum=1, category="knn",
+        also_documented_in=("docs/ann_performance.md",),
+    ),
+    EnvVar(
         "TPUML_UMAP_OPT", "choice", "auto",
         "UMAP SGD engine for fit and the transform refine pass: `auto` "
         "prefers the VMEM-resident Pallas engine when the lowering probe "

@@ -1,0 +1,347 @@
+"""IVF-Flat approximate kNN (``ops/ivf_kernels.py``): recall vs the exact
+oracle, same-seed determinism, parameter validation through the
+``ApproximateNearestNeighbors`` estimator surface, below-gate exact
+fallback, and the ``TPUML_UMAP_GRAPH`` graph-engine dispatch contract —
+mirroring the ``TPUML_UMAP_OPT`` tests in ``tests/test_umap_pallas.py``."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.knn import (
+    ApproximateNearestNeighbors,
+    NearestNeighbors,
+)
+from spark_rapids_ml_tpu.ops import ivf_kernels as ik
+from spark_rapids_ml_tpu.umap import UMAP
+
+
+def _blobs(n=2000, d=16, centers=12, seed=7):
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(
+        n_samples=n, n_features=d, centers=centers, random_state=seed
+    )
+    return X.astype(np.float32)
+
+
+def _exact_ids(Xi, Xq, k):
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    nn = SkNN(n_neighbors=k, algorithm="brute").fit(Xi)
+    _, idx = nn.kneighbors(Xq)
+    return idx
+
+
+def _recall(approx_ids, exact_ids):
+    k = exact_ids.shape[1]
+    hits = [
+        len(set(a) & set(e)) / k for a, e in zip(approx_ids, exact_ids)
+    ]
+    return float(np.mean(hits))
+
+
+# --------------------------------------------------------------------------
+# kernel-level: recall, determinism, exhaustive-probe exactness
+# --------------------------------------------------------------------------
+
+
+def test_ivf_recall_meets_target():
+    X = _blobs()
+    nlist, nprobe = ik.resolve_ann_params(len(X))
+    index = ik.build_ivf_index(X, nlist=nlist, seed=0)
+    d2, ids = ik.ivf_search(X[:256], index, k=15, nprobe=nprobe)
+    exact = _exact_ids(X, X[:256], 15)
+    assert _recall(np.asarray(ids), exact) >= 0.95
+    # squared distances come back ascending
+    d2 = np.asarray(d2)
+    assert np.all(np.diff(d2, axis=1) >= -1e-5)
+
+
+def test_ivf_exhaustive_probe_is_exact():
+    """nprobe == nlist scans every list: the probe machinery must then
+    reproduce the exact neighbor id set (validates gather/scan/merge)."""
+    X = _blobs(n=600, d=8, centers=6)
+    index = ik.build_ivf_index(X, nlist=8, seed=0)
+    _, ids = ik.ivf_search(X[:128], index, k=10, nprobe=8)
+    exact = _exact_ids(X, X[:128], 10)
+    assert _recall(np.asarray(ids), exact) == 1.0
+
+
+def test_ivf_same_seed_deterministic():
+    X = _blobs(n=1200, d=8, centers=8)
+    outs = []
+    for _ in range(2):
+        index = ik.build_ivf_index(X, nlist=16, seed=3)
+        d2, ids = ik.ivf_search(X[:100], index, k=8, nprobe=4)
+        outs.append((np.asarray(d2), np.asarray(ids)))
+    np.testing.assert_array_equal(outs[0][1], outs[1][1])
+    np.testing.assert_array_equal(outs[0][0], outs[1][0])
+
+
+def test_index_layout_invariants():
+    X = _blobs(n=900, d=6, centers=5)
+    index = ik.build_ivf_index(X, nlist=12, seed=0)
+    assert index.nlist == 12 and index.n_rows == 900
+    assert index.cap % 8 == 0
+    # CSR metadata covers every row exactly once
+    assert index.offsets[0] == 0 and index.offsets[-1] == 900
+    assert int(np.sum(index.lens)) == 900
+    assert int(np.max(index.lens)) <= index.cap
+    # padding slots carry id -1; real slots hold a permutation of all ids
+    gids = np.asarray(index.grouped_ids)
+    real = gids[gids >= 0]
+    np.testing.assert_array_equal(np.sort(real), np.arange(900))
+
+
+# --------------------------------------------------------------------------
+# parameter resolution + validation
+# --------------------------------------------------------------------------
+
+
+def test_resolve_ann_params_validation():
+    with pytest.raises(ValueError, match="nlist"):
+        ik.resolve_ann_params(1000, nlist=1)
+    with pytest.raises(ValueError, match="nlist"):
+        ik.resolve_ann_params(100, nlist=200)
+    with pytest.raises(ValueError, match="nprobe"):
+        ik.resolve_ann_params(1000, nlist=16, nprobe=0)
+    with pytest.raises(ValueError, match="nprobe"):
+        ik.resolve_ann_params(1000, nlist=16, nprobe=32)
+
+
+def test_resolve_ann_params_env_priority(monkeypatch):
+    monkeypatch.setenv("TPUML_ANN_NLIST", "32")
+    monkeypatch.setenv("TPUML_ANN_NPROBE", "5")
+    assert ik.resolve_ann_params(10000) == (32, 5)
+    # explicit args win over the env
+    assert ik.resolve_ann_params(10000, nlist=64, nprobe=7) == (64, 7)
+
+
+def test_resolve_umap_graph_validates(monkeypatch):
+    monkeypatch.setenv("TPUML_UMAP_GRAPH", "bogus")
+    with pytest.raises(ValueError, match="TPUML_UMAP_GRAPH"):
+        ik.resolve_umap_graph()
+
+
+def test_estimator_algo_params_validation():
+    X = _blobs(n=300, d=4, centers=3)
+    df = DataFrame({"features": X})
+    with pytest.raises(ValueError, match="algorithm"):
+        ApproximateNearestNeighbors(k=3, algorithm="cagra").fit(df)
+    with pytest.raises(ValueError, match="algoParams"):
+        ApproximateNearestNeighbors(
+            k=3, algoParams={"n_lists": 8}
+        ).fit(df)
+    with pytest.raises(TypeError, match="algoParams"):
+        ApproximateNearestNeighbors(k=3, algoParams=[8, 2]).fit(df)
+    # out-of-domain values raise at query time even when the row gate
+    # would route the call to the exact engine anyway
+    model = ApproximateNearestNeighbors(
+        k=3, num_workers=1, algoParams={"nlist": 1}
+    ).fit(df)
+    with pytest.raises(ValueError, match="nlist"):
+        model.kneighbors(DataFrame({"features": X[:8]}))
+
+
+# --------------------------------------------------------------------------
+# estimator surface: gate fallback, ivf path, determinism
+# --------------------------------------------------------------------------
+
+
+def test_ann_below_gate_answers_exact():
+    """Default gate (131072 rows) routes small fixtures to the exact ring:
+    the ANN result must be BIT-identical to NearestNeighbors'."""
+    X = _blobs(n=400, d=8, centers=4)
+    df = DataFrame({"features": X})
+    qdf = DataFrame({"features": X[:32]})
+    ann = ApproximateNearestNeighbors(k=6, num_workers=1).fit(df)
+    _, _, ann_df = ann.kneighbors(qdf)
+    assert ann._ann_report["engine"] == "exact"
+    exact = NearestNeighbors(k=6, num_workers=1).fit(df)
+    _, _, exact_df = exact.kneighbors(qdf)
+    np.testing.assert_array_equal(ann_df["indices"], exact_df["indices"])
+    np.testing.assert_array_equal(ann_df["distances"], exact_df["distances"])
+
+
+def test_ann_infeasible_warns_and_answers_exact(monkeypatch, caplog):
+    """Above the gate but below the feasibility floor (n < 256): warn,
+    then answer with the exact ring instead of crashing."""
+    monkeypatch.setenv("TPUML_ANN_GATE_ROWS", "1")
+    X = _blobs(n=200, d=4, centers=3)
+    ann = ApproximateNearestNeighbors(k=4, num_workers=1).fit(
+        DataFrame({"features": X})
+    )
+    ann.logger.addHandler(caplog.handler)
+    try:
+        _, _, knn_df = ann.kneighbors(DataFrame({"features": X[:16]}))
+    finally:
+        ann.logger.removeHandler(caplog.handler)
+    assert ann._ann_report["engine"] == "exact"
+    assert any("exact" in r.getMessage() for r in caplog.records)
+    exact = _exact_ids(X, X[:16], 4)
+    np.testing.assert_array_equal(knn_df["indices"], exact)
+
+
+def test_ann_kneighbors_ivf_recall(monkeypatch):
+    monkeypatch.setenv("TPUML_ANN_GATE_ROWS", "1")
+    X = _blobs()
+    ann = ApproximateNearestNeighbors(k=15, num_workers=1).fit(
+        DataFrame({"features": X})
+    )
+    _, _, knn_df = ann.kneighbors(DataFrame({"features": X[:256]}))
+    rep = ann._ann_report
+    assert rep["engine"] == "ivf"
+    assert rep["build_seconds"] >= 0 and rep["search_seconds"] >= 0
+    exact = _exact_ids(X, X[:256], 15)
+    assert _recall(np.asarray(knn_df["indices"]), exact) >= 0.95
+    # distances are euclidean (not squared) and ascending, like the parent
+    dist = np.asarray(knn_df["distances"])
+    assert np.all(np.diff(dist, axis=1) >= -1e-4)
+    # self distance: ~0 up to the f32 ||x||^2 - 2<x,y> + ||y||^2
+    # cancellation error of the probe-scan formulation
+    np.testing.assert_allclose(dist[:, 0], 0.0, atol=0.05)
+
+
+def test_ann_kneighbors_same_seed_deterministic(monkeypatch):
+    monkeypatch.setenv("TPUML_ANN_GATE_ROWS", "1")
+    X = _blobs(n=800, d=8, centers=6)
+    qdf = DataFrame({"features": X[:64]})
+    outs = []
+    for _ in range(2):
+        ann = ApproximateNearestNeighbors(
+            k=5, num_workers=1, algoParams={"nlist": 16, "seed": 3}
+        ).fit(DataFrame({"features": X}))
+        _, _, knn_df = ann.kneighbors(qdf)
+        outs.append((np.asarray(knn_df["indices"]), np.asarray(knn_df["distances"])))
+    np.testing.assert_array_equal(outs[0][0], outs[1][0])
+    np.testing.assert_array_equal(outs[0][1], outs[1][1])
+
+
+def test_ann_similarity_join(monkeypatch):
+    monkeypatch.setenv("TPUML_ANN_GATE_ROWS", "1")
+    X = _blobs(n=600, d=6, centers=5)
+    ann = ApproximateNearestNeighbors(k=3, num_workers=1).fit(
+        DataFrame({"features": X})
+    )
+    joined = ann.approxSimilarityJoin(DataFrame({"features": X[:20]}))
+    assert "distCol" in joined
+    assert len(joined["distCol"]) == 20 * 3
+
+
+def test_ann_write_read_raise():
+    est = ApproximateNearestNeighbors(k=3)
+    with pytest.raises(NotImplementedError):
+        est.write()
+    with pytest.raises(NotImplementedError):
+        ApproximateNearestNeighbors.read()
+
+
+# --------------------------------------------------------------------------
+# UMAP graph-engine dispatch (mirrors the TPUML_UMAP_OPT contract)
+# --------------------------------------------------------------------------
+
+
+def test_select_graph_engine_dispatch(monkeypatch):
+    monkeypatch.delenv("TPUML_UMAP_GRAPH", raising=False)
+    monkeypatch.delenv("TPUML_ANN_GATE_ROWS", raising=False)
+    # auto below the default gate: exact (defaults-inert contract)
+    assert ik.select_graph_engine(4096, 16) == "exact"
+    # auto above the gate on a feasible shape: ivf
+    monkeypatch.setenv("TPUML_ANN_GATE_ROWS", "1024")
+    assert ik.select_graph_engine(4096, 16) == "ivf"
+    # exact pins regardless of gate
+    monkeypatch.setenv("TPUML_UMAP_GRAPH", "exact")
+    assert ik.select_graph_engine(4096, 16) == "exact"
+    # explicit ivf ignores the row gate on a feasible shape
+    monkeypatch.setenv("TPUML_UMAP_GRAPH", "ivf")
+    monkeypatch.setenv("TPUML_ANN_GATE_ROWS", "1000000")
+    assert ik.select_graph_engine(4096, 16) == "ivf"
+
+
+def test_select_graph_engine_ivf_falls_back_with_warning(monkeypatch, caplog):
+    monkeypatch.setenv("TPUML_UMAP_GRAPH", "ivf")
+    # the package logger does not propagate to root, so hook caplog's
+    # handler onto it directly (same idiom as test_umap_pallas.py)
+    lg = logging.getLogger("spark_rapids_ml_tpu.umap")
+    lg.addHandler(caplog.handler)
+    try:
+        # n < 256: infeasible however you slice it
+        assert ik.select_graph_engine(100, 8) == "exact"
+    finally:
+        lg.removeHandler(caplog.handler)
+    assert any("falling back" in r.getMessage() for r in caplog.records)
+
+
+def test_ivf_feasible_bounds():
+    assert not ik.ivf_feasible(100, 8, 10, 2)       # n < 256
+    assert not ik.ivf_feasible(1000, 1000, 30, 4)   # k >= n
+    assert not ik.ivf_feasible(1000, 8, 500, 4)     # cells fragment
+    assert not ik.ivf_feasible(100000, 64, 1000, 1) # probed pool < k
+    assert ik.ivf_feasible(100000, 16, 316, 40)
+
+
+# --------------------------------------------------------------------------
+# UMAP end-to-end: ivf graph vs exact graph
+# --------------------------------------------------------------------------
+
+
+def _cluster_data(n=400, d=8, seed=5):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(3, d)) * 5
+    lab = rng.integers(0, 3, size=n)
+    return (centers[lab] + 0.3 * rng.normal(size=(n, d))).astype(np.float32)
+
+
+def test_umap_graph_engines_agree_on_quality(monkeypatch):
+    """Full UMAP fit through each graph engine: trustworthiness within
+    ±0.01 and the fit report names the engine + index params that ran."""
+    from sklearn.manifold import trustworthiness
+
+    X = _cluster_data()
+    df = DataFrame({"features": X})
+    models = {}
+    # 100 epochs: the embedding must be CONVERGED before trustworthiness
+    # comparisons are meaningful — at 30 epochs the transient amplifies a
+    # 0.3%-different graph to a ~0.06 gap that converges away
+    for mode in ("exact", "ivf"):
+        monkeypatch.setenv("TPUML_UMAP_GRAPH", mode)
+        models[mode] = UMAP(
+            n_neighbors=10, random_state=0, init="random", n_epochs=100,
+            num_workers=1,
+        ).fit(df)
+        assert models[mode]._fit_report["graph_engine"] == mode
+    rep = models["ivf"]._fit_report
+    assert rep["ann_nlist"] >= 2 and rep["ann_nprobe"] >= 1
+    t = {
+        m: trustworthiness(X, np.asarray(mod.embedding_), n_neighbors=10)
+        for m, mod in models.items()
+    }
+    assert t["exact"] > 0.85
+    assert abs(t["ivf"] - t["exact"]) <= 0.01, t
+
+    # transform through the ivf graph: report names the engine
+    monkeypatch.setenv("TPUML_UMAP_GRAPH", "ivf")
+    out = models["ivf"].transform(DataFrame({"features": X[:64]}))
+    assert out["embedding"].shape == (64, 2)
+    assert models["ivf"]._transform_report["graph_engine"] == "ivf"
+    # pinning exact flips the transform path for the same model
+    monkeypatch.setenv("TPUML_UMAP_GRAPH", "exact")
+    models["ivf"].transform(DataFrame({"features": X[:32]}))
+    assert models["ivf"]._transform_report["graph_engine"] == "exact"
+
+
+def test_umap_defaults_keep_exact_graph(monkeypatch):
+    """No TPUML_* ANN env set: the graph stage must run the exact engine
+    (defaults-inert acceptance gate)."""
+    for var in ("TPUML_UMAP_GRAPH", "TPUML_ANN_GATE_ROWS",
+                "TPUML_ANN_NLIST", "TPUML_ANN_NPROBE"):
+        monkeypatch.delenv(var, raising=False)
+    X = _cluster_data(n=300)
+    model = UMAP(
+        n_neighbors=8, random_state=0, init="random", n_epochs=5,
+        num_workers=1,
+    ).fit(DataFrame({"features": X}))
+    assert model._fit_report["graph_engine"] == "exact"

@@ -93,8 +93,13 @@ def check_project(files: List[SourceFile], repo_root: str) -> Iterator[Finding]:
                 )
 
     # 2. registered-but-undocumented + 3. documented-but-unregistered
+    # every also_documented_in target participates alongside the static
+    # list, so new per-subsystem docs are covered without editing the rule
+    doc_files = set(_DOC_FILES)
+    for var in envspec.SPEC.values():
+        doc_files.update(getattr(var, "also_documented_in", ()) or ())
     doc_text: Dict[str, str] = {}
-    for rel in _DOC_FILES:
+    for rel in sorted(doc_files):
         p = os.path.join(repo_root, rel)
         if os.path.exists(p):
             with open(p, "r", encoding="utf-8") as f:
