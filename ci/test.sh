@@ -188,4 +188,128 @@ finally:
 print("fault-injection + resume smoke OK")
 EOF
 
+# Quantized-wire dispatch smoke: an int8 streamed PCA fit completes end
+# to end, the model's ingest report carries the resolved encoding, and
+# the components track the f32 fit within the documented int8 tolerance.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.runtime import counters
+
+rng = np.random.default_rng(3)
+X = rng.normal(size=(512, 8)).astype(np.float32)
+df = DataFrame({"features": X})
+
+def fit():
+    return PCA(
+        k=3, num_workers=4, streaming=True, stream_chunk_rows=64
+    ).fit(df)
+
+base = counters.snapshot()
+m32 = fit()
+assert m32._ingest_report["wire_dtype"] == "f32", m32._ingest_report
+try:
+    os.environ["TPUML_WIRE_DTYPE"] = "int8"
+    m8 = fit()
+finally:
+    os.environ.pop("TPUML_WIRE_DTYPE", None)
+assert m8._ingest_report["wire_dtype"] == "int8", m8._ingest_report
+dots = np.abs((np.asarray(m32.components_) * np.asarray(m8.components_)).sum(axis=1))
+np.testing.assert_allclose(dots, 1.0, atol=5e-2)
+delta = counters.delta_since(base)
+assert "wire_release_errors" not in delta, delta
+print("quantized-wire dispatch smoke OK:", m8._ingest_report)
+EOF
+
+# Prefetch-ring overlap smoke: on a source whose decode is synthetically
+# slow (sleeps release the GIL, so decode/stage/fold genuinely overlap
+# even on the CPU backend), the pipelined pass must hide most of the
+# slower leg: overlap_efficiency > 0.5 against independently timed legs.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import contextlib
+import time
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.data.chunks import Chunk, GeneratorChunkSource
+from spark_rapids_ml_tpu.ops import streaming as st
+from spark_rapids_ml_tpu.parallel.mesh import local_mesh
+
+mesh = local_mesh()
+chunk_rows, d, n_chunks = 8192, 256, 10
+rows = chunk_rows * n_chunks
+block = np.random.default_rng(0).standard_normal(
+    (chunk_rows, d)).astype(np.float32)
+mean0 = jnp.zeros((d,), jnp.float32)
+
+def gen(start, count, seed):
+    time.sleep(0.08)  # slow decode (object storage / parquet scan stand-in)
+    return block[:count], None
+
+def decode_leg():
+    src = GeneratorChunkSource(gen, rows, d)
+    for _ in src.iter_chunks(chunk_rows, np.float32):
+        pass
+
+def fold_leg(dev):
+    acc = st.gram2_init(d, np.float32, False)
+    for _ in range(n_chunks):
+        acc = st.gram2_step(acc, dev["X"], dev["mask"], mean0)
+    np.asarray(jnp.ravel(acc["G"])[:1])
+
+def full_pass():
+    src = GeneratorChunkSource(gen, rows, d)
+    acc = st.gram2_init(d, np.float32, False)
+    guard = st.StreamGuard()
+    with contextlib.closing(
+        st.iter_device_chunks(src, mesh, chunk_rows, np.float32,
+                              need_y=False, need_w=False)
+    ) as chunks:
+        for _, dev in chunks:
+            acc = st.gram2_step(acc, dev["X"], dev["mask"], mean0)
+            guard.tick(dev, acc)
+    guard.flush(acc)
+
+dev0 = st.put_chunk(Chunk(X=block, n_valid=chunk_rows), mesh, np.float32)
+fold_leg(dev0)  # compile outside the timers
+t0 = time.perf_counter(); decode_leg(); t_decode = time.perf_counter() - t0
+t0 = time.perf_counter(); fold_leg(dev0); t_fold = time.perf_counter() - t0
+full_pass()  # warm the pipeline threads' first-iteration costs
+# min over repeats: the smoke asserts the machinery CAN overlap, so
+# scheduler noise should only forgive, never fail, the assertion
+t_total = float("inf")
+for _ in range(3):
+    t0 = time.perf_counter()
+    full_pass()
+    t_total = min(t_total, time.perf_counter() - t0)
+overlap = max(0.0, min(1.0, (t_decode + t_fold - t_total)
+                       / max(min(t_decode, t_fold), 1e-9)))
+print(f"ring overlap smoke: decode={t_decode:.3f}s fold={t_fold:.3f}s "
+      f"total={t_total:.3f}s overlap_efficiency={overlap:.3f}")
+assert overlap > 0.5, (t_decode, t_fold, t_total, overlap)
+EOF
+
+# bench pca_stream artifact: the JSON line must carry the new wire
+# provenance columns
+BENCH_ONLY=pca_stream BENCH_STREAM_SECONDS=3 BENCH_STREAM_CHUNK=65536 \
+TPUML_WIRE_DTYPE=int8 JAX_PLATFORMS=cpu python bench.py cpu \
+  > /tmp/tpuml_bench_wire.out
+python - <<'EOF'
+import json
+
+with open("/tmp/tpuml_bench_wire.out") as f:
+    line = json.loads(f.read().strip().splitlines()[-1])
+entry = line["pca_stream"]
+assert entry["wire_dtype"] == "int8", entry
+assert "decode_seconds" in entry and "overlap_efficiency" in entry, entry
+print("bench pca_stream wire columns OK:", entry["wire_dtype"],
+      entry["ingest_gbps"], "GB/s logical")
+EOF
+
 echo "CI OK"

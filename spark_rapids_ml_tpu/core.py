@@ -500,7 +500,8 @@ class _TpuEstimator(Params, _TpuParams):
         self._apply_verbosity()
         cls_name = type(self).__name__
         stream_func = self._get_tpu_streaming_fit_func(dataset)
-        if stream_func is not None and self._should_stream(dataset):
+        streaming = stream_func is not None and self._should_stream(dataset)
+        if streaming:
             self.logger.info(
                 "Streaming fit engaged (out-of-core chunked ingestion)."
             )
@@ -542,6 +543,12 @@ class _TpuEstimator(Params, _TpuParams):
             model._resilience_report = res_delta
             if res_delta:
                 self.logger.info("resilience events during fit: %s", res_delta)
+            if streaming:
+                # ingest provenance: the wire encoding + pipeline depths the
+                # chunk stream actually used (resolved knobs, not requested)
+                from .ops.streaming import last_ingest_report
+
+                model._ingest_report = last_ingest_report()
             models.append(model)
         return models
 
@@ -604,6 +611,11 @@ class _TpuModel(Params, _TpuParams):
     # delta; {} on a clean path). Class-level default so models that never
     # went through a fit loop (e.g. load()ed from disk) still expose it.
     _resilience_report: Dict[str, int] = {}
+
+    # ingest provenance of a STREAMED fit (resolved wire dtype + pipeline
+    # depths from ops.streaming.last_ingest_report); {} for resident fits
+    # and load()ed models.
+    _ingest_report: Dict[str, Any] = {}
 
     def __init__(self, **model_attributes: Any) -> None:
         super().__init__()

@@ -418,8 +418,10 @@ class ParquetScanFrame(DataFrame):
             raise FileNotFoundError(f"No parquet files under {path}")
         self._path = path
         self._files = files
+        from .chunks import parquet_row_counts
+
         self._schema = pq.ParquetFile(files[0]).schema_arrow
-        self._nrows = sum(pq.ParquetFile(f).metadata.num_rows for f in files)
+        self._nrows = sum(parquet_row_counts(files))
         self._num_partitions = max(1, int(num_partitions))
         self._materialized: Optional[Dict[str, ColumnLike]] = None
 

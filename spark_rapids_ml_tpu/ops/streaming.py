@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -42,6 +42,7 @@ from ..runtime.retry import (
 from ..utils.logging import get_logger
 
 _res_logger = get_logger("streaming.resilience")
+_wire_logger = get_logger("streaming.wire")
 
 
 # ---------------------------------------------------------------------------
@@ -51,6 +52,34 @@ _res_logger = get_logger("streaming.resilience")
 # host-side backpressure period for streaming loops (chunks between syncs);
 # 0 disables
 _SYNC_EVERY = int(envspec.get("TPUML_STREAM_SYNC_EVERY"))
+
+_release_err_logged = False
+
+
+def _release_buffers(arrays) -> None:
+    """``delete()`` retired chunk buffers (device slabs + the client's
+    retained host copies).
+
+    A failed delete is never fatal — results don't depend on it — but a
+    swallowed one hides a leak that grows with total bytes shipped: each
+    failure bumps the ``wire_release_errors`` counter and the first in the
+    process is debug-logged with the exception, so a nonzero bench/test
+    delta points straight at the cause.
+    """
+    global _release_err_logged
+    for a in arrays:
+        if a is None:
+            continue
+        try:
+            a.delete()
+        except Exception as exc:
+            counters.bump("wire_release_errors")
+            if not _release_err_logged:
+                _release_err_logged = True
+                _wire_logger.debug(
+                    "chunk buffer release failed (first occurrence; further "
+                    "ones only bump wire_release_errors): %r", exc,
+                )
 
 
 class StreamGuard:
@@ -91,11 +120,7 @@ class StreamGuard:
     def _sync_and_release(self, acc) -> None:
         leaf = jax.tree_util.tree_leaves(acc)[0]
         np.asarray(jnp.ravel(leaf)[:1])
-        for a in self._pending:
-            try:
-                a.delete()
-            except Exception:
-                pass
+        _release_buffers(self._pending)
         self._pending.clear()
 
     def tick(self, dev, acc) -> None:
@@ -207,16 +232,211 @@ def prefetch_chunks(it, depth: Optional[int] = None):
         cancel.set()
 
 
+# ---------------------------------------------------------------------------
+# Wire formats (TPUML_WIRE_DTYPE) — fewer bytes over the host->device link
+# ---------------------------------------------------------------------------
+
+# float8 e4m3 finite max (S.1111.110 -> 448); quantization maps each
+# column's observed absmax onto it
+_F8_MAX = 448.0
+
+# auto-probe acceptance thresholds: relative RMS reconstruction error of
+# the FIRST chunk under each encoding (cost model + derivation:
+# docs/streaming_performance.md; dispatch behavior pinned by
+# tests/test_streaming_wire.py)
+_AUTO_INT8_TOL = 2e-2
+_AUTO_F16_TOL = 2e-3
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedWire:
+    """A streamed chunk living on device in its quantized wire encoding.
+
+    Fold steps accept this in place of the dense ``X`` and call
+    :func:`wire_dense` first thing INSIDE their jit: the dequantize (one
+    fused multiply-add per element) happens where the step reads the data,
+    so the wide matrix never materializes between transfer and fold — the
+    only host->device traffic was the narrow buffer plus two O(d) scale
+    vectors. Being a pytree, it crosses the jit boundary as its leaves;
+    the target dtype rides in the (static) treedef, so each encoding gets
+    exactly one fold-step trace.
+
+    ``offset`` is None for the scale-only encoding (f8).
+    """
+
+    def __init__(self, q, scale, offset, dtype):
+        self.q = q
+        self.scale = scale
+        self.offset = offset
+        self.dtype = jnp.dtype(dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale, self.offset), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, dtype, children):
+        return cls(*children, dtype)
+
+    def dense(self) -> jax.Array:
+        x = self.q.astype(self.dtype) * self.scale.astype(self.dtype)
+        if self.offset is not None:
+            x = x + self.offset.astype(self.dtype)
+        return x
+
+    def delete(self) -> None:
+        """StreamGuard-compatible release of the underlying buffers."""
+        for a in (self.q, self.scale, self.offset):
+            if a is not None:
+                a.delete()
+
+
+def wire_dense(X):
+    """Resolve a fold-step ``X`` argument to a dense matrix.
+
+    Every jitted fold step calls this on entry: a :class:`QuantizedWire`
+    dequantizes HERE — inside the caller's jit — and a plain array passes
+    through untouched (zero cost on the default path).
+    """
+    return X.dense() if isinstance(X, QuantizedWire) else X
+
+
+def _quantize_int8(
+    x: np.ndarray, n_valid: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-chunk-column affine int8: ``x ~ q * scale + offset``.
+
+    Ranges come from the VALID rows only (padding rows quantize to
+    whatever clips — every fold step multiplies them away by the mask).
+    A constant column gets scale 1 so the reconstruction is exact.
+    """
+    v = x[:n_valid] if 0 < n_valid < x.shape[0] else x
+    lo = v.min(axis=0).astype(np.float32)
+    hi = v.max(axis=0).astype(np.float32)
+    scale = ((hi - lo) / np.float32(254.0)).astype(np.float32)
+    scale = np.where(scale > 0, scale, np.float32(1.0))
+    offset = ((hi + lo) * np.float32(0.5)).astype(np.float32)
+    # in-place pipeline: this runs per chunk on the ingest-critical path,
+    # so avoid stacking several chunk-sized float temporaries
+    q = x - offset
+    q /= scale
+    np.rint(q, out=q)
+    np.clip(q, -127, 127, out=q)
+    return q.astype(np.int8), scale, offset
+
+
+@functools.lru_cache(maxsize=1)
+def _f8_dtype() -> Optional[np.dtype]:
+    """numpy dtype of the e4m3 wire encoding, or None when the toolchain
+    lacks it (``ml_dtypes`` ships with jax, but gate rather than assume)."""
+    try:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.float8_e4m3fn)
+    except Exception:
+        try:
+            return np.dtype(jnp.float8_e4m3fn)
+        except Exception:
+            return None
+
+
+@functools.lru_cache(maxsize=1)
+def _f8_supported() -> bool:
+    """True when f8 buffers round-trip through the live backend (the
+    dtype exists AND device_put + upcast lower on this platform)."""
+    f8 = _f8_dtype()
+    if f8 is None:
+        return False
+    try:
+        np.asarray(
+            jnp.asarray(np.ones((2,), f8)).astype(jnp.float32)
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _quantize_f8(
+    x: np.ndarray, n_valid: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-chunk-column scaled e4m3: ``x ~ q * scale`` with each column's
+    absmax mapped to the f8 finite max (no offset: e4m3's ~2 decimal
+    digits are spent on relative precision instead)."""
+    v = x[:n_valid] if 0 < n_valid < x.shape[0] else x
+    amax = np.abs(v).max(axis=0).astype(np.float32)
+    scale = np.where(amax > 0, amax / np.float32(_F8_MAX), np.float32(1.0))
+    q = (x / scale).astype(_f8_dtype())
+    return q, scale
+
+
+def resolve_wire_dtype() -> str:
+    """Parsed+validated ``TPUML_WIRE_DTYPE`` (EnvSpecError on bad values)."""
+    return str(envspec.get("TPUML_WIRE_DTYPE"))
+
+
+def _probe_quant_error(x: np.ndarray, kind: str) -> float:
+    """Relative RMS reconstruction error of encoding ``x`` as ``kind``."""
+    v = np.asarray(x, np.float32)
+    if kind == "int8":
+        q, scale, offset = _quantize_int8(v, v.shape[0])
+        rec = q.astype(np.float32) * scale + offset
+    else:  # f16
+        rec = v.astype(np.float16).astype(np.float32)
+    rms = float(np.sqrt(np.mean(v * v)))
+    return float(np.sqrt(np.mean((rec - v) ** 2))) / max(rms, 1e-12)
+
+
+def select_wire_format(sample_X: np.ndarray, requested: Optional[str] = None) -> str:
+    """Resolve the wire encoding for one streaming pass (never ``auto``).
+
+    ``requested`` overrides the env (None = read ``TPUML_WIRE_DTYPE``).
+    Same dispatch contract as ``TPUML_UMAP_OPT``: ``auto`` gates on a
+    probe — the first chunk's quantization error under int8 (then f16)
+    against the documented tolerances — and an explicit request that is
+    infeasible on this host/backend WARNS and falls back instead of
+    failing the fit. Non-float storage always ships as-is (``f32``).
+    """
+    kind = resolve_wire_dtype() if requested is None else str(requested)
+    x = np.asarray(sample_X)
+    if x.dtype.kind != "f":
+        return "f32"
+    if kind == "auto":
+        err8 = _probe_quant_error(x, "int8")
+        if err8 <= _AUTO_INT8_TOL:
+            kind = "int8"
+        elif _probe_quant_error(x, "f16") <= _AUTO_F16_TOL:
+            kind = "f16"
+        else:
+            kind = "f32"
+        _wire_logger.info(
+            "TPUML_WIRE_DTYPE=auto: int8 probe error %.2e -> wire %s",
+            err8, kind,
+        )
+    if kind == "f8" and not _f8_supported():
+        _wire_logger.warning(
+            "TPUML_WIRE_DTYPE=f8 requested but float8_e4m3 is unavailable "
+            "on this toolchain/backend; falling back to f16"
+        )
+        kind = "f16"
+    return kind
+
+
 def put_chunk(
-    chunk: Chunk, mesh, dtype, *, need_y: bool = True, need_w: bool = True
+    chunk: Chunk, mesh, dtype, *, need_y: bool = True, need_w: bool = True,
+    wire: str = "f32",
 ) -> Dict[str, Optional[jax.Array]]:
     """device_put one host chunk row-sharded over dp.  Transfers are async:
     the next chunk's H2D overlaps the current chunk's accumulation step.
 
-    Wire dtype: a chunk stored in a float NARROWER than the compute dtype
-    (e.g. float16 parquet) ships as-is and upcasts ON DEVICE — halving
-    host->device traffic, which is the streaming bottleneck on any
-    interconnect (PCIe, or the remote tunnel's ~30 MB/s).
+    Wire dtype (``wire``, a RESOLVED ``select_wire_format`` value — never
+    ``auto``): ``int8`` / ``f8`` quantize per chunk column on host and ship
+    the 1-byte buffer plus O(d) scales, returning ``X`` as a
+    :class:`QuantizedWire` the fold step dequantizes inside its jit;
+    ``f16`` downcasts wide float storage on host and upcasts on device.
+    Independent of the knob, a chunk stored in a float NARROWER than the
+    compute dtype (e.g. float16 parquet) ships as-is and upcasts ON DEVICE.
+    Fewer wire bytes attack the streaming bottleneck on any interconnect
+    (PCIe, or the remote tunnel's ~30 MB/s); the default ``f32`` keeps the
+    historical byte-identical behavior.
 
     ``need_y`` / ``need_w``: callers whose accumulation step does not
     consume the label / weight column MUST pass False — the column is then
@@ -228,13 +448,34 @@ def put_chunk(
     fault_site("ingest:chunk")
     sh = row_sharding(mesh)
     x_host = np.asarray(chunk.X)
-    wire = None
-    if x_host.dtype.kind == "f" and x_host.dtype.itemsize < np.dtype(dtype).itemsize:
-        # the narrow array below is the buffer the client ACTUALLY
-        # transferred (and retains a host copy of); it rides along under
-        # "_wire" so StreamGuard deletes IT, not just the derived upcast
-        wire = jax.device_put(x_host, sh)
-        X = jnp.asarray(wire, dtype=dtype)
+    wire_bufs = None
+    if wire in ("int8", "f8") and x_host.dtype.kind == "f":
+        # every array below is a buffer the client ACTUALLY transferred
+        # (and retains a host copy of); they ride along under "_wire" so
+        # StreamGuard deletes THEM, not just arrays derived on device
+        from ..parallel.mesh import replicated
+
+        rep = replicated(mesh)
+        if wire == "int8":
+            q, scale, offset = _quantize_int8(x_host, chunk.n_valid)
+        else:
+            q, scale = _quantize_f8(x_host, chunk.n_valid)
+            offset = None
+        qd = jax.device_put(q, sh)
+        sd = jax.device_put(scale, rep)
+        od = None if offset is None else jax.device_put(offset, rep)
+        X: Any = QuantizedWire(qd, sd, od, jnp.dtype(dtype))
+        wire_bufs = [a for a in (qd, sd, od) if a is not None]
+    elif x_host.dtype.kind == "f" and x_host.dtype.itemsize < np.dtype(dtype).itemsize:
+        # narrow float STORAGE pass-through (also where wire="f16" lands
+        # once the host buffer is already f16)
+        narrow = jax.device_put(x_host, sh)
+        X = jnp.asarray(narrow, dtype=dtype)
+        wire_bufs = narrow
+    elif wire == "f16" and x_host.dtype.kind == "f" and x_host.dtype.itemsize > 2:
+        narrow = jax.device_put(x_host.astype(np.float16), sh)
+        X = jnp.asarray(narrow, dtype=dtype)
+        wire_bufs = narrow
     else:
         X = jax.device_put(np.asarray(x_host, dtype=dtype), sh)
     out: Dict[str, Optional[jax.Array]] = {
@@ -242,7 +483,7 @@ def put_chunk(
         "mask": jax.device_put(chunk.mask(dtype), sh),
         "y": None,
         "w": None,
-        "_wire": wire,
+        "_wire": wire_bufs,
     }
     if need_y and chunk.y is not None:
         out["y"] = jax.device_put(np.asarray(chunk.y, dtype=dtype), sh)
@@ -275,7 +516,10 @@ def _split_chunk(chunk: Chunk, row_mult: int) -> Optional[Tuple[Chunk, Chunk]]:
     return slab(0, half), slab(half, rows)
 
 
-def stage_chunks(chunk: Chunk, mesh, dtype, *, need_y: bool = True, need_w: bool = True):
+def stage_chunks(
+    chunk: Chunk, mesh, dtype, *, need_y: bool = True, need_w: bool = True,
+    wire: str = "f32",
+):
     """Stage ``chunk`` on device, degrading gracefully under failure.
 
     Yields ``(piece, dev)`` pairs — normally exactly one, the whole chunk.
@@ -296,7 +540,9 @@ def stage_chunks(chunk: Chunk, mesh, dtype, *, need_y: bool = True, need_w: bool
     """
     budget = resolve_retries()
     if budget <= 0:
-        yield chunk, put_chunk(chunk, mesh, dtype, need_y=need_y, need_w=need_w)
+        yield chunk, put_chunk(
+            chunk, mesh, dtype, need_y=need_y, need_w=need_w, wire=wire
+        )
         return
     import time as _time
 
@@ -307,7 +553,9 @@ def stage_chunks(chunk: Chunk, mesh, dtype, *, need_y: bool = True, need_w: bool
     while pending:
         piece = pending[0]
         try:
-            dev = put_chunk(piece, mesh, dtype, need_y=need_y, need_w=need_w)
+            dev = put_chunk(
+                piece, mesh, dtype, need_y=need_y, need_w=need_w, wire=wire
+            )
         except SimulatedPreemption:
             raise
         except Exception as exc:
@@ -341,6 +589,149 @@ def stage_chunks(chunk: Chunk, mesh, dtype, *, need_y: bool = True, need_w: bool
         yield piece, dev
 
 
+# provenance of the most recent ingest pipeline in this process (resolved
+# wire dtype + ring depths); the estimator layer copies it onto fitted
+# models as ``model._ingest_report``
+_LAST_INGEST: Dict[str, Any] = {}
+
+
+def last_ingest_report() -> Dict[str, Any]:
+    """Copy of the most recent :func:`iter_device_chunks` configuration."""
+    return dict(_LAST_INGEST)
+
+
+def _staged_chunks(chunks, mesh, dtype, *, need_y, need_w, wire, depth):
+    """Device-staging ring stage of the ingest pipeline.
+
+    A background thread pulls decoded chunks, wire-encodes them
+    (quantization for int8/f8 is real host CPU work) and issues the async
+    ``device_put``, keeping up to ``depth`` staged chunks buffered ahead
+    of the consumer. The consumer's fold dispatch — and crucially the
+    StreamGuard's periodic BLOCKING syncs — no longer serialize against
+    encode+transfer of the next chunks.
+
+    Single producer + FIFO queue: yields ``(chunk, dev)`` strictly in
+    source order at any depth. Cancel/error discipline is identical to
+    :func:`prefetch_chunks` (same close-promptly caveat).
+    """
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    end = object()
+    cancel = threading.Event()
+    err: list = []
+
+    def worker():
+        try:
+            for chunk in chunks:
+                dev = put_chunk(
+                    chunk, mesh, dtype, need_y=need_y, need_w=need_w, wire=wire
+                )
+                while not cancel.is_set():
+                    try:
+                        q.put((chunk, dev), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if cancel.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised on consumer
+            err.append(e)
+        finally:
+            while not cancel.is_set():
+                try:
+                    q.put(end, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    th = threading.Thread(target=worker, name="tpuml-chunk-stage", daemon=True)
+    th.start()
+    try:
+        while True:
+            if err:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    raise err[0].with_traceback(err[0].__traceback__) from None
+            else:
+                item = q.get()
+            if item is end:
+                break
+            yield item
+        if err:
+            raise err[0].with_traceback(err[0].__traceback__)
+    finally:
+        cancel.set()
+
+
+def iter_device_chunks(
+    source: ChunkSource,
+    mesh,
+    chunk_rows: int,
+    dtype,
+    *,
+    need_y: bool = True,
+    need_w: bool = True,
+    wire: Optional[str] = None,
+):
+    """The shared multi-stage ingest pipeline of every streaming loop.
+
+    Yields ``(piece, dev)`` pairs in source order. Stages, each a bounded
+    ring so host memory stays O(depth) chunk buffers:
+
+    1. **decode** — :func:`prefetch_chunks` runs ``source.iter_chunks``
+       (parquet decode / synthetic gen) on a background thread,
+       ``TPUML_STREAM_PREFETCH`` deep;
+    2. **stage** — :func:`_staged_chunks` wire-encodes and issues the
+       async ``device_put`` up to ``TPUML_STREAM_STAGE_DEPTH`` chunks
+       ahead, so decode, host->device transfer, and the fold step
+       genuinely overlap instead of serializing;
+    3. **fold** — the caller accumulates and ``guard.tick``s as before.
+
+    The wire encoding is resolved ONCE from the first chunk
+    (:func:`select_wire_format`: env request, ``auto`` probe, fallback)
+    and pinned for the whole pass, so every chunk shares one encoding and
+    one fold-step trace. Ordering — and therefore every accumulator
+    result — is independent of both depths (single producer per stage,
+    FIFO rings); ``tests/test_streaming_wire.py`` pins that.
+
+    With a retry budget (``TPUML_RETRIES`` > 0) staging happens on the
+    consumer thread where :func:`stage_chunks` can halve/retry
+    synchronously — the ring is bypassed (resilience wins over overlap).
+    """
+    import itertools
+
+    np_dtype = np.dtype(jnp.dtype(dtype).name)
+    it = prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+    try:
+        first = next(it, None)
+        if first is None:
+            return
+        kind = select_wire_format(first.X, requested=wire)
+        depth = int(envspec.get("TPUML_STREAM_STAGE_DEPTH"))
+        _LAST_INGEST.clear()
+        _LAST_INGEST.update(
+            wire_dtype=kind,
+            stage_depth=depth,
+            prefetch_depth=int(envspec.get("TPUML_STREAM_PREFETCH")),
+        )
+        chunks = itertools.chain([first], it)
+        if depth > 0 and resolve_retries() <= 0:
+            yield from _staged_chunks(
+                chunks, mesh, dtype,
+                need_y=need_y, need_w=need_w, wire=kind, depth=depth,
+            )
+        else:
+            for chunk in chunks:
+                yield from stage_chunks(
+                    chunk, mesh, dtype, need_y=need_y, need_w=need_w, wire=kind
+                )
+    finally:
+        it.close()
+
+
 # ---------------------------------------------------------------------------
 # Pass 1: weighted first moments
 # ---------------------------------------------------------------------------
@@ -364,6 +755,7 @@ def moments1_step(
     y: Optional[jax.Array] = None,
 ) -> Dict[str, jax.Array]:
     """Fold one chunk into (Σw, Σw·x [, Σw·y]).  ``rw`` = mask·weight."""
+    X = wire_dense(X)
     out = dict(acc)
     out["n"] = acc["n"] + rw.sum()
     out["sum_x"] = acc["sum_x"] + (X * rw[:, None]).sum(axis=0)
@@ -395,6 +787,7 @@ def gram2_step(
     mean_y: Optional[jax.Array] = None,
 ) -> Dict[str, jax.Array]:
     """Fold one chunk into G=(Xc√w)'(Xc√w) [, Xy, yy] centered at mean."""
+    X = wire_dense(X)
     sw = jnp.sqrt(rw)
     Xc = (X - mean_x[None, :]) * sw[:, None]
     out = dict(acc)
@@ -425,6 +818,7 @@ def kmeans_chunk_step(
     resident kernel's bf16-operand option, same semantics here."""
     from .kmeans_kernels import pairwise_sq_dists, stats_dot
 
+    X = wire_dense(X)
     k = centers.shape[0]
     d2 = pairwise_sq_dists(X, centers, matmul_dtype=matmul_dtype)
     assign = jnp.argmin(d2, axis=1)
@@ -443,7 +837,7 @@ def chunk_min_sq_dists(
     """Per-row min squared distance to any center (padding rows -> 0)."""
     from .kmeans_kernels import pairwise_sq_dists
 
-    return jnp.min(pairwise_sq_dists(X, centers), axis=1) * mask
+    return jnp.min(pairwise_sq_dists(wire_dense(X), centers), axis=1) * mask
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -456,6 +850,7 @@ def count_closest_chunk_step(
     regime the out-of-core path exists for."""
     from .kmeans_kernels import pairwise_sq_dists
 
+    X = wire_dense(X)
     d2 = pairwise_sq_dists(X, cands)
     assign = jnp.argmin(d2, axis=1)
     onehot = jax.nn.one_hot(assign, cands.shape[0], dtype=X.dtype) * mask[:, None]
@@ -473,6 +868,7 @@ def var_chunk_step(
 ) -> jax.Array:
     """Fold one chunk into Σ w·(x-mean)² (diagonal-only second moment —
     cheaper than the full Gram when only feature variances are needed)."""
+    X = wire_dense(X)
     d = (X - mean[None, :]) * jnp.sqrt(rw)[:, None]
     return acc + (d * d).sum(axis=0)
 
@@ -504,6 +900,7 @@ def logreg_chunk_vg_step(
     data copy. The regularization terms are added once on the host, not
     per chunk.
     """
+    X = wire_dense(X)
     dtype = X.dtype
     d = X.shape[1]
     K = n_classes if multinomial else 1
@@ -547,22 +944,20 @@ def streamed_suffstats(
     from ..parallel.mesh import allreduce_sum_host
 
     d = source.n_features
-    np_dtype = np.dtype(jnp.dtype(dtype).name)
 
     acc1 = moments1_init(d, dtype, with_y)
     guard = StreamGuard()
-    # closing() so an exception in the loop body tears down the prefetch
-    # thread promptly instead of at GC time (caveat on prefetch_chunks).
+    # closing() so an exception in the loop body tears down the pipeline
+    # threads promptly instead of at GC time (caveat on prefetch_chunks).
     with contextlib.closing(
-        prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+        iter_device_chunks(source, mesh, chunk_rows, dtype, need_y=with_y)
     ) as chunks:
-        for chunk in chunks:
-            for _, dev in stage_chunks(chunk, mesh, dtype, need_y=with_y):
-                rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
-                acc1 = moments1_step(
-                    acc1, dev["X"], rw, dev["y"] if with_y else None
-                )
-                guard.tick(dev, acc1)
+        for _, dev in chunks:
+            rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
+            acc1 = moments1_step(
+                acc1, dev["X"], rw, dev["y"] if with_y else None
+            )
+            guard.tick(dev, acc1)
     guard.flush(acc1)
     # cross-process allreduce of the first-moment partials (the NCCL
     # allreduce analog; identity single-process)
@@ -583,16 +978,15 @@ def streamed_suffstats(
     acc2 = gram2_init(d, dtype, with_y)
     guard = StreamGuard()
     with contextlib.closing(
-        prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+        iter_device_chunks(source, mesh, chunk_rows, dtype, need_y=with_y)
     ) as chunks:
-        for chunk in chunks:
-            for _, dev in stage_chunks(chunk, mesh, dtype, need_y=with_y):
-                rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
-                acc2 = gram2_step(
-                    acc2, dev["X"], rw, mean_x,
-                    dev["y"] if with_y else None, mean_y,
-                )
-                guard.tick(dev, acc2)
+        for _, dev in chunks:
+            rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
+            acc2 = gram2_step(
+                acc2, dev["X"], rw, mean_x,
+                dev["y"] if with_y else None, mean_y,
+            )
+            guard.tick(dev, acc2)
     guard.flush(acc2)
     if with_y:
         G_h, Xy_h, yy_h = allreduce_sum_host(acc2["G"], acc2["Xy"], acc2["yy"])
@@ -658,14 +1052,13 @@ def streamed_logreg_fit(
     acc1 = moments1_init(d, dtype, with_y=False)
     guard = StreamGuard()
     with contextlib.closing(
-        prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+        iter_device_chunks(
+            source, mesh, chunk_rows, dtype, need_y=False, need_w=False
+        )
     ) as chunks:
-        for chunk in chunks:
-            for _, dev in stage_chunks(
-                chunk, mesh, dtype, need_y=False, need_w=False
-            ):
-                acc1 = moments1_step(acc1, dev["X"], dev["mask"])
-                guard.tick(dev, acc1)
+        for _, dev in chunks:
+            acc1 = moments1_step(acc1, dev["X"], dev["mask"])
+            guard.tick(dev, acc1)
     guard.flush(acc1)
     n_h, sx_h = allreduce_sum_host(acc1["n"], acc1["sum_x"])
     n = float(n_h)
@@ -677,14 +1070,13 @@ def streamed_logreg_fit(
         vacc = jnp.zeros((d,), dtype)
         guard = StreamGuard()
         with contextlib.closing(
-            prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+            iter_device_chunks(
+                source, mesh, chunk_rows, dtype, need_y=False, need_w=False
+            )
         ) as chunks:
-            for chunk in chunks:
-                for _, dev in stage_chunks(
-                    chunk, mesh, dtype, need_y=False, need_w=False
-                ):
-                    vacc = var_chunk_step(vacc, dev["X"], dev["mask"], mean)
-                    guard.tick(dev, vacc)
+            for _, dev in chunks:
+                vacc = var_chunk_step(vacc, dev["X"], dev["mask"], mean)
+                guard.tick(dev, vacc)
         guard.flush(vacc)
         (vacc_h,) = allreduce_sum_host(vacc)
         var = jnp.asarray(vacc_h, dtype) / max(n - 1.0, 1.0)
@@ -705,17 +1097,16 @@ def streamed_logreg_fit(
         acc = {"f": jnp.zeros((), dtype), "g": jnp.zeros((p,), dtype)}
         guard = StreamGuard()
         with contextlib.closing(
-            prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+            iter_device_chunks(source, mesh, chunk_rows, dtype, need_w=False)
         ) as chunks:
-            for chunk in chunks:
-                for _, dev in stage_chunks(chunk, mesh, dtype, need_w=False):
-                    acc = logreg_chunk_vg_step(
-                        acc, dev["X"], dev["mask"], dev["y"], wd, mean_dev,
-                        inv_std,
-                        n_classes=n_classes, multinomial=multinomial,
-                        fit_intercept=fit_intercept, use_center=use_center,
-                    )
-                    guard.tick(dev, acc)
+            for _, dev in chunks:
+                acc = logreg_chunk_vg_step(
+                    acc, dev["X"], dev["mask"], dev["y"], wd, mean_dev,
+                    inv_std,
+                    n_classes=n_classes, multinomial=multinomial,
+                    fit_intercept=fit_intercept, use_center=use_center,
+                )
+                guard.tick(dev, acc)
         guard.flush(acc)
         # per-evaluation allreduce of (loss, grad) partials — the QN-loop
         # NCCL allreduce of the reference's distributed L-BFGS; every rank
@@ -779,7 +1170,6 @@ def streamed_kmeans_lloyd(
     """
     from ..parallel.mesh import allreduce_sum_host
 
-    np_dtype = np.dtype(jnp.dtype(dtype).name)
     k, d = centers0.shape
     centers = jnp.asarray(centers0, dtype)
 
@@ -791,16 +1181,15 @@ def streamed_kmeans_lloyd(
         }
         guard = StreamGuard()
         with contextlib.closing(
-            prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+            iter_device_chunks(
+                source, mesh, chunk_rows, dtype, need_y=False, need_w=False
+            )
         ) as chunks:
-            for chunk in chunks:
-                for _, dev in stage_chunks(
-                    chunk, mesh, dtype, need_y=False, need_w=False
-                ):
-                    acc = kmeans_chunk_step(
-                        acc, dev["X"], dev["mask"], cts, matmul_dtype=mm
-                    )
-                    guard.tick(dev, acc)
+            for _, dev in chunks:
+                acc = kmeans_chunk_step(
+                    acc, dev["X"], dev["mask"], cts, matmul_dtype=mm
+                )
+                guard.tick(dev, acc)
         guard.flush(acc)
         # per-iteration allreduce of (sums, counts, cost) partials — the
         # Lloyd-loop NCCL allreduce; every rank then updates identically
@@ -939,36 +1328,29 @@ def streamed_min_sq_dists_update(
         if min_d2 is None
         else min_d2
     )
-    np_dtype = np.dtype(jnp.dtype(dtype).name)
     offset = 0
     with contextlib.closing(
-        prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+        iter_device_chunks(
+            source, mesh, chunk_rows, dtype, need_y=False, need_w=False
+        )
     ) as chunks:
-        for chunk in chunks:
-            for piece, dev in stage_chunks(
-                chunk, mesh, dtype, need_y=False, need_w=False
-            ):
-                d2 = np.asarray(
-                    chunk_min_sq_dists(dev["X"], dev["mask"], cands_dev),
-                    np.float64,
-                )
-                # the d2 fetch above proves the step completed; release the
-                # chunk's buffers including the raw wire transfer (StreamGuard
-                # rationale — retention otherwise grows with total bytes
-                # shipped)
-                for a in dev.values():
-                    if a is not None:
-                        try:
-                            a.delete()
-                        except Exception:
-                            pass
-                nv = piece.n_valid
-                np.minimum(
-                    out[offset : offset + nv],
-                    d2[:nv],
-                    out=out[offset : offset + nv],
-                )
-                offset += nv
+        for piece, dev in chunks:
+            d2 = np.asarray(
+                chunk_min_sq_dists(dev["X"], dev["mask"], cands_dev),
+                np.float64,
+            )
+            # the d2 fetch above proves the step completed; release the
+            # chunk's buffers including the raw wire transfer (StreamGuard
+            # rationale — retention otherwise grows with total bytes
+            # shipped)
+            _release_buffers(dev.values())
+            nv = piece.n_valid
+            np.minimum(
+                out[offset : offset + nv],
+                d2[:nv],
+                out=out[offset : offset + nv],
+            )
+            offset += nv
     return out
 
 
@@ -979,18 +1361,16 @@ def streamed_count_closest(
     (the k-means|| candidate weights)."""
     cands_dev = jnp.asarray(cands, dtype)
     counts = jnp.zeros((cands.shape[0],), jnp.int32)
-    np_dtype = np.dtype(jnp.dtype(dtype).name)
     guard = StreamGuard()
     with contextlib.closing(
-        prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+        iter_device_chunks(
+            source, mesh, chunk_rows, dtype, need_y=False, need_w=False
+        )
     ) as chunks:
-        for chunk in chunks:
-            for _, dev in stage_chunks(
-                chunk, mesh, dtype, need_y=False, need_w=False
-            ):
-                counts = count_closest_chunk_step(
-                    counts, dev["X"], dev["mask"], cands_dev
-                )
-                guard.tick(dev, counts)
+        for _, dev in chunks:
+            counts = count_closest_chunk_step(
+                counts, dev["X"], dev["mask"], cands_dev
+            )
+            guard.tick(dev, counts)
     guard.flush(counts)
     return np.asarray(counts, np.float64)

@@ -15,7 +15,7 @@ Axis naming convention used across the framework:
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -314,6 +314,39 @@ def allgather_host(vals: np.ndarray) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(vals))
+
+
+def host_file_shard(
+    files: Any,
+    *,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> List[Any]:
+    """This host's round-robin subset of the ingest file list.
+
+    Per-host sharded ingest: with the streaming data plane partition-local
+    (see :func:`local_mesh`), N hosts reading the SAME parquet directory
+    would each decode every file and N-fold overcount the global
+    statistics at the allreduce. Round-robin assignment
+    (``files[process_index::process_count]``) makes the subsets a disjoint
+    cover, so N hosts pull N files concurrently and the existing
+    :func:`allreduce_sum_host` of partials is exact. Round-robin (not
+    contiguous blocks) keeps per-host byte counts balanced when file sizes
+    trend across the directory (time-partitioned writers).
+
+    ``process_index`` / ``process_count`` default to the live jax process
+    world; tests and ``dryrun_multichip`` override them to validate the
+    assignment without a real multi-host world. Identity when the world
+    has one process.
+    """
+    idx = jax.process_index() if process_index is None else int(process_index)
+    n = jax.process_count() if process_count is None else int(process_count)
+    if n < 1 or not (0 <= idx < n):
+        raise ValueError(f"invalid process world: index {idx} of {n}")
+    files = list(files)
+    if n == 1:
+        return files
+    return files[idx::n]
 
 
 def local_mesh(mp: int = 1) -> Mesh:

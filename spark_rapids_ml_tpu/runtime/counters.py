@@ -16,6 +16,10 @@ Counter names in use:
                         continued from (0 when nothing resumed).
 - ``cv_failed_fits``  — param combos recorded as worst-metric by the
                         CrossValidator tolerant mode (``TPUML_CV_FAILFAST=0``).
+- ``wire_release_errors`` — chunk device buffers whose post-fold
+                        ``delete()`` raised (``ops/streaming.py`` release
+                        helper); a nonzero delta means retired wire
+                        buffers may be leaking host/device memory.
 """
 
 from __future__ import annotations

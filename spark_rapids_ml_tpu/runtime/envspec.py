@@ -135,6 +135,41 @@ SPEC: Dict[str, EnvVar] = _registry(
         "memory); `0` disables the periodic sync.",
         minimum=0, category="streaming",
     ),
+    EnvVar(
+        "TPUML_WIRE_DTYPE", "choice", "f32",
+        "Host->device wire encoding of streamed feature chunks: `f32` "
+        "ships the storage dtype unchanged (the default — bit-identical "
+        "results); `f16` downcasts on host and upcasts on device; `int8` / "
+        "`f8` quantize per chunk column on host (affine / e4m3 scaled) and "
+        "dequantize inside the jitted fold step; `auto` probes the first "
+        "chunk's quantization error and picks the narrowest encoding "
+        "within tolerance (see `docs/streaming_performance.md`). "
+        "Infeasible explicit requests warn and fall back.",
+        choices=("auto", "f32", "f16", "int8", "f8"), category="streaming",
+        also_documented_in=("docs/streaming_performance.md",),
+    ),
+    EnvVar(
+        "TPUML_STREAM_STAGE_DEPTH", "int", 2,
+        "Look-ahead depth of the device-staging ring: a background thread "
+        "wire-encodes and `device_put`s up to that many chunks ahead of "
+        "the fold loop, so decode, host->device transfer, and accumulate "
+        "overlap. `0` stages serially on the consumer thread (the "
+        "pre-ring behavior). Fold order and results are identical at any "
+        "depth (see `docs/streaming_performance.md`).",
+        minimum=0, category="streaming",
+        also_documented_in=("docs/streaming_performance.md",),
+    ),
+    EnvVar(
+        "TPUML_STREAM_SHARD_FILES", "bool", False,
+        "Per-host sharded ingest: each process of a multi-host world "
+        "streams only its round-robin subset of the parquet files "
+        "(`files[process_index::process_count]`), so N hosts pull N files "
+        "concurrently; partial statistics combine through the existing "
+        "cross-process allreduce. Identity in a single-process world "
+        "(see `docs/streaming_performance.md`).",
+        category="streaming",
+        also_documented_in=("docs/streaming_performance.md",),
+    ),
     # --- native layer -----------------------------------------------------
     EnvVar(
         "TPUML_LIB", "path", None,

@@ -1,0 +1,314 @@
+"""Quantized wire formats + pipelined ingest (TPUML_WIRE_DTYPE et al).
+
+Contract under test (docs/streaming_performance.md):
+
+- the DEFAULT path (no TPUML_* set) is bit-identical to shipping f32 —
+  wire formats are strictly opt-in;
+- opted-in narrow encodings reproduce the f32 streamed fit within the
+  documented tolerances (f16 ~1e-3 relative, int8 ~2e-2 relative on
+  well-conditioned data);
+- results are independent of the pipeline depths (staging ring and
+  prefetch are pure reordering of WHEN work happens, never of what);
+- StreamGuard releases the quantized wire buffers it was handed;
+- dispatch: auto probes, infeasible f8 falls back with a warning,
+  invalid env values raise EnvSpecError.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.data.chunks import ArrayChunkSource
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.ops import streaming as st
+from spark_rapids_ml_tpu.parallel.mesh import host_file_shard, local_mesh
+from spark_rapids_ml_tpu.regression import LinearRegression
+from spark_rapids_ml_tpu.runtime import envspec
+
+
+def _suffstats(rng, wire_env=None, monkeypatch=None, n=300, d=6, **kw):
+    X = np.asarray(
+        np.random.default_rng(7).normal(size=(n, d)), np.float32
+    )
+    src = ArrayChunkSource(X)
+    return st.streamed_suffstats(src, local_mesh(), 64, np.float32, **kw), X
+
+
+def _stats_arrays(stats):
+    return {k: np.asarray(v) for k, v in stats.items()}
+
+
+class TestWireFormatParity:
+    def test_default_env_resolves_f32(self, monkeypatch):
+        monkeypatch.delenv("TPUML_WIRE_DTYPE", raising=False)
+        stats, _ = _suffstats(None)
+        assert st.last_ingest_report()["wire_dtype"] == "f32"
+
+    @pytest.mark.parametrize("wire,tol", [("f16", 2e-3), ("int8", 3e-2)])
+    def test_quantized_suffstats_within_tolerance(self, monkeypatch, wire, tol):
+        monkeypatch.delenv("TPUML_WIRE_DTYPE", raising=False)
+        base, X = _suffstats(None)
+        monkeypatch.setenv("TPUML_WIRE_DTYPE", wire)
+        quant, _ = _suffstats(None)
+        assert st.last_ingest_report()["wire_dtype"] == wire
+        for k in ("mean_all", "G", "var"):
+            b, q = np.asarray(base[k]), np.asarray(quant[k])
+            scale = max(float(np.abs(b).max()), 1e-6)
+            assert np.abs(q - b).max() / scale < tol, k
+
+    def test_pca_fit_parity_f32_vs_int8(self, rng, monkeypatch):
+        X = rng.normal(size=(240, 5)).astype(np.float32)
+        df = DataFrame({"features": X})
+
+        def fit():
+            return PCA(
+                k=2, num_workers=4, streaming=True, stream_chunk_rows=64
+            ).fit(df)
+
+        monkeypatch.delenv("TPUML_WIRE_DTYPE", raising=False)
+        m32 = fit()
+        assert m32._ingest_report["wire_dtype"] == "f32"
+        monkeypatch.setenv("TPUML_WIRE_DTYPE", "int8")
+        m8 = fit()
+        assert m8._ingest_report["wire_dtype"] == "int8"
+        # principal subspace agrees up to sign within the int8 tolerance
+        c32 = np.asarray(m32.components_)
+        c8 = np.asarray(m8.components_)
+        dots = np.abs((c32 * c8).sum(axis=1))
+        np.testing.assert_allclose(dots, 1.0, atol=5e-2)
+        np.testing.assert_allclose(
+            np.asarray(m8.explained_variance_),
+            np.asarray(m32.explained_variance_),
+            rtol=5e-2,
+        )
+
+    def test_linreg_fit_parity_f32_vs_f16(self, rng, monkeypatch):
+        X = rng.normal(size=(256, 4)).astype(np.float32)
+        w = np.asarray([1.5, -2.0, 0.5, 3.0], np.float32)
+        y = X @ w + 0.01 * rng.normal(size=(256,)).astype(np.float32)
+        df = DataFrame({"features": X, "label": y})
+
+        def fit():
+            return LinearRegression(
+                num_workers=4, streaming=True, stream_chunk_rows=64
+            ).fit(df)
+
+        monkeypatch.delenv("TPUML_WIRE_DTYPE", raising=False)
+        m32 = fit()
+        monkeypatch.setenv("TPUML_WIRE_DTYPE", "f16")
+        m16 = fit()
+        np.testing.assert_allclose(
+            np.asarray(m16.coefficients), np.asarray(m32.coefficients),
+            atol=1e-2,
+        )
+
+    def test_kmeans_fit_parity_f32_vs_int8(self, rng, monkeypatch):
+        from spark_rapids_ml_tpu.clustering import KMeans
+
+        centers = rng.normal(size=(3, 4)).astype(np.float32) * 8
+        X = np.concatenate(
+            [c + rng.normal(size=(70, 4)).astype(np.float32) for c in centers]
+        )
+        df = DataFrame({"features": X})
+
+        def fit():
+            m = KMeans(
+                k=3, maxIter=5, seed=0, num_workers=4,
+                streaming=True, stream_chunk_rows=64,
+            ).fit(df)
+            return np.asarray(sorted(m.clusterCenters(), key=tuple))
+
+        monkeypatch.delenv("TPUML_WIRE_DTYPE", raising=False)
+        c32 = fit()
+        monkeypatch.setenv("TPUML_WIRE_DTYPE", "int8")
+        c8 = fit()
+        # same blobs recovered: centers agree to the quantization scale
+        np.testing.assert_allclose(c8, c32, atol=0.5)
+
+
+class TestDefaultBitIdentity:
+    def test_unset_equals_explicit_f32_bitwise(self, monkeypatch):
+        monkeypatch.delenv("TPUML_WIRE_DTYPE", raising=False)
+        a, _ = _suffstats(None)
+        monkeypatch.setenv("TPUML_WIRE_DTYPE", "f32")
+        b, _ = _suffstats(None)
+        for k, av in _stats_arrays(a).items():
+            np.testing.assert_array_equal(av, np.asarray(b[k]), err_msg=k)
+
+    @pytest.mark.parametrize("depth", ["0", "1", "5"])
+    def test_results_independent_of_stage_depth(self, monkeypatch, depth):
+        monkeypatch.delenv("TPUML_STREAM_STAGE_DEPTH", raising=False)
+        base, _ = _suffstats(None, with_y=False)
+        monkeypatch.setenv("TPUML_STREAM_STAGE_DEPTH", depth)
+        got, _ = _suffstats(None, with_y=False)
+        assert st.last_ingest_report()["stage_depth"] == int(depth)
+        for k, bv in _stats_arrays(base).items():
+            np.testing.assert_array_equal(np.asarray(got[k]), bv, err_msg=k)
+
+    @pytest.mark.parametrize("prefetch", ["0", "4"])
+    def test_results_independent_of_prefetch_depth(self, monkeypatch, prefetch):
+        monkeypatch.delenv("TPUML_STREAM_PREFETCH", raising=False)
+        base, _ = _suffstats(None)
+        monkeypatch.setenv("TPUML_STREAM_PREFETCH", prefetch)
+        got, _ = _suffstats(None)
+        for k, bv in _stats_arrays(base).items():
+            np.testing.assert_array_equal(np.asarray(got[k]), bv, err_msg=k)
+
+    def test_int8_results_independent_of_stage_depth(self, monkeypatch):
+        # the quantize-then-ship path must also be pure reordering
+        monkeypatch.setenv("TPUML_WIRE_DTYPE", "int8")
+        monkeypatch.setenv("TPUML_STREAM_STAGE_DEPTH", "0")
+        a, _ = _suffstats(None)
+        monkeypatch.setenv("TPUML_STREAM_STAGE_DEPTH", "3")
+        b, _ = _suffstats(None)
+        for k, av in _stats_arrays(a).items():
+            np.testing.assert_array_equal(av, np.asarray(b[k]), err_msg=k)
+
+
+class TestDispatch:
+    def test_invalid_wire_dtype_raises(self, monkeypatch):
+        monkeypatch.setenv("TPUML_WIRE_DTYPE", "int4")
+        with pytest.raises(envspec.EnvSpecError, match="TPUML_WIRE_DTYPE"):
+            st.resolve_wire_dtype()
+
+    def test_invalid_stage_depth_raises(self, monkeypatch):
+        monkeypatch.setenv("TPUML_STREAM_STAGE_DEPTH", "-1")
+        with pytest.raises(envspec.EnvSpecError):
+            envspec.get("TPUML_STREAM_STAGE_DEPTH")
+
+    def test_auto_picks_int8_on_bounded_data(self, monkeypatch):
+        monkeypatch.setenv("TPUML_WIRE_DTYPE", "auto")
+        x = np.random.default_rng(0).normal(size=(128, 4)).astype(np.float32)
+        assert st.select_wire_format(x) == "int8"
+
+    def test_auto_falls_back_on_wide_dynamic_range(self, monkeypatch):
+        monkeypatch.setenv("TPUML_WIRE_DTYPE", "auto")
+        # adversarial columns: symmetric f16-overflowing outliers stretch
+        # the int8 bins to ~787 units AND the bulk sits mid-bin (~scale/2
+        # off the nearest representable value), so its reconstruction error
+        # is ~390 against a data RMS of ~3e3 (rel ~0.12 > the 2e-2 gate);
+        # the outliers themselves overflow f16 — both narrow probes fail
+        x = np.random.default_rng(0).normal(size=(2048, 3)).astype(np.float32)
+        x += 400.0
+        x[0] = 1e5
+        x[1] = -1e5
+        with np.errstate(over="ignore"):
+            assert st.select_wire_format(x) == "f32"
+
+    def test_f8_unsupported_falls_back_to_f16(self, monkeypatch):
+        monkeypatch.setattr(st, "_f8_supported", lambda: False)
+        kind = st.select_wire_format(
+            np.ones((8, 2), np.float32), requested="f8"
+        )
+        assert kind == "f16"
+
+    def test_non_float_storage_ships_as_is(self):
+        x = np.arange(32, dtype=np.int32).reshape(8, 4)
+        assert st.select_wire_format(x, requested="int8") == "f32"
+
+
+class TestGuardReleasesQuantizedBuffers:
+    def test_wire_buffers_deleted_after_flush(self, monkeypatch):
+        from spark_rapids_ml_tpu.data.chunks import Chunk
+
+        mesh = local_mesh()
+        chunk = Chunk(
+            X=np.random.default_rng(1).normal(size=(16, 3)).astype(np.float32),
+            n_valid=16,
+        )
+        dev = st.put_chunk(chunk, mesh, np.float32, wire="int8")
+        assert isinstance(dev["X"], st.QuantizedWire)
+        wire_bufs = list(dev["_wire"])
+        assert len(wire_bufs) == 3  # q + scale + offset
+        guard = st.StreamGuard()
+        acc = st.moments1_init(3, np.float32, False)
+        acc = st.moments1_step(acc, dev["X"], dev["mask"])
+        guard.tick(dev, acc)
+        guard.flush(acc)
+        assert all(b.is_deleted() for b in wire_bufs)
+
+    def test_release_errors_counted_not_raised(self, monkeypatch):
+        from spark_rapids_ml_tpu.runtime import counters
+
+        class Boom:
+            def delete(self):
+                raise RuntimeError("boom")
+
+        before = counters.get("wire_release_errors")
+        st._release_buffers([Boom(), None, Boom()])
+        assert counters.get("wire_release_errors") == before + 2
+
+
+class TestQuantizedWire:
+    def test_dense_roundtrip_error_bound(self):
+        x = np.random.default_rng(3).normal(size=(64, 5)).astype(np.float32)
+        q, scale, offset = st._quantize_int8(x, 64)
+        rec = q.astype(np.float32) * scale + offset
+        rms = np.sqrt((x * x).mean())
+        assert np.sqrt(((rec - x) ** 2).mean()) / rms < 2e-2
+
+    def test_constant_column_exact(self):
+        x = np.full((32, 2), 3.5, np.float32)
+        q, scale, offset = st._quantize_int8(x, 32)
+        np.testing.assert_array_equal(
+            q.astype(np.float32) * scale + offset, x
+        )
+
+    def test_padding_rows_excluded_from_ranges(self):
+        x = np.zeros((8, 1), np.float32)
+        x[:4] = np.asarray([[1.0], [2.0], [3.0], [4.0]])
+        x[4:] = 1e9  # garbage padding must not blow up the scale
+        _, scale, _ = st._quantize_int8(x, 4)
+        assert float(scale[0]) < 0.1
+
+    def test_fold_step_dequantizes_inside_jit(self):
+        mesh = local_mesh()
+        from spark_rapids_ml_tpu.data.chunks import Chunk
+
+        x = np.random.default_rng(5).normal(size=(16, 3)).astype(np.float32)
+        dev = st.put_chunk(Chunk(X=x, n_valid=16), mesh, np.float32, wire="int8")
+        acc = st.moments1_init(3, np.float32, False)
+        acc = st.moments1_step(acc, dev["X"], dev["mask"])
+        dense = np.asarray(st.wire_dense(dev["X"]))
+        np.testing.assert_allclose(
+            np.asarray(acc["sum_x"]), dense.sum(axis=0), rtol=1e-5
+        )
+        # and the dequantized matrix tracks the original to int8 precision
+        assert np.abs(dense - x).max() < np.abs(x).max() / 100
+
+
+class TestHostFileShard:
+    def test_disjoint_cover_and_balance(self):
+        files = [f"f{i}" for i in range(10)]
+        shards = [
+            host_file_shard(files, process_index=i, process_count=3)
+            for i in range(3)
+        ]
+        assert sorted(f for s in shards for f in s) == files
+        sizes = sorted(len(s) for s in shards)
+        assert sizes[-1] - sizes[0] <= 1
+
+    def test_identity_single_process(self):
+        files = ["a", "b"]
+        assert host_file_shard(files, process_index=0, process_count=1) == files
+
+    def test_invalid_world_raises(self):
+        with pytest.raises(ValueError):
+            host_file_shard(["a"], process_index=2, process_count=2)
+
+    def test_env_gated_in_parquet_source(self, tmp_path, rng, monkeypatch):
+        from spark_rapids_ml_tpu.data.chunks import ParquetChunkSource
+
+        X = rng.normal(size=(60, 3)).astype(np.float32)
+        path = str(tmp_path / "ds")
+        DataFrame({"features": X}).write_parquet(path, rows_per_file=10)
+        monkeypatch.delenv("TPUML_STREAM_SHARD_FILES", raising=False)
+        full = ParquetChunkSource(path)
+        assert full.n_rows == 60  # default: no sharding, single process
+        monkeypatch.setenv("TPUML_STREAM_SHARD_FILES", "1")
+        sharded = ParquetChunkSource(path)
+        # single-process world: sharding is the identity
+        assert sharded.n_rows == 60
+        assert sharded._files == full._files
