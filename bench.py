@@ -569,6 +569,7 @@ def bench_rf(X, mask, y, mesh, n_chips):
         next_pow2,
         resolve_contract_gather,
         resolve_hist_strategy,
+        resolve_tree_batch,
     )
 
     n_dp = mesh.shape["dp"]
@@ -625,10 +626,19 @@ def bench_rf(X, mask, y, mesh, n_chips):
         jax.random.split(jax.random.key(7), n_dp * trees_per_dev)
     ).reshape(n_dp, trees_per_dev, 2)
     keys = jax.device_put(np.asarray(keys), NamedSharding(mesh, P("dp")))
+    # tree-batched growth (TPUML_RF_TREE_BATCH, default auto): the whole
+    # dispatch group advances one level per device program instead of
+    # lax.map-ing trees sequentially — same resolution the estimator uses,
+    # so the bench measures exactly what the library ships
+    rows_per_tree = n_rf // n_dp
+    tree_batch = resolve_tree_batch(group, cfg, rows_per_tree)
 
     def timed_fn(bins, ms, stats, kg):
         return _checksum(
-            build_forest(bins, ms, stats, kg, mesh=mesh, cfg=cfg)
+            build_forest(
+                bins, ms, stats, kg, mesh=mesh, cfg=cfg,
+                tree_batch=tree_batch,
+            )
         )
 
     timed = jax.jit(timed_fn)
@@ -672,6 +682,39 @@ def bench_rf(X, mask, y, mesh, n_chips):
             break
     t = min(times)
     n_trees = trees_per_dev * n_dp
+    # per-level cost: each group dispatch walks RF_DEPTH levels, groups
+    # run back-to-back, so the derived average is t / (levels * groups).
+    # BENCH_RF_LEVEL_TIMING=1 replaces the average with MEASURED marginal
+    # level costs — depth-prefix builds of one group, differenced — at
+    # the price of one compile per depth (tuning runs only).
+    n_groups = len(kgs)
+    seconds_per_level = t / (RF_DEPTH * n_groups)
+    level_seconds = None
+    if os.environ.get("BENCH_RF_LEVEL_TIMING") == "1":
+        prefix_t = []
+        for dep in range(1, RF_DEPTH + 1):
+            cfg_l = cfg._replace(max_depth=dep)
+            tb_l = resolve_tree_batch(group, cfg_l, rows_per_tree)
+            # per-depth variant, compiled once and reused for the timed
+            # call  # tpuml: ignore[TPU003]
+            f_l = jax.jit(
+                lambda b, m, s, kg, _c=cfg_l, _tb=tb_l: _checksum(
+                    build_forest(
+                        b, m, s, kg, mesh=mesh, cfg=_c, tree_batch=_tb
+                    )
+                )
+            )
+            np.asarray(f_l(bins, ms, stats, warm_keys))  # compile
+            # perturb stats so a memoizing remote backend re-executes
+            s_l = stats * jnp.float32(1.0 + dep * 1e-6)
+            jax.block_until_ready(s_l)
+            t0l = time.perf_counter()
+            np.asarray(f_l(bins, ms, s_l, warm_keys))
+            prefix_t.append(time.perf_counter() - t0l)
+        level_seconds = [round(prefix_t[0], 4)] + [
+            round(max(0.0, b - a), 4)
+            for a, b in zip(prefix_t, prefix_t[1:])
+        ]
     # transform path: the two-hop bin-space descent the model uses on TPU
     # (round 5; binize of the query batch is timed INSIDE, as the model
     # pays it per batch), over the FULL forest width (one built group's
@@ -680,7 +723,9 @@ def bench_rf(X, mask, y, mesh, n_chips):
 
     # one-shot warm build, outside the timed region  # tpuml: ignore[TPU003]
     grp = jax.jit(
-        lambda b, m, s, kg: build_forest(b, m, s, kg, mesh=mesh, cfg=cfg)
+        lambda b, m, s, kg: build_forest(
+            b, m, s, kg, mesh=mesh, cfg=cfg, tree_batch=tree_batch
+        )
     )(bins, ms, stats, warm_keys)
     feat_g = grp["feature"].reshape(-1, grp["feature"].shape[-1])
     thr_b = grp["threshold_bin"].reshape(feat_g.shape)
@@ -778,6 +823,10 @@ def bench_rf(X, mask, y, mesh, n_chips):
         "trees": n_trees,
         "rows": n_rf,
         "k_features": k_feat,
+        "hist_strategy": cfg.hist_strategy,
+        "tree_batch": tree_batch,
+        "seconds_per_level": round(seconds_per_level, 5),
+        **({"level_seconds": level_seconds} if level_seconds else {}),
         "flops_model": updates,  # scatter-equivalent work, not MXU flops
         "baseline_samples_per_sec": 1.8e9 / (k_feat * RF_DEPTH * 2),
         "baseline_inputs": {
@@ -785,6 +834,191 @@ def bench_rf(X, mask, y, mesh, n_chips):
             "atomics_per_sec": 1.8e9,
             "k_features": k_feat,
             "depth": RF_DEPTH,
+            "n_stats": 2,
+            "transform_formula": "fil_node_fetch_v1",
+            "node_fetches_per_sec": 1e10,
+        },
+    }
+
+
+GBT_ROUNDS = int(os.environ.get("BENCH_GBT_ROUNDS", 20))
+GBT_ROWS = int(os.environ.get("BENCH_GBT_ROWS", 131_072))
+GBT_DEPTH = int(os.environ.get("BENCH_GBT_DEPTH", 8))
+
+
+def bench_gbt(X, mask, y, mesh, n_chips):
+    """Binary logistic gradient boosting (``ops/gbt_kernels.gbt_round``):
+    sequential rounds, each a tree-batched level-wise build over the
+    current gradient field plus an in-round margin advance. Rows stay
+    data-parallel — every tree sees the full dataset through psum'd
+    histograms — so unlike rf the round chain has collectives, and the
+    fit rate measures the boosting-loop steady state (stats recompute,
+    T-batched histograms, leaf Newton steps, margin update).
+
+    Throughput unit matches rf: tree-samples/sec/chip (rows x trees /
+    seconds).
+
+    Baseline model (derived roofline like ann): XGBoost-class GPU hist
+    boosting on the A10G is bound by the same shared-memory histogram
+    atomics as the RF baseline (1.8e9 updates/s) but pays ALL d features
+    per node (boosted trees don't subsample features per split) x depth
+    levels x 2 stats (grad, hess) per tree-sample; the per-round
+    gradient/margin streaming passes are charged at zero (they are HBM
+    reads the histogram pass already pays)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_ml_tpu.ops.gbt_kernels import GBTConfig, gbt_round
+    from spark_rapids_ml_tpu.ops.tree_kernels import (
+        ForestConfig,
+        binize,
+        next_pow2,
+        resolve_contract_gather,
+        resolve_hist_strategy,
+    )
+
+    n_dp = mesh.shape["dp"]
+    n_g = min(GBT_ROWS, X.shape[0])
+    n_g = max(n_dp, (n_g // n_dp) * n_dp)
+    Xs, ys, ms = X[:n_g], y[:n_g], mask[:n_g]
+    d_pad = next_pow2(N_COLS)
+    qs = jnp.linspace(0.0, 1.0, RF_BINS + 1)[1:-1]
+    # one-shot setup jit (same device-side sketch as rf)
+    # tpuml: ignore[TPU003]
+    edges = jax.jit(
+        lambda Xs: jnp.quantile(Xs[: min(65536, n_g)], qs, axis=0).T.astype(
+            jnp.float32
+        )
+    )(Xs)
+    bins = binize(Xs, edges, d_pad=d_pad)
+    cfg = GBTConfig(
+        loss="logistic", n_out=1, learning_rate=0.1,
+        tree=ForestConfig(
+            max_depth=GBT_DEPTH, n_bins=RF_BINS, n_features=N_COLS,
+            n_stats=4, impurity="variance", k_features=N_COLS,
+            min_samples_leaf=1, min_info_gain=0.0, min_samples_split=2,
+            bootstrap=False,
+            hist_strategy=resolve_hist_strategy(),
+            contract_gather=resolve_contract_gather(),
+        ),
+    )
+    keys_np = np.asarray(jax.random.split(jax.random.PRNGKey(7), GBT_ROUNDS))
+    zeros = jax.device_put(
+        np.zeros((n_g, 1), np.float32), NamedSharding(mesh, P("dp"))
+    )
+    warm_key = jnp.asarray(np.asarray(jax.random.PRNGKey(99)))
+    # compile on a distinct key (remote-memoization discipline, as in rf)
+    out_w = gbt_round(bins, ms, ys, zeros, warm_key, mesh=mesh, cfg=cfg)
+    jax.block_until_ready(out_w["margins"])
+
+    reps = max(1, int(os.environ.get("BENCH_GBT_REPS", 2)))
+    times = []
+    last = None
+    for rep in range(reps):
+        # a fresh epsilon init perturbs every round's stats so a
+        # memoizing remote backend cannot replay the chain
+        margins = zeros + jnp.float32((rep + 1) * 1e-6)
+        jax.block_until_ready(margins)
+        t0 = time.perf_counter()
+        outs = []
+        for r in range(GBT_ROUNDS):
+            out = gbt_round(
+                bins, ms, ys, margins, jnp.asarray(keys_np[r]),
+                mesh=mesh, cfg=cfg,
+            )
+            margins = out.pop("margins")
+            outs.append(out)
+        jax.block_until_ready(margins)
+        times.append(time.perf_counter() - t0)
+        last = outs
+    t = min(times)
+
+    # transform leg: the model's descent engines over the boosted forest
+    # (summed leaf payloads; margin = init + sum), packed when the
+    # traversal kernel lowers, else the two-hop bins descent — the same
+    # engine split the rf entry reports
+    feat_t = jnp.concatenate([o["feature"] for o in last], axis=0)
+    thrb_t = jnp.concatenate([o["threshold_bin"] for o in last], axis=0)
+    vals_t = jnp.concatenate([o["values"] for o in last], axis=0)[:, :, None]
+    jax.block_until_ready((feat_t, thrb_t, vals_t))
+    from spark_rapids_ml_tpu.ops.rf_pallas import packed_traverse_ok
+    from spark_rapids_ml_tpu.ops.tree_kernels import (
+        pack_forest, rf_eval_bins, rf_eval_packed,
+    )
+
+    d_pad4 = -(-Xs.shape[1] // 4) * 4
+    pf = pack_forest(
+        np.asarray(feat_t), np.asarray(thrb_t), max_depth=GBT_DEPTH
+    )
+    use_packed = pf.k2 == 0 or packed_traverse_ok(
+        pf.feat1.shape[0], pf.k1, pf.k2, d_pad4 // 4
+    )
+    n_half = n_g // 2
+    if use_packed:
+        pk = tuple(
+            jax.device_put(a) for a in (pf.feat1, pf.thr1, pf.feat2, pf.thr2)
+        )
+        jax.block_until_ready(pk)
+
+        def tr_fn(Xq, edges, feat_t, thrb_t, vals_t):
+            acc = jnp.float32(0.0)
+            for lo in (0, n_g - n_half):
+                xbq = binize(Xq[lo : lo + n_half], edges, d_pad=d_pad4)
+                acc = acc + _checksum(
+                    rf_eval_packed(
+                        xbq, *pk, vals_t,
+                        k1=pf.k1, k2=pf.k2, max_depth=GBT_DEPTH,
+                    )
+                )
+            return acc
+
+    else:
+
+        def tr_fn(Xq, edges, feat_t, thrb_t, vals_t):
+            acc = jnp.float32(0.0)
+            for lo in (0, n_g - n_half):
+                xbq = binize(Xq[lo : lo + n_half], edges, d_pad=d_pad4)
+                acc = acc + _checksum(
+                    rf_eval_bins(
+                        xbq, feat_t, thrb_t, vals_t,
+                        max_depth=GBT_DEPTH, group=4,
+                    )
+                )
+            return acc
+
+    tr_timed = jax.jit(tr_fn)
+    np.asarray(tr_timed(Xs, edges, feat_t, thrb_t, vals_t))  # compile
+    t_tr, _ = _best_time(
+        lambda rep: (
+            Xs * jnp.float32(1.0 + (rep + 1) * 1e-6), edges, feat_t,
+            thrb_t, vals_t,
+        ),
+        tr_timed,
+    )
+    n_trees = GBT_ROUNDS * cfg.n_out
+    updates = float(n_g) * N_COLS * 2 * GBT_DEPTH * n_trees
+    return {
+        "samples_per_sec_per_chip": n_g * n_trees / t / n_chips,
+        "fit_seconds": t,
+        "transform_seconds": t_tr,
+        "transform_engine": "packed" if use_packed else "bins",
+        "transform_samples_per_sec_per_chip": n_g / t_tr / n_chips,
+        "transform_baseline_samples_per_sec": 1e10 / (n_trees * GBT_DEPTH),
+        "rounds": GBT_ROUNDS,
+        "trees": n_trees,
+        "rows": n_g,
+        "depth": GBT_DEPTH,
+        "hist_strategy": cfg.tree.hist_strategy,
+        "seconds_per_round": round(t / GBT_ROUNDS, 5),
+        "flops_model": updates,  # scatter-equivalent work, not MXU flops
+        "baseline_samples_per_sec": 1.8e9 / (N_COLS * GBT_DEPTH * 2),
+        "baseline_kind": "derived-roofline",
+        "baseline_inputs": {
+            "formula": "gbt_hist_atomics_v1",
+            "atomics_per_sec": 1.8e9,
+            "d": N_COLS,
+            "depth": GBT_DEPTH,
             "n_stats": 2,
             "transform_formula": "fil_node_fetch_v1",
             "node_fetches_per_sec": 1e10,
@@ -1409,7 +1643,7 @@ def main() -> None:
         N_ROWS = min(N_ROWS, 50_000)
         CSIZE = _csize(N_ROWS)
         global RF_ROWS, RF_TREES, RF_DEPTH, KNN_QUERIES, KNN_ITEMS, UMAP_ROWS
-        global ANN_ROWS, ANN_QUERIES
+        global ANN_ROWS, ANN_QUERIES, GBT_ROWS, GBT_ROUNDS, GBT_DEPTH
         if "BENCH_UMAP_ROWS" not in os.environ:
             UMAP_ROWS = 2048
         if "BENCH_KNN_QUERIES" not in os.environ:
@@ -1426,6 +1660,12 @@ def main() -> None:
             RF_TREES = 4
         if "BENCH_RF_DEPTH" not in os.environ:
             RF_DEPTH = 8
+        if "BENCH_GBT_ROWS" not in os.environ:
+            GBT_ROWS = 8192
+        if "BENCH_GBT_ROUNDS" not in os.environ:
+            GBT_ROUNDS = 4
+        if "BENCH_GBT_DEPTH" not in os.environ:
+            GBT_DEPTH = 5
         print(
             f"[bench] cpu device: reducing N_ROWS to {N_ROWS}, "
             f"rf to {RF_TREES}x{RF_ROWS}x depth {RF_DEPTH} "
@@ -1495,6 +1735,7 @@ def main() -> None:
         "logreg": lambda: bench_logreg(*_X(), mesh, n_chips),
         "linreg": lambda: bench_linreg(*_X(), mesh, n_chips),
         "rf": lambda: bench_rf(*_X(), mesh, n_chips),
+        "gbt": lambda: bench_gbt(*_X(), mesh, n_chips),
         "knn": lambda: bench_knn(*_X()[:2], mesh, n_chips),
     }
     # BENCH_ONLY=rf,kmeans : run a subset (tuning loops); full runs only
@@ -1662,6 +1903,8 @@ def _emit_line(results, meta, watchdog_tripped):
         "init_seconds", "sgd_seconds", "epoch_ms",
         "sgd_engine", "retries", "resumed_from",
         "wire_dtype", "decode_seconds",
+        "hist_strategy", "tree_batch", "seconds_per_level",
+        "level_seconds", "rounds", "depth", "seconds_per_round",
     )
     for name, r in results.items():
         line[name] = {

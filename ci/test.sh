@@ -52,14 +52,89 @@ fi
 echo "== benchmark smoke =="
 ./run_benchmark.sh cpu 5000 64
 
-echo "== transform bench smoke (rf packed engine + umap) =="
-# Serving-path contract: the rf and umap entries must emit
+echo "== transform bench smoke (rf packed engine + gbt + umap) =="
+# Serving-path contract: the rf, gbt, and umap entries must emit
 # transform_vs_baseline (BENCH_REQUIRE_TRANSFORM makes a silently
-# dropped rf transform metric a hard failure). Tiny CPU scales — this
-# checks the metric plumbing, not the TPU throughput target.
-JAX_PLATFORMS=cpu BENCH_ONLY=rf,umap BENCH_REQUIRE_TRANSFORM=rf,umap \
+# dropped transform metric a hard failure), and the rf entry must carry
+# the tree-batch provenance columns. Tiny CPU scales — this checks the
+# metric plumbing, not the TPU throughput target.
+JAX_PLATFORMS=cpu BENCH_ONLY=rf,gbt,umap BENCH_REQUIRE_TRANSFORM=rf,gbt,umap \
     BENCH_ROWS=4096 BENCH_RF_ROWS=4096 BENCH_RF_TREES=4 BENCH_RF_DEPTH=8 \
-    BENCH_UMAP_ROWS=1024 python bench.py
+    BENCH_GBT_ROWS=4096 BENCH_GBT_ROUNDS=3 BENCH_GBT_DEPTH=4 \
+    BENCH_UMAP_ROWS=1024 python bench.py > /tmp/tpuml_bench_tree.out
+python - <<'EOF'
+import json
+
+with open("/tmp/tpuml_bench_tree.out") as f:
+    line = json.loads(f.read().strip().splitlines()[-1])
+rf, gbt = line["rf"], line["gbt"]
+assert rf["tree_batch"] >= 1 and rf["hist_strategy"], rf
+assert rf["seconds_per_level"] > 0, rf
+assert "transform_vs_baseline" in gbt and gbt["seconds_per_round"] > 0, gbt
+print(
+    "bench rf/gbt columns OK: tree_batch", rf["tree_batch"],
+    "hist", rf["hist_strategy"], "gbt engine", gbt["transform_engine"],
+)
+EOF
+
+echo "== tree-batched growth dispatch + gbt fit/transform smoke =="
+# TPUML_RF_TREE_BATCH contract: off and auto produce bit-identical
+# forests at the same seed (batched growth is an execution-shape choice,
+# never a semantics choice), bad values fail loudly, and the GBT
+# estimators fit + transform end to end on the same engine stack.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+import numpy as np
+
+from spark_rapids_ml_tpu.classification import (
+    GBTClassifier, RandomForestClassifier,
+)
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.ops.tree_kernels import (
+    ForestConfig, resolve_tree_batch,
+)
+from spark_rapids_ml_tpu.runtime import envspec
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(600, 16)).astype(np.float32)
+y = (X[:, 0] - X[:, 2] > 0).astype(np.float64)
+df = DataFrame({"features": X, "label": y})
+
+kw = dict(numTrees=8, maxDepth=5, seed=3)
+os.environ["TPUML_RF_TREE_BATCH"] = "off"
+m_off = RandomForestClassifier(**kw).fit(df)
+os.environ["TPUML_RF_TREE_BATCH"] = "auto"
+m_auto = RandomForestClassifier(**kw).fit(df)
+os.environ.pop("TPUML_RF_TREE_BATCH")
+np.testing.assert_array_equal(m_off._features_arr, m_auto._features_arr)
+np.testing.assert_array_equal(m_off._thresholds_arr, m_auto._thresholds_arr)
+np.testing.assert_array_equal(m_off._leaf_stats_arr, m_auto._leaf_stats_arr)
+
+cfg = ForestConfig(
+    max_depth=4, n_bins=32, n_features=16, n_stats=2, impurity="gini",
+    k_features=16, min_samples_leaf=1, min_info_gain=0.0,
+    min_samples_split=2, bootstrap=True,
+)
+os.environ["TPUML_RF_TREE_BATCH"] = "nonsense"
+try:
+    resolve_tree_batch(8, cfg, 600)
+except envspec.EnvSpecError:
+    pass
+else:
+    raise SystemExit("TPUML_RF_TREE_BATCH=nonsense did not raise")
+finally:
+    os.environ.pop("TPUML_RF_TREE_BATCH")
+
+model = GBTClassifier(maxIter=4, maxDepth=3, seed=1).fit(df)
+out = model.transform(df)
+acc = float((np.asarray(out["prediction"]) == y).mean())
+assert acc > 0.9, acc
+prob = np.asarray(out["probability"])
+assert prob.shape == (600, 2)
+np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+print(f"tree-batch dispatch + gbt smoke OK (gbt acc {acc:.3f})")
+EOF
 
 echo "== umap sgd engine dispatch smoke =="
 # TPUML_UMAP_OPT contract: bad modes fail loudly, and on a CPU host both

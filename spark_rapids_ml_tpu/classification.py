@@ -2,10 +2,17 @@
 ``spark_rapids_ml.classification`` (``/root/reference/python/src/spark_rapids_ml/classification.py``)."""
 
 from .models.classification import LogisticRegression, LogisticRegressionModel
-from .models.tree import RandomForestClassificationModel, RandomForestClassifier
+from .models.tree import (
+    GBTClassificationModel,
+    GBTClassifier,
+    RandomForestClassificationModel,
+    RandomForestClassifier,
+)
 from .pipeline import OneVsRest, OneVsRestModel  # pyspark.ml.classification layout
 
 __all__ = [
+    "GBTClassifier",
+    "GBTClassificationModel",
     "LogisticRegression",
     "LogisticRegressionModel",
     "OneVsRest",

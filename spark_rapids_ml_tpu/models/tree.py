@@ -42,10 +42,11 @@ from ..params import (
     TypeConverters,
     _mk,
 )
-from ..parallel.mesh import DP_AXIS
+from ..parallel.mesh import DP_AXIS, fetch_global, gather_rows_global
 from ..ops.tree_kernels import (
     resolve_contract_gather,
     resolve_hist_strategy,
+    resolve_tree_batch,
     ForestConfig,
     binize,
     build_forest,
@@ -223,6 +224,33 @@ def _resolve_k_features(
     return max(1, min(int(k), d))
 
 
+def _quantize_features(
+    inputs: "FitInputs", n_bins: int, d_pad: int, seed: int, algo: str
+):
+    """Host quantile sketch -> device binize, shared by the forest and
+    boosting fits. Strided VALID-row sample: unbiased under any dataset
+    sort order (a prefix sample would skew edges on sorted data), and
+    mask-aware so per-process padding rows never enter the sketch."""
+    step = max(1, inputs.n_rows // 131072)
+    valid_pos = np.nonzero(fetch_global(inputs.mask, inputs.mesh) > 0)[0]
+    sample = gather_rows_global(inputs.X, valid_pos[::step], inputs.mesh)
+    # Input contract: features must be FINITE. binize routes NaN to bin 0
+    # (compare-count semantics; see its docstring) where searchsorted
+    # would route it to the top bin — consistent between fit and
+    # transform, but silently different from engines that impute. The
+    # quantile sample is already on the host, so screening it is ~free;
+    # TPUML_RF_CHECK_FINITE=1 extends the check to every transform batch.
+    if not np.isfinite(sample).all():
+        raise ValueError(
+            f"{algo} features contain NaN/Inf; clean or "
+            "impute before fit (binize would route non-finite "
+            "values to bin 0)"
+        )
+    edges_np = make_bin_edges(sample, n_bins, seed=seed)
+    bins = binize(inputs.X, jnp.asarray(edges_np), d_pad=d_pad)
+    return edges_np, bins
+
+
 class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _RandomForestParams):
     """Shared fit machinery (reference ``_RandomForestEstimator``,
     ``tree.py:230-420``)."""
@@ -314,30 +342,10 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
             d_pad = next_pow2(d)
             seed = int(params.get("random_state") or 0)
 
-            # 1) quantize features (host quantile sketch -> device binize).
-            # Strided VALID-row sample: unbiased under any dataset sort
-            # order (a prefix sample would skew edges on sorted data), and
-            # mask-aware so per-process padding rows never enter the sketch
-            from ..parallel.mesh import fetch_global, gather_rows_global
-
-            step = max(1, inputs.n_rows // 131072)
-            valid_pos = np.nonzero(fetch_global(inputs.mask, inputs.mesh) > 0)[0]
-            sample = gather_rows_global(inputs.X, valid_pos[::step], inputs.mesh)
-            # Input contract: features must be FINITE. binize routes NaN
-            # to bin 0 (compare-count semantics; see its docstring) where
-            # searchsorted would route it to the top bin — consistent
-            # between fit and transform, but silently different from
-            # engines that impute. The quantile sample is already on the
-            # host, so screening it is ~free; TPUML_RF_CHECK_FINITE=1
-            # extends the check to every transform batch.
-            if not np.isfinite(sample).all():
-                raise ValueError(
-                    "RandomForest features contain NaN/Inf; clean or "
-                    "impute before fit (binize would route non-finite "
-                    "values to bin 0)"
-                )
-            edges_np = make_bin_edges(sample, n_bins, seed=seed)
-            bins = binize(inputs.X, jnp.asarray(edges_np), d_pad=d_pad)
+            # 1) quantize features (host quantile sketch -> device binize)
+            edges_np, bins = _quantize_features(
+                inputs, n_bins, d_pad, seed, "RandomForest"
+            )
 
             # 2) per-row sufficient stats
             stats = self._label_stats(inputs.y, n_stats)
@@ -393,6 +401,11 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
             # (observed: 50 deep trees in one call crashed the worker
             # where 8-tree calls succeed); groups also amortize compiles
             group = min(t_local, 8)
+            # tree-batched growth (TPUML_RF_TREE_BATCH): B trees advance
+            # one level per dispatch, bit-identical to sequential at the
+            # same keys — the budget sees the rows each tree actually
+            # trains on (gathered vs local shard)
+            rows_per_tree = n_pad_global if gather else n_pad_global // n_dp
             # per key: list of host arrays shaped (n_dp, group_size, ...)
             pieces: Dict[str, List[np.ndarray]] = {}
             for g0 in range(0, t_local, group):
@@ -401,6 +414,7 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
                 outg = build_forest(
                     bins, inputs.mask, stats, kg,
                     mesh=inputs.mesh, cfg=cfg, gather=gather,
+                    tree_batch=resolve_tree_batch(gsz, cfg, rows_per_tree),
                 )
                 for k, a in outg.items():
                     h = fetch_global(a, inputs.mesh)
@@ -447,13 +461,15 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
         return _fit
 
 
-class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
-    """Shared model surface (reference ``_RandomForestModel``,
-    ``tree.py:423-614``)."""
+class _ForestModelBase(_TpuModel):
+    """Shared fitted-forest surface: node-table accessors, structure
+    introspection, and the three-engine transform dispatch
+    (packed lockstep > bin-space descent > raw-threshold descent).
 
-    def __init__(self, **attrs: Any) -> None:
-        _TpuModel.__init__(self, **attrs)
-        _RandomForestParams.__init__(self)
+    RandomForest and GBT models both ride this base — the engines only
+    need ``features``/``threshold_bins``/``bin_edges`` tables plus a
+    per-node payload, which subclasses supply (leaf vote distributions /
+    means for the forest, margin contributions for boosting)."""
 
     # -- forest structure --------------------------------------------------
     @property
@@ -711,8 +727,17 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
         combined._cv_models = list(models)
         return combined
 
-    def _eval_models(self) -> List["_RandomForestModel"]:
+    def _eval_models(self) -> List["_ForestModelBase"]:
         return getattr(self, "_cv_models", None) or [self]
+
+
+class _RandomForestModel(_RandomForestClass, _ForestModelBase, _RandomForestParams):
+    """Shared model surface (reference ``_RandomForestModel``,
+    ``tree.py:423-614``)."""
+
+    def __init__(self, **attrs: Any) -> None:
+        _ForestModelBase.__init__(self, **attrs)
+        _RandomForestParams.__init__(self)
 
 
 # ---------------------------------------------------------------------------
@@ -1052,3 +1077,606 @@ class RandomForestRegressionModel(_RandomForestModel):
             ).evaluate(evaluator)
             for m in self._eval_models()
         ]
+
+
+# ---------------------------------------------------------------------------
+# gradient-boosted trees
+# ---------------------------------------------------------------------------
+#
+# Spark ML drop-ins for GBTClassifier / GBTRegressor on the SAME binned-
+# histogram engine: each boosting round grows its trees through the
+# tree-batched level-wise builder (``ops/tree_kernels._grow_trees_batched``)
+# with data-parallel histogram psums (``ops/gbt_kernels.gbt_round``), and
+# fitted models reuse the forest transform engines (packed lockstep /
+# bin-space descent) with margin-contribution leaf payloads.
+
+
+class _GBTClass:
+    _default_loss = "squared"
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        # pyspark.ml GBT param surface -> backend names (the same scheme
+        # as the forest mapping above; sklearn-style backend names)
+        return {
+            "maxIter": "n_estimators",
+            "maxDepth": "max_depth",
+            "maxBins": "n_bins",
+            "stepSize": "learning_rate",
+            "lossType": "loss",
+            "featureSubsetStrategy": "max_features",
+            "minInstancesPerNode": "min_samples_leaf",
+            "minInfoGain": "min_impurity_decrease",
+            "seed": "random_state",
+            "impurity": "",          # Spark GBT impurity is fixed variance
+            "maxMemoryInMB": "",
+            "cacheNodeIds": "",
+            "checkpointInterval": "",
+            "subsamplingRate": "",
+            "minWeightFractionPerNode": "",
+            "validationTol": "",
+            "validationIndicatorCol": None,
+            "weightCol": None,
+            "leafCol": None,
+        }
+
+    @classmethod
+    def _param_value_mapping(cls) -> Dict[str, Callable[[Any], Any]]:
+        return {"max_features": _RandomForestClass._param_value_mapping()["max_features"]}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        # Spark GBT defaults: maxIter=20, maxDepth=5, maxBins=32,
+        # stepSize=0.1, featureSubsetStrategy="all"
+        return {
+            "n_estimators": 20,
+            "max_depth": 5,
+            "n_bins": 32,
+            "learning_rate": 0.1,
+            "max_features": 1.0,
+            "min_samples_leaf": 1,
+            "min_impurity_decrease": 0.0,
+            "random_state": None,
+            "loss": cls._default_loss,
+        }
+
+
+class _GBTParams(
+    HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasPredictionCol, HasSeed
+):
+    maxIter = _mk("maxIter", "number of boosting rounds", TypeConverters.toInt)
+    maxDepth = _mk("maxDepth", "maximum tree depth", TypeConverters.toInt)
+    maxBins = _mk("maxBins", "max histogram bins per feature", TypeConverters.toInt)
+    stepSize = _mk("stepSize", "learning rate (shrinkage)", TypeConverters.toFloat)
+    lossType = _mk("lossType", "loss function", TypeConverters.toString)
+    impurity = _mk("impurity", "split criterion (fixed: variance)", TypeConverters.toString)
+    featureSubsetStrategy = _mk(
+        "featureSubsetStrategy",
+        "features considered per split: all|auto|sqrt|log2|onethird|fraction|n",
+        TypeConverters.toString,
+    )
+    minInstancesPerNode = _mk(
+        "minInstancesPerNode", "min rows per child node", TypeConverters.toInt
+    )
+    minInfoGain = _mk("minInfoGain", "min gain for a split", TypeConverters.toFloat)
+    subsamplingRate = _mk("subsamplingRate", "row subsample rate (ignored)", TypeConverters.toFloat)
+    maxMemoryInMB = _mk("maxMemoryInMB", "memory hint (ignored)", TypeConverters.toInt)
+    cacheNodeIds = _mk("cacheNodeIds", "node-id caching (ignored)", TypeConverters.toBoolean)
+    checkpointInterval = _mk("checkpointInterval", "checkpointing (ignored)", TypeConverters.toInt)
+    minWeightFractionPerNode = _mk(
+        "minWeightFractionPerNode", "min weight fraction (ignored)", TypeConverters.toFloat
+    )
+    validationTol = _mk("validationTol", "early-stop tolerance (ignored)", TypeConverters.toFloat)
+    validationIndicatorCol = _mk(
+        "validationIndicatorCol", "validation split column (unsupported)",
+        TypeConverters.toString,
+    )
+    weightCol = _mk("weightCol", "weight column (unsupported)", TypeConverters.toString)
+    leafCol = _mk("leafCol", "leaf index column (unsupported)", TypeConverters.toString)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(
+            maxIter=20,
+            maxDepth=5,
+            maxBins=32,
+            stepSize=0.1,
+            featureSubsetStrategy="all",
+            minInstancesPerNode=1,
+            minInfoGain=0.0,
+            subsamplingRate=1.0,
+            seed=0,
+        )
+
+    def getMaxIter(self) -> int:
+        return self.getOrDefault("maxIter")
+
+    def getMaxDepth(self) -> int:
+        return self.getOrDefault("maxDepth")
+
+    def getMaxBins(self) -> int:
+        return self.getOrDefault("maxBins")
+
+    def getStepSize(self) -> float:
+        return self.getOrDefault("stepSize")
+
+    def getLossType(self) -> str:
+        return self.getOrDefault("lossType")
+
+    def getFeatureSubsetStrategy(self) -> str:
+        return self.getOrDefault("featureSubsetStrategy")
+
+
+class _GBTEstimator(_GBTClass, _TpuEstimatorSupervised, _GBTParams):
+    """Shared boosting-fit machinery: quantize once, then sequential
+    rounds of ``gbt_round`` — each round one tree-batched build on the
+    current gradient field, with margins advanced in place on device."""
+
+    _is_classification = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        _TpuEstimatorSupervised.__init__(self)
+        _GBTParams.__init__(self)
+        self._setDefault(lossType=self._default_loss)
+        self._set_params(**kwargs)
+
+    def setMaxIter(self, value: int) -> "_GBTEstimator":
+        self._set_params(maxIter=value)
+        return self
+
+    def setMaxDepth(self, value: int) -> "_GBTEstimator":
+        self._set_params(maxDepth=value)
+        return self
+
+    def setMaxBins(self, value: int) -> "_GBTEstimator":
+        self._set_params(maxBins=value)
+        return self
+
+    def setStepSize(self, value: float) -> "_GBTEstimator":
+        self._set_params(stepSize=value)
+        return self
+
+    def setLossType(self, value: str) -> "_GBTEstimator":
+        self._set_params(lossType=value)
+        return self
+
+    def setFeatureSubsetStrategy(self, value: str) -> "_GBTEstimator":
+        self._set_params(featureSubsetStrategy=value)
+        return self
+
+    def setSeed(self, value: int) -> "_GBTEstimator":
+        self._set_params(seed=value)
+        return self
+
+    # subclass hooks -------------------------------------------------------
+    def _process_labels(self, y_host: np.ndarray) -> int:
+        """Validate labels; classifier returns n_classes, regressor 0."""
+        raise NotImplementedError
+
+    def _check_loss(self, loss: str) -> str:
+        raise NotImplementedError
+
+    # fit ------------------------------------------------------------------
+    def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
+        label_col = self.getOrDefault("labelCol")
+        y_host_raw = np.asarray(dataset.column(label_col))
+        n_classes = self._process_labels(y_host_raw)
+        is_classification = self._is_classification
+
+        def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
+            import time as _time
+
+            from ..ops.gbt_kernels import GBTConfig, gbt_loss, gbt_round
+
+            t0 = _time.perf_counter()
+            max_depth = int(params["max_depth"])
+            if max_depth > _MAX_SUPPORTED_DEPTH:
+                raise ValueError(
+                    f"maxDepth={max_depth} exceeds supported depth "
+                    f"{_MAX_SUPPORTED_DEPTH} (full binary node layout)"
+                )
+            n_rounds = int(params["n_estimators"])
+            if n_rounds < 1:
+                raise ValueError("maxIter must be >= 1")
+            lr = float(params["learning_rate"])
+            self._check_loss(str(params["loss"]))
+            n_bins = int(min(params["n_bins"], max(2, inputs.n_rows)))
+            if n_bins > 256:
+                self.logger.warning("maxBins=%d clamped to 256", n_bins)
+                n_bins = 256
+            d = inputs.n_features
+            d_pad = next_pow2(d)
+            seed = int(params.get("random_state") or 0)
+
+            edges_np, bins = _quantize_features(
+                inputs, n_bins, d_pad, seed, "GBT"
+            )
+
+            # loss kind + output head width. Spark's GBTClassifier is
+            # binary-only; K>2 extends it sklearn-style (one tree per
+            # class per round on softmax gradients)
+            if is_classification:
+                if n_classes == 2:
+                    loss_kind, n_out, n_v = "logistic", 1, 1
+                else:
+                    loss_kind, n_out, n_v = "multinomial", n_classes, n_classes
+            else:
+                loss_kind, n_out, n_v = "squared", 1, 1
+            n_stats = 3 if loss_kind == "squared" else 4
+
+            # F0: the constant margin minimizing the bare loss (sklearn
+            # init conventions: mean / log-odds / log-priors)
+            yv = y_host_raw.astype(np.float64)
+            if loss_kind == "squared":
+                init = np.array([yv.mean()], dtype=np.float32)
+            elif loss_kind == "logistic":
+                p1 = float(np.clip(yv.mean(), 1e-6, 1.0 - 1e-6))
+                init = np.array([np.log(p1 / (1.0 - p1))], dtype=np.float32)
+            else:
+                prior = np.bincount(
+                    yv.astype(np.int64), minlength=n_classes
+                ) / max(1, len(yv))
+                init = np.log(np.clip(prior, 1e-6, None)).astype(np.float32)
+
+            cfg = GBTConfig(
+                loss=loss_kind,
+                n_out=n_out,
+                learning_rate=lr,
+                tree=ForestConfig(
+                    max_depth=max_depth,
+                    n_bins=n_bins,
+                    n_features=d,
+                    n_stats=n_stats,
+                    impurity="variance",
+                    k_features=_resolve_k_features(
+                        params["max_features"], d, is_classification
+                    ),
+                    min_samples_leaf=int(params["min_samples_leaf"]),
+                    min_info_gain=float(
+                        params.get("min_impurity_decrease", 0.0) or 0.0
+                    ),
+                    min_samples_split=int(params.get("min_samples_split", 2)),
+                    bootstrap=False,
+                    hist_strategy=resolve_hist_strategy(),
+                    contract_gather=resolve_contract_gather(),
+                ),
+            )
+
+            n_pad_global = bins.shape[0]
+            margins = jax.make_array_from_callback(
+                (n_pad_global, n_v),
+                NamedSharding(inputs.mesh, P(DP_AXIS)),
+                lambda idx: np.ascontiguousarray(
+                    np.broadcast_to(init[None, :], (n_pad_global, n_v))[idx]
+                ),
+            )
+            keys_np = np.asarray(
+                jax.random.split(jax.random.PRNGKey(seed), n_rounds)
+            )
+            log_every = int(envspec.get("TPUML_GBT_ROUND_LOG_EVERY"))
+
+            t_quant = _time.perf_counter()
+            outs = []
+            for r in range(n_rounds):
+                out = gbt_round(
+                    bins, inputs.mask, inputs.y, margins,
+                    jnp.asarray(keys_np[r]), mesh=inputs.mesh, cfg=cfg,
+                )
+                margins = out.pop("margins")
+                outs.append(out)
+                if log_every and (r + 1) % log_every == 0:
+                    lv = float(
+                        np.asarray(
+                            gbt_loss(
+                                inputs.y, margins, inputs.mask,
+                                mesh=inputs.mesh, loss=loss_kind,
+                            )
+                        )
+                    )
+                    self.logger.info(
+                        "GBT round %d/%d: train %s loss %.6f",
+                        r + 1, n_rounds, loss_kind, lv,
+                    )
+            # one host fetch per table after the loop (rounds are data-
+            # dependent through the margins, so growth itself is the
+            # serialization point, not these copies)
+            feat = np.concatenate(
+                [np.asarray(o["feature"]) for o in outs], axis=0
+            ).astype(np.int32)
+            thr_bin = np.concatenate(
+                [np.asarray(o["threshold_bin"]) for o in outs], axis=0
+            ).astype(np.int32)
+            leaf_stats = np.concatenate(
+                [np.asarray(o["leaf_stats"]) for o in outs], axis=0
+            ).astype(np.float32)
+            gains = np.concatenate(
+                [np.asarray(o["gain"]) for o in outs], axis=0
+            ).astype(np.float32)
+            values = np.concatenate(
+                [np.asarray(o["values"]) for o in outs], axis=0
+            ).astype(np.float32)
+            t_boost = _time.perf_counter()
+
+            thr = np.where(
+                feat >= 0,
+                edges_np[
+                    np.clip(feat, 0, d - 1), np.clip(thr_bin, 0, n_bins - 2)
+                ],
+                0.0,
+            ).astype(np.float32)
+
+            return {
+                "features": feat,
+                "thresholds": thr,
+                "threshold_bins": thr_bin,
+                "bin_edges": edges_np.astype(np.float32),
+                "leaf_stats": leaf_stats,
+                "gains": gains,
+                # lr-scaled margin contributions, the EXACT f32 numbers
+                # that advanced the training margins (device-computed in
+                # gbt_round) — transform margins reproduce training
+                # margins bit-for-bit
+                "leaf_values": values,
+                "init_margin": init,
+                "n_classes": n_classes if is_classification else 0,
+                "num_features": d,
+                "learning_rate": lr,
+                "n_rounds": n_rounds,
+                "loss": loss_kind,
+                "_fit_report": {
+                    "quantize_seconds": t_quant - t0,
+                    "boost_seconds": t_boost - t_quant,
+                    "rounds": n_rounds,
+                    "trees": int(feat.shape[0]),
+                    "seconds_per_round": (t_boost - t_quant) / n_rounds,
+                },
+            }
+
+        return _fit
+
+
+class _GBTModel(_GBTClass, _ForestModelBase, _GBTParams):
+    """Shared fitted-GBT surface: the forest transform engines driven
+    with margin-contribution payloads summed over trees."""
+
+    def __init__(self, **attrs: Any) -> None:
+        _ForestModelBase.__init__(self, **attrs)
+        _GBTParams.__init__(self)
+
+    @property
+    def _leaf_values_arr(self) -> np.ndarray:
+        return np.asarray(self._model_attributes["leaf_values"])
+
+    @property
+    def _init_margin_arr(self) -> np.ndarray:
+        return np.asarray(
+            self._model_attributes["init_margin"], dtype=np.float32
+        ).reshape(-1)
+
+    def getNumRounds(self) -> int:
+        return int(self._model_attributes["n_rounds"])
+
+    def _leaf_counts(self) -> np.ndarray:
+        # GBT stats are (w, r, r^2[, h]) — slot 0 is the row count for
+        # every loss (the RF base sums class slots when n_classes > 0)
+        return self._leaf_stats_arr[:, :, 0]
+
+    def _payload_values(self) -> np.ndarray:
+        """(T, M, V) per-node margin contributions: multiclass trees are
+        rounds-major, tree t contributes to class t % K; binary and
+        regression heads are single-column."""
+        lv = self._leaf_values_arr.astype(np.float32)
+        K = int(self._model_attributes.get("n_classes") or 0)
+        if K > 2:
+            T, M = lv.shape
+            out = np.zeros((T, M, K), dtype=np.float32)
+            out[
+                np.arange(T)[:, None],
+                np.arange(M)[None, :],
+                (np.arange(T) % K)[:, None],
+            ] = lv
+            return out
+        return lv[:, :, None]
+
+    def _margins_from_eval(self, summed: jax.Array) -> np.ndarray:
+        return np.asarray(summed) + self._init_margin_arr[None, :]
+
+    def _margin_outputs(
+        self, marg: np.ndarray, x_dtype: np.dtype
+    ) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    # -- the three engines (shared shape; payload = margin contributions) --
+    def _packed_transform_fn(self) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        from ..ops.tree_kernels import rf_eval_packed
+
+        pf = self._ensure_packed()
+        feat1, thr1 = jnp.asarray(pf.feat1), jnp.asarray(pf.thr1)
+        feat2, thr2 = jnp.asarray(pf.feat2), jnp.asarray(pf.thr2)
+        vals = jnp.asarray(self._payload_values())
+        binz = self._make_binize_for_apply()
+        st = self._stage_timer()
+
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            with st.stage("dispatch"):
+                s = rf_eval_packed(
+                    binz(Xb), feat1, thr1, feat2, thr2, vals,
+                    k1=pf.k1, k2=pf.k2, max_depth=pf.max_depth,
+                )
+            with st.stage("host_out"):
+                return self._margin_outputs(
+                    self._margins_from_eval(s), np.dtype(Xb.dtype)
+                )
+
+        return _fn
+
+    def _bins_transform_fn(self) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        from ..ops.tree_kernels import rf_eval_bins
+
+        feat = jnp.asarray(self._features_arr)
+        thrb = jnp.asarray(np.asarray(self._model_attributes["threshold_bins"]))
+        vals = jnp.asarray(self._payload_values())
+        depth = self._max_depth_built
+        binz = self._make_binize_for_apply()
+        st = self._stage_timer()
+
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            with st.stage("dispatch"):
+                s = rf_eval_bins(binz(Xb), feat, thrb, vals, max_depth=depth)
+            with st.stage("host_out"):
+                return self._margin_outputs(
+                    self._margins_from_eval(s), np.dtype(Xb.dtype)
+                )
+
+        return _fn
+
+    def _legacy_transform_fn(self) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        from ..ops.tree_kernels import forest_apply
+
+        feat = jnp.asarray(self._features_arr)
+        thr = jnp.asarray(self._thresholds_arr)
+        vals = jnp.asarray(self._payload_values())
+        depth = self._max_depth_built
+
+        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+            leaf = forest_apply(
+                jnp.asarray(Xb), feat, jnp.asarray(thr, Xb.dtype),
+                max_depth=depth,
+            )                                            # (T, n)
+            s = jax.vmap(lambda v, li: v[li])(vals, leaf).sum(axis=0)
+            return self._margin_outputs(
+                self._margins_from_eval(s), np.dtype(Xb.dtype)
+            )
+
+        return _fn
+
+    def predict(self, vector: Any) -> float:
+        x = np.asarray(vector, dtype=np.float32).reshape(1, -1)
+        fn = self._get_tpu_transform_func()
+        return float(fn(x)[self.getOrDefault("predictionCol")][0])
+
+
+class GBTClassifier(_GBTEstimator, HasProbabilityCol, HasRawPredictionCol):
+    """``GBTClassifier(maxIter=20, maxDepth=5).fit(df)`` — drop-in for
+    ``pyspark.ml.classification.GBTClassifier`` on the binned-histogram
+    engine. Binary uses logistic loss (Spark semantics); label counts
+    above 2 extend to softmax boosting, one tree per class per round."""
+
+    _is_classification = True
+    _default_loss = "logistic"
+
+    def _process_labels(self, y_host: np.ndarray) -> int:
+        from ..parallel.mesh import global_label_summary
+
+        ls = global_label_summary(y_host)
+        if ls["total"] == 0:
+            raise ValueError("Labels column is empty")
+        if ls["y_min"] < 0 or not ls["all_int"]:
+            raise RuntimeError("Labels MUST be non-negative integers")
+        return max(int(ls["y_max"]) + 1, 2)
+
+    def _check_loss(self, loss: str) -> str:
+        if loss != "logistic":
+            raise ValueError(
+                f"Unsupported lossType for GBTClassifier: {loss!r} "
+                "(only 'logistic')"
+            )
+        return loss
+
+    def _create_model(self, result: Dict[str, Any]) -> "GBTClassificationModel":
+        report = result.pop("_fit_report", None)
+        model = GBTClassificationModel(**result)
+        if report is not None:
+            model._fit_report = report
+        return model
+
+
+class GBTClassificationModel(_GBTModel, HasProbabilityCol, HasRawPredictionCol):
+    @property
+    def numClasses(self) -> int:
+        return int(self._model_attributes["n_classes"])
+
+    @property
+    def classes_(self) -> np.ndarray:
+        return np.arange(self.numClasses, dtype=np.float64)
+
+    def _out_cols(self) -> List[str]:
+        return [
+            self.getOrDefault("predictionCol"),
+            self.getOrDefault("probabilityCol"),
+            self.getOrDefault("rawPredictionCol"),
+        ]
+
+    def _margin_outputs(
+        self, marg: np.ndarray, x_dtype: np.dtype
+    ) -> Dict[str, np.ndarray]:
+        pred_col, prob_col, raw_col = self._out_cols()
+        if self.numClasses == 2:
+            m = marg[:, 0].astype(np.float64)
+            p1 = 1.0 / (1.0 + np.exp(-m))
+            prob = np.stack([1.0 - p1, p1], axis=1)
+            raw = np.stack([-m, m], axis=1)
+            pred = (p1 > 0.5).astype(x_dtype)
+        else:
+            raw = marg.astype(np.float64)
+            e = np.exp(raw - raw.max(axis=1, keepdims=True))
+            prob = e / e.sum(axis=1, keepdims=True)
+            pred = raw.argmax(axis=1).astype(x_dtype)
+        return {
+            pred_col: pred,
+            prob_col: prob.astype(np.float32),
+            raw_col: raw.astype(np.float32),
+        }
+
+    def predictProbability(self, vector: Any) -> np.ndarray:
+        x = np.asarray(vector, dtype=np.float32).reshape(1, -1)
+        fn = self._get_tpu_transform_func()
+        return fn(x)[self.getOrDefault("probabilityCol")][0]
+
+    def predictRaw(self, vector: Any) -> np.ndarray:
+        x = np.asarray(vector, dtype=np.float32).reshape(1, -1)
+        fn = self._get_tpu_transform_func()
+        return fn(x)[self.getOrDefault("rawPredictionCol")][0]
+
+
+class GBTRegressor(_GBTEstimator):
+    """``GBTRegressor(maxIter=20, maxDepth=5).fit(df)`` — drop-in for
+    ``pyspark.ml.regression.GBTRegressor`` (squared-error loss)."""
+
+    _is_classification = False
+    _default_loss = "squared"
+
+    def _process_labels(self, y_host: np.ndarray) -> int:
+        from ..parallel.mesh import global_label_summary
+
+        if global_label_summary(y_host)["total"] == 0:
+            raise ValueError("Labels column is empty")
+        return 0
+
+    def _check_loss(self, loss: str) -> str:
+        if loss == "absolute":
+            raise ValueError(
+                "lossType='absolute' is not supported (leaf values come "
+                "from closed-form Newton steps; use 'squared')"
+            )
+        if loss != "squared":
+            raise ValueError(
+                f"Unsupported lossType for GBTRegressor: {loss!r} "
+                "(only 'squared')"
+            )
+        return loss
+
+    def _create_model(self, result: Dict[str, Any]) -> "GBTRegressionModel":
+        report = result.pop("_fit_report", None)
+        model = GBTRegressionModel(**result)
+        if report is not None:
+            model._fit_report = report
+        return model
+
+
+class GBTRegressionModel(_GBTModel):
+    def _margin_outputs(
+        self, marg: np.ndarray, x_dtype: np.dtype
+    ) -> Dict[str, np.ndarray]:
+        (pred_col,) = self._out_cols()
+        return {pred_col: marg[:, 0].astype(x_dtype)}

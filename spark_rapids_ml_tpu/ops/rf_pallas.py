@@ -383,6 +383,64 @@ def rf_hist_sel_ok(
 
 
 # ---------------------------------------------------------------------------
+# T-batched wrappers: one kernel call over a whole tree batch
+# ---------------------------------------------------------------------------
+#
+# The sub-block kernels process independent BLOCK_ROWS-row grid blocks, so
+# a batch of T trees flattens its (T, n_pad, ...) operands to (T*n_pad, ...)
+# rows and runs ONE kernel call: when n_pad % BLOCK_ROWS == 0 (already a
+# rf_hist_*_ok gate condition), every grid block lies inside one tree and
+# block j of tree t is computed exactly as in a per-tree call — the batched
+# partials are bitwise identical to T separate calls, while the grid gets
+# T times the blocks to pipeline through the MXU per dispatch.
+
+
+def subblock_hist_batched(
+    binq: jax.Array,   # (T, n_pad, k) int32 bins, node-contiguous per tree
+    sw: jax.Array,     # (T, n_pad, S) f32 stats*weight (0 on padding rows)
+    *,
+    n_bins: int,
+    r_sub: int,
+    variance: bool = False,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Per-tree sub-block histograms: (T, n_pad//r_sub, S, k*n_bins)."""
+    T, n_pad, k = binq.shape
+    S = sw.shape[-1]
+    assert n_pad % BLOCK_ROWS == 0, n_pad
+    out = subblock_hist(
+        binq.reshape(T * n_pad, k),
+        sw.reshape(T * n_pad, S),
+        n_bins=n_bins, r_sub=r_sub, variance=variance, interpret=interpret,
+    )
+    return out.reshape(T, n_pad // r_sub, S, k * n_bins)
+
+
+def subblock_hist_sel_batched(
+    bq: jax.Array,      # (T, n_pad, d_pad) uint8 FULL bins, node-sorted
+    featsq: jax.Array,  # (T, n_sb, k) int32 selected feature ids
+    swT: jax.Array,     # (T, S, n_pad) f32 stats*weight
+    *,
+    n_bins: int,
+    r_sub: int,
+    variance: bool = False,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused-selection variant: (T, n_pad//r_sub, S, k*n_bins)."""
+    T, n_pad, d_pad = bq.shape
+    n_sb, k = featsq.shape[-2:]
+    S = swT.shape[-2]
+    assert n_pad % BLOCK_ROWS == 0, n_pad
+    out = subblock_hist_sel(
+        bq.reshape(T * n_pad, d_pad),
+        featsq.reshape(T * n_sb, k),
+        swT.transpose(1, 0, 2).reshape(S, T * n_pad),
+        n_bins=n_bins, r_sub=r_sub, variance=variance, interpret=interpret,
+    )
+    return out.reshape(T, n_sb, S, k * n_bins)
+
+
+# ---------------------------------------------------------------------------
 # packed-byte lane gather (inference): bins[r, idx[r, j]] via the hardware
 # lane shuffle
 # ---------------------------------------------------------------------------

@@ -103,6 +103,58 @@ def resolve_hist_strategy() -> str:
     return str(envspec.get("TPUML_RF_FORCE_STRATEGY"))
 
 
+def _largest_divisor_leq(t: int, b: int) -> int:
+    for d in range(min(t, b), 0, -1):
+        if t % d == 0:
+            return d
+    return 1
+
+
+def resolve_tree_batch(t_group: int, cfg: "ForestConfig", n_rows: int) -> int:
+    """Trees advanced per batched level dispatch (1 = sequential builder).
+
+    ``TPUML_RF_TREE_BATCH``: ``off`` pins the sequential per-tree builder,
+    an integer pins a batch width, ``auto`` targets the whole dispatch
+    group. The result is clamped to (a) a divisor of ``t_group`` — the
+    group reshapes to (G, B, 2) key batches — and (b) the widest batch
+    whose per-level residents fit the HBM budget: the histogram tile, its
+    gain-chain copies, and the per-tree row state (stat weights, routing
+    ids, subset-gathered bins) all scale xT, while the per-level strategy
+    gates deliberately stay per-tree so batched and sequential builds
+    select identical strategies — a precondition of their bit-identity
+    (see docs/rf_performance.md).
+    """
+    raw = str(envspec.get("TPUML_RF_TREE_BATCH")).strip().lower()
+    if raw == "off":
+        return 1
+    if raw == "auto":
+        want = t_group
+    else:
+        try:
+            want = int(raw)
+        except ValueError:
+            raise envspec.EnvSpecError(
+                f"TPUML_RF_TREE_BATCH={raw!r}: expected 'auto', 'off', or "
+                "a positive integer"
+            ) from None
+        if want < 1:
+            raise envspec.EnvSpecError(
+                f"TPUML_RF_TREE_BATCH={want}: batch width must be >= 1"
+            )
+    budget = envspec.get("TPUML_RF_TREE_BATCH_BUDGET")
+    budget = float(budget) if budget else _sel_hbm_budget() / 4.0
+    subset = cfg.k_features < cfg.n_features
+    d_hist = next_pow2(cfg.k_features if subset else max(1, cfg.n_features))
+    n_nodes_max = 1 << max(0, cfg.max_depth - 1)
+    tile = min(_HIST_BUDGET, n_nodes_max * cfg.n_bins * cfg.n_stats * d_hist)
+    per_tree = (
+        4 * n_rows * (cfg.n_stats + 4 + (d_hist if subset else 0))
+        + 16 * tile
+    )
+    fit = max(1, int(budget // max(1, per_tree)))
+    return _largest_divisor_leq(t_group, min(want, fit))
+
+
 class ForestConfig(NamedTuple):
     """Static (compile-time) build configuration."""
 
@@ -980,11 +1032,670 @@ def _build_tree(
 
 
 # ---------------------------------------------------------------------------
+# tree-batched level-wise builder: T trees advance one level per dispatch
+# ---------------------------------------------------------------------------
+
+
+def _seg_sum_trees(vals, seg, num):
+    """Per-tree segment sums fused into ONE global scatter.
+
+    ``vals`` (T, n, ...) and ``seg`` (T, n) in [0, num) reduce to
+    (T, num, ...) by offsetting tree t's segment ids by ``t * num`` —
+    trees touch disjoint segment ranges and every tree's rows keep their
+    original order, so each tree's accumulation sequence is exactly the
+    per-tree ``segment_sum``'s (bitwise identical), while the device sees
+    a single scatter over T*n rows instead of T small ones.
+    """
+    T, n = seg.shape
+    gseg = seg + (num * jnp.arange(T, dtype=jnp.int32))[:, None]
+    flat = vals.reshape((T * n,) + vals.shape[2:])
+    out = jax.ops.segment_sum(flat, gseg.reshape(T * n), num_segments=T * num)
+    return out.reshape((T, num) + vals.shape[2:])
+
+
+def _hist_compact_batched(
+    hist_src,             # (T, n, F) int bins, or None with full_bins
+    seg: jax.Array,       # (T, n) int32 level-local node id; n_nodes = dead
+    sw: jax.Array,        # (T, n, S) f32 stats*weight
+    *,
+    n_nodes: int,
+    nb: int,
+    r_sub: int,
+    n_pad: int,
+    f_chunk: int,
+    variance: bool,
+    full_bins=None,       # (n, d_pad) uint8 SHARED rows + feats => fused-sel
+    feats=None,           # (T, n_nodes, F) int32 per-node feature ids
+    interpret=None,
+):
+    """T-batched ``_hist_compact``: (T, F, n_nodes, nb, S) + (T, n_nodes, S).
+
+    The per-tree sort/searchsorted bookkeeping is vmapped (cheap index
+    math), but the Pallas kernel runs ONCE over the flattened
+    (T*n_pad) rows: the kernel's grid blocks are ``BLOCK_ROWS``-aligned
+    and ``n_pad % BLOCK_ROWS == 0`` (caller gate), so every block is
+    tree-pure and the flattened call computes exactly the per-tree
+    blocks back to back — bitwise identical to T separate calls.
+    """
+    from .rf_pallas import subblock_hist_batched, subblock_hist_sel_batched
+
+    T = seg.shape[0]
+    if full_bins is not None:
+        n = full_bins.shape[0]
+        F = feats.shape[-1]
+    else:
+        n, F = hist_src.shape[-2], hist_src.shape[-1]
+    S = sw.shape[-1]
+    n_sb = n_pad // r_sub
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    def prep(seg_t, sw_t):
+        # mirror of _hist_compact's index math, one tree at a time
+        keys_s, perm = lax.sort((seg_t, iota), num_keys=1)
+        starts = jnp.searchsorted(
+            keys_s, jnp.arange(n_nodes + 1, dtype=jnp.int32), side="left"
+        ).astype(jnp.int32)
+        lens = starts[1:] - starts[:-1]
+        plen = -(-lens // r_sub) * r_sub
+        pstart = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(plen)]
+        )
+        sb_pos = jnp.arange(n_sb, dtype=jnp.int32) * r_sub
+        seg_sb = jnp.searchsorted(pstart[1:], sb_pos, side="right").astype(
+            jnp.int32
+        )
+        sbc = jnp.clip(seg_sb, 0, n_nodes - 1)
+        tbl = jnp.stack([starts[:-1], pstart[:-1], lens], axis=1)
+        tbl_rows = jnp.broadcast_to(
+            tbl[sbc][:, None, :], (n_sb, r_sub, 3)
+        ).reshape(n_pad, 3)
+        pos = jnp.arange(n_pad, dtype=jnp.int32)
+        off = pos - tbl_rows[:, 1]
+        src = tbl_rows[:, 0] + off
+        pvalid = (off < tbl_rows[:, 2]) & (
+            jnp.broadcast_to(seg_sb[:, None], (n_sb, r_sub)).reshape(n_pad)
+            < n_nodes
+        )
+        src2 = perm[jnp.clip(src, 0, n - 1)]
+        swq = sw_t[src2] * pvalid[:, None].astype(sw_t.dtype)
+        seg_red = jnp.where(seg_sb < n_nodes, seg_sb, n_nodes)
+        return src2, swq, seg_red, pstart, sbc
+
+    src2, swq, seg_red, pstart, sbc = jax.vmap(prep)(seg, sw)
+
+    def _use_cumsum(width):
+        return (not variance) and n <= (1 << 23) and width <= 8192
+
+    def reduce_partials(p2d, width):  # (T, n_sb, width) -> (T, n_nodes, width)
+        if _use_cumsum(width):
+            # vmapped cumsum + boundary diff: per-tree scan order unchanged
+            return jax.vmap(
+                lambda p, ps: _sorted_block_reduce(p, ps, r_sub, n_nodes)
+            )(p2d, pstart)
+        return _seg_sum_trees(p2d, seg_red, n_nodes + 1)[:, :n_nodes]
+
+    if full_bins is not None:
+        bq = jax.vmap(lambda s2: full_bins[s2])(src2)       # (T, n_pad, d_pad)
+        featsq = jax.vmap(lambda f, c: f[c])(feats, sbc)    # (T, n_sb, F)
+        partials = subblock_hist_sel_batched(
+            bq, featsq, swq.transpose(0, 2, 1), n_bins=nb, r_sub=r_sub,
+            variance=variance, interpret=interpret,
+        )                                                   # (T, n_sb, S, F*nb)
+        hist_nodes = reduce_partials(
+            partials.reshape(T, n_sb, S * F * nb), S * F * nb
+        ).reshape(T, n_nodes, S, F, nb)
+    else:
+        if hist_src.ndim == 2:      # shared full bins (no subset)
+            binq = jax.vmap(lambda s2: hist_src[s2])(src2).astype(jnp.int32)
+        else:                       # per-tree subset-gathered bins
+            binq = jax.vmap(lambda h, s2: h[s2])(hist_src, src2).astype(
+                jnp.int32
+            )                                               # (T, n_pad, F)
+        Fc = f_chunk
+        hist_parts = []
+        for c0 in range(0, F, Fc):
+            partials = subblock_hist_batched(
+                binq[:, :, c0 : c0 + Fc], swq, n_bins=nb, r_sub=r_sub,
+                variance=variance, interpret=interpret,
+            )                                               # (T, n_sb, S, Fc*nb)
+            part = reduce_partials(
+                partials.reshape(T, n_sb, S * Fc * nb), S * Fc * nb
+            )
+            hist_parts.append(part.reshape(T, n_nodes, S, Fc, nb))
+        hist_nodes = (
+            hist_parts[0]
+            if len(hist_parts) == 1
+            else jnp.concatenate(hist_parts, axis=3)
+        )                                                   # (T, n_nodes, S, F, nb)
+    parent = hist_nodes[:, :, :, 0, :].sum(axis=-1)         # (T, n_nodes, S)
+    hist = hist_nodes.transpose(0, 3, 1, 4, 2)              # (T, F, n_nodes, nb, S)
+    return hist, parent
+
+
+def _grow_trees_batched(
+    bins: jax.Array,    # (n, d_pad) uint8, shared across the tree batch
+    sw: jax.Array,      # (T, n, S) float stats*weight per tree
+    kf: jax.Array,      # (T, 2) per-tree feature-subset keys
+    cfg: ForestConfig,
+    *,
+    axis_name=None,
+    return_rows: bool = False,
+) -> Dict[str, jax.Array]:
+    """T-batched mirror of ``_build_tree``'s level loop.
+
+    All T trees advance one level per dispatch: per-node histogram
+    accumulations fuse into ONE (T*nodes)-segmented scatter / one
+    tall-skinny (T*nodes, C) x (C, F*nb) one-hot matmul / one flattened
+    Pallas sub-block kernel call, and the gain search vmaps over the tree
+    axis. Every step either is a per-tree gather/elementwise op under
+    vmap or preserves each tree's per-segment accumulation order (see
+    _seg_sum_trees / _hist_compact_batched), and the per-level strategy
+    gates are the SAME static expressions as the sequential builder —
+    so fitted trees are bit-identical to ``_build_tree`` at the same
+    keys (tests/test_tree_batch.py pins this per strategy).
+
+    ``axis_name``: optional mesh axis to ``psum`` histograms and parent
+    stats over — the data-parallel hook the GBT boosting loop uses to
+    grow each round's trees on ALL rows while rows stay sharded. RF keeps
+    it None (each tree trains on its device's shard by design).
+    ``return_rows``: also return each row's final node id (T, n) —
+    the boosting loop reads leaf assignments from it without a second
+    descent.
+    """
+    n, d_pad = bins.shape
+    T = sw.shape[0]
+    S = cfg.n_stats
+    nb = cfg.n_bins
+    M = max_nodes(cfg.max_depth)
+    dt = sw.dtype
+
+    def _allred(x):
+        return lax.psum(x, axis_name) if axis_name is not None else x
+
+    feat = jnp.full((T, M), -1, jnp.int32)
+    thr_bin = jnp.zeros((T, M), jnp.int32)
+    leaf = jnp.zeros((T, M, S), dt)
+    gains = jnp.zeros((T, M), dt)
+    node = jnp.zeros((T, n), jnp.int32)
+
+    if cfg.contract_gather == "on":
+        use_contract = d_pad % 4 == 0
+    elif cfg.contract_gather == "off":
+        use_contract = False
+    else:
+        use_contract = (
+            jax.default_backend() == "tpu"
+            and d_pad % 4 == 0
+            and d_pad <= 1024
+        )
+    packed = _pack_bins(bins) if use_contract else None
+
+    for level in range(cfg.max_depth + 1):
+        offset = (1 << level) - 1
+        n_nodes = 1 << level
+        local = node - offset                           # (T, n)
+        in_level = (local >= 0) & (local < n_nodes)
+        seg = jnp.where(in_level, local, n_nodes).astype(jnp.int32)
+        if level == cfg.max_depth:
+            parent = _allred(
+                _seg_sum_trees(sw, seg, n_nodes + 1)[:, :n_nodes]
+            )
+            leaf = leaf.at[:, offset : offset + n_nodes].set(parent)
+            break
+
+        subset = cfg.k_features < cfg.n_features
+        if subset:
+            # per-tree draws via lax.map of the sequential builder's exact
+            # call — identical uniforms per (tree, level) by construction;
+            # top-k rows are independent, so the (T*n_nodes)-row batch
+            # selects identical subsets
+            r = lax.map(
+                lambda k: jax.random.uniform(
+                    jax.random.fold_in(k, level),
+                    (n_nodes, cfg.n_features),
+                ),
+                kf,
+            ).reshape(T * n_nodes, cfg.n_features)
+            if jax.default_backend() == "tpu":
+                feats = lax.approx_max_k(
+                    r, cfg.k_features, recall_target=1.0
+                )[1].astype(jnp.int32)
+            else:
+                feats = lax.top_k(r, cfg.k_features)[1].astype(jnp.int32)
+            k_pad = next_pow2(cfg.k_features)
+            if k_pad > cfg.k_features:
+                feats = jnp.pad(
+                    feats,
+                    ((0, 0), (0, k_pad - cfg.k_features)),
+                    constant_values=cfg.n_features,
+                )
+            feats = feats.reshape(T, n_nodes, k_pad)
+            d_hist = k_pad
+        else:
+            feats = None
+            d_hist = d_pad
+
+        def make_hist_src(feats=feats, local=local):
+            if not subset:
+                return bins                             # (n, d_pad) shared
+            lc0 = jnp.clip(local, 0, n_nodes - 1)       # (T, n)
+            row_feats = jax.vmap(lambda f, l: f[l])(feats, lc0)
+            if use_contract:
+                return jax.vmap(
+                    lambda rf_: _contract_gather(packed, rf_)
+                )(row_feats)                            # (T, n, k_pad) i32
+            return jax.vmap(
+                lambda rf_: jnp.take_along_axis(
+                    bins, jnp.clip(rf_, 0, d_pad - 1), axis=1
+                )
+            )(row_feats)                                # (T, n, k_pad) u8
+
+        # compact-strategy eligibility: the SAME per-tree static
+        # expressions as _build_tree — resolve_tree_batch's budget is
+        # what accounts for the xT transients, NOT these gates, so both
+        # builders always pick the same strategy per level
+        from .rf_pallas import BLOCK_ROWS, rf_hist_pallas_ok, rf_hist_sel_ok
+
+        r_sub = _compact_r_sub(n, n_nodes, BLOCK_ROWS, S)
+        n_nodes_max = 1 << max(0, cfg.max_depth - 1)
+        if (n_nodes_max + 1) * r_sub * 3 <= n:
+            n_pad_c = (
+                -(-(n + (n_nodes_max + 1) * r_sub) // BLOCK_ROWS)
+                * BLOCK_ROWS
+            )
+        else:
+            n_pad_c = (
+                -(-(n + (n_nodes + 1) * r_sub) // BLOCK_ROWS) * BLOCK_ROWS
+            )
+        n_sb_c = n_pad_c // r_sub
+        Fc = 1 << max(0, min(d_hist, 8192 // nb).bit_length() - 1)
+        while Fc > 1 and (
+            d_hist % Fc != 0 or n_sb_c * S * Fc * nb * 4 > (256 << 20)
+        ):
+            Fc //= 2
+        compact_shape_ok = (
+            cfg.hist_strategy in ("auto", "compact")
+            and dt == jnp.float32
+            and d_hist % Fc == 0
+            and n_nodes * d_hist * nb * S <= (1 << 28)
+        )
+        sel_resident = (
+            n * d_pad
+            + n_pad_c * d_pad
+            + n_sb_c * S * d_hist * nb * 4
+            + 2 * n_nodes * S * d_hist * nb * 4
+        )
+        sel_budget = _sel_hbm_budget()
+        use_sel = (
+            compact_shape_ok
+            and subset
+            and d_pad > _SEL_MIN_DPAD
+            and sel_resident <= sel_budget
+            and rf_hist_sel_ok(
+                n_pad_c, d_pad, d_hist, nb, S, r_sub,
+                variance=(cfg.impurity == "variance"),
+            )
+        )
+        use_compact = use_sel or (
+            compact_shape_ok
+            and rf_hist_pallas_ok(
+                n_pad_c, Fc, nb, S, r_sub,
+                variance=(cfg.impurity == "variance"),
+            )
+        )
+        if use_sel:
+            hist_full, parent = _hist_compact_batched(
+                None, seg, sw, n_nodes=n_nodes, nb=nb, r_sub=r_sub,
+                n_pad=n_pad_c, f_chunk=Fc,
+                variance=(cfg.impurity == "variance"),
+                full_bins=bins, feats=feats,
+            )
+        elif use_compact:
+            hist_full, parent = _hist_compact_batched(
+                make_hist_src(), seg, sw, n_nodes=n_nodes, nb=nb,
+                r_sub=r_sub, n_pad=n_pad_c, f_chunk=Fc,
+                variance=(cfg.impurity == "variance"),
+            )
+        else:
+            parent = _seg_sum_trees(sw, seg, n_nodes + 1)[:, :n_nodes]
+        parent = _allred(parent)
+        leaf = leaf.at[:, offset : offset + n_nodes].set(parent)
+        pcount = _count(parent, cfg.impurity)           # (T, n_nodes)
+        pimp = _impurity(parent, cfg.impurity)
+
+        bsf = jax.vmap(
+            lambda h, p, pc, pi, rf_: _best_splits_from_hist(
+                h, p, pc, pi, rf_, nb, cfg
+            )
+        )
+
+        if use_compact:
+            hist_full = _allred(hist_full)
+            if subset:
+                realf_full = feats.transpose(0, 2, 1)   # (T, k_pad, n_nodes)
+            else:
+                realf_full = jnp.broadcast_to(
+                    jnp.arange(d_hist, dtype=jnp.int32)[None, :, None],
+                    (T, d_hist, n_nodes),
+                )
+            Fc2 = d_hist
+            while Fc2 > 1 and Fc2 * n_nodes * nb * S > 4 * _HIST_BUDGET:
+                Fc2 //= 2
+            bg = jnp.full((T, n_nodes), -jnp.inf, dt)
+            bf = jnp.zeros((T, n_nodes), jnp.int32)
+            bb = jnp.zeros((T, n_nodes), jnp.int32)
+            for c0 in range(0, d_hist, Fc2):
+                g, f, b = bsf(
+                    hist_full[:, c0 : c0 + Fc2], parent, pcount, pimp,
+                    realf_full[:, c0 : c0 + Fc2],
+                )
+                upd = g > bg
+                bg = jnp.where(upd, g, bg)
+                bf = jnp.where(upd, f, bf)
+                bb = jnp.where(upd, b, bb)
+        else:
+            if cfg.hist_strategy == "matmul":
+                use_matmul = True
+            elif cfg.hist_strategy in ("scatter", "compact"):
+                use_matmul = False
+            elif subset:
+                use_matmul = False
+            else:
+                use_matmul = (
+                    jax.default_backend() == "tpu"
+                    and (2.0 * n_nodes * nb) < _SCATTER_EQ_FLOPS
+                )
+
+            hist_src = make_hist_src()
+            budget = (1 << 25) if (subset and not use_matmul) else _HIST_BUDGET
+            F = _chunk_features(d_hist, n_nodes, nb, S, budget)
+            n_chunks = d_hist // F
+            if use_matmul:
+                C_lvl = min(_ROW_CHUNK, n)
+                f_cap = max(1, (1 << 26) // (C_lvl * nb))
+                f_cap = 1 << (f_cap.bit_length() - 1)
+                F = min(F, f_cap)
+                n_chunks = d_hist // F
+
+            def _hist_scatter_b(binc, *, n_nodes, in_level, local, sw):
+                """(T, F, n_nodes, nb, S) via ONE fused global scatter:
+                tree t's (node, bin) cells live at segment offset
+                t*(n_nodes*nb+1), so per (tree, feature, cell) the
+                accumulation visits the same rows in the same order as
+                the sequential _hist_scatter — bitwise identical."""
+                F = binc.shape[-1]
+                num = n_nodes * nb + 1
+                bc = binc if binc.ndim == 3 else binc[None]
+                ids = jnp.where(
+                    in_level[:, :, None],
+                    local[:, :, None] * nb + bc,
+                    n_nodes * nb,
+                )                                       # (T, n, F)
+                gids = ids + (
+                    num * jnp.arange(T, dtype=jnp.int32)
+                )[:, None, None]
+                gflat = gids.reshape(T * n, F)
+                if S <= 16:
+                    hist = jnp.stack(
+                        [
+                            jax.vmap(
+                                lambda col, c=sw[:, :, s].reshape(
+                                    T * n
+                                ): jax.ops.segment_sum(
+                                    c, col, num_segments=T * num
+                                ),
+                                in_axes=1,
+                            )(gflat)                    # (F, T*num)
+                            for s in range(S)
+                        ],
+                        axis=-1,
+                    )                                   # (F, T*num, S)
+                else:
+                    swf = sw.reshape(T * n, S)
+                    hist = jax.vmap(
+                        lambda col: jax.ops.segment_sum(
+                            swf, col, num_segments=T * num
+                        ),
+                        in_axes=1,
+                    )(gflat)
+                hist = hist.reshape(F, T, num, S)[:, :, : n_nodes * nb, :]
+                return hist.reshape(F, T, n_nodes, nb, S).transpose(
+                    1, 0, 2, 3, 4
+                )
+
+            def _hist_matmul_b(binc, *, n_nodes, in_level, local, sw):
+                """(T, F, n_nodes, nb, S) via one-hot contractions. With
+                shared bins (no subset) the T node-onehots stack into a
+                single tall-skinny (T*n_nodes, C) x (C, F*nb) MXU matmul
+                per stat — the tree-batched dispatch shape this builder
+                exists for. Variance stats and per-tree bins (forced
+                matmul + subset) use a T-batched dot_general instead:
+                each batch element is exactly the sequential (n_nodes, C)
+                x (C, F*nb) GEMM, preserving its accumulation order —
+                the flat stacking changes the GEMM's M extent, which
+                measurably perturbs f32 accumulation at the last ulp
+                (integer one-hot stats are exact either way, so
+                classification keeps the fused form)."""
+                F = binc.shape[-1]
+                C = min(_ROW_CHUNK, n)
+                nc = -(-n // C)
+                node_ar = jnp.arange(n_nodes, dtype=jnp.int32)
+                bin_ar = jnp.arange(nb, dtype=jnp.int32)
+                prec = (
+                    lax.Precision.HIGHEST
+                    if cfg.impurity == "variance"
+                    else None
+                )
+                shared_bins = binc.ndim == 2
+
+                def row_body(ri, acc):
+                    start = jnp.minimum(ri * C, n - C)
+                    loc = lax.dynamic_slice(local, (0, start), (T, C))
+                    lvl = lax.dynamic_slice(in_level, (0, start), (T, C))
+                    swc = lax.dynamic_slice(sw, (0, start, 0), (T, C, S))
+                    fresh = (start + jnp.arange(C)) >= ri * C
+                    Noh = (
+                        (loc[:, :, None] == node_ar[None, None, :])
+                        & lvl[:, :, None]
+                        & fresh[None, :, None]
+                    ).astype(dt)                        # (T, C, n_nodes)
+                    if shared_bins and prec is None:
+                        bcc = lax.dynamic_slice(binc, (start, 0), (C, F))
+                        Boh = (
+                            bcc[:, :, None] == bin_ar[None, None, :]
+                        ).astype(dt).reshape(C, F * nb)
+                        out = jnp.stack(
+                            [
+                                jnp.matmul(
+                                    (Noh * swc[:, :, s][:, :, None])
+                                    .transpose(0, 2, 1)
+                                    .reshape(T * n_nodes, C),
+                                    Boh,
+                                    precision=prec,
+                                ).reshape(T, n_nodes, F * nb)
+                                for s in range(S)
+                            ],
+                            axis=-1,
+                        )                               # (T, n_nodes, F*nb, S)
+                    elif shared_bins:
+                        bcc = lax.dynamic_slice(binc, (start, 0), (C, F))
+                        Boh = jnp.broadcast_to(
+                            (bcc[:, :, None] == bin_ar[None, None, :])
+                            .astype(dt)
+                            .reshape(C, F * nb)[None],
+                            (T, C, F * nb),
+                        )
+                        out = jnp.stack(
+                            [
+                                lax.dot_general(
+                                    (Noh * swc[:, :, s][:, :, None])
+                                    .transpose(0, 2, 1),
+                                    Boh,
+                                    (((2,), (1,)), ((0,), (0,))),
+                                    precision=prec,
+                                )
+                                for s in range(S)
+                            ],
+                            axis=-1,
+                        )
+                    else:
+                        bcc = lax.dynamic_slice(
+                            binc, (0, start, 0), (T, C, F)
+                        )
+                        Boh = (
+                            bcc[:, :, :, None] == bin_ar
+                        ).astype(dt).reshape(T, C, F * nb)
+                        out = jnp.stack(
+                            [
+                                lax.dot_general(
+                                    (Noh * swc[:, :, s][:, :, None])
+                                    .transpose(0, 2, 1),
+                                    Boh,
+                                    (((2,), (1,)), ((0,), (0,))),
+                                    precision=prec,
+                                )
+                                for s in range(S)
+                            ],
+                            axis=-1,
+                        )
+                    return acc + out
+
+                acc = lax.fori_loop(
+                    0, nc, row_body,
+                    jnp.zeros((T, n_nodes, F * nb, S), dt),
+                )
+                return acc.reshape(T, n_nodes, F, nb, S).transpose(
+                    0, 2, 1, 3, 4
+                )
+
+            def chunk_body(carry, ci, *, n_nodes=n_nodes, parent=parent,
+                           pcount=pcount, pimp=pimp, feats=feats, F=F,
+                           in_level=in_level, local=local, sw=sw,
+                           use_matmul=use_matmul, subset=subset,
+                           hist_src=hist_src):
+                bg, bf, bb = carry
+                if subset:
+                    binc = lax.dynamic_slice(
+                        hist_src, (0, 0, ci * F), (T, n, F)
+                    ).astype(jnp.int32)
+                else:
+                    binc = lax.dynamic_slice(
+                        hist_src, (0, ci * F), (n, F)
+                    ).astype(jnp.int32)
+                make = _hist_matmul_b if use_matmul else _hist_scatter_b
+                hist = make(
+                    binc, n_nodes=n_nodes, in_level=in_level,
+                    local=local, sw=sw,
+                )
+                hist = _allred(hist)
+                if subset:
+                    realf = lax.dynamic_slice(
+                        feats, (0, 0, ci * F), (T, n_nodes, F)
+                    ).transpose(0, 2, 1)                # (T, F, n_nodes)
+                else:
+                    realf = jnp.broadcast_to(
+                        (ci * F + jnp.arange(F, dtype=jnp.int32))
+                        [None, :, None],
+                        (T, F, n_nodes),
+                    )
+                g, f, b = bsf(hist, parent, pcount, pimp, realf)
+                upd = g > bg
+                return (
+                    jnp.where(upd, g, bg),
+                    jnp.where(upd, f, bf),
+                    jnp.where(upd, b, bb),
+                ), None
+
+            init = (
+                jnp.full((T, n_nodes), -jnp.inf, dt),
+                jnp.zeros((T, n_nodes), jnp.int32),
+                jnp.zeros((T, n_nodes), jnp.int32),
+            )
+            (bg, bf, bb), _ = lax.scan(
+                chunk_body, init, jnp.arange(n_chunks)
+            )
+
+        do_split = (
+            jnp.isfinite(bg)
+            & (bg >= max(cfg.min_info_gain, 1e-9))
+            & (pcount >= cfg.min_samples_split)
+        )                                               # (T, n_nodes)
+        feat = feat.at[:, offset : offset + n_nodes].set(
+            jnp.where(do_split, bf, -1)
+        )
+        thr_bin = thr_bin.at[:, offset : offset + n_nodes].set(bb)
+        gains = gains.at[:, offset : offset + n_nodes].set(
+            jnp.where(do_split, bg, jnp.zeros_like(bg))
+        )
+
+        lc = jnp.clip(local, 0, n_nodes - 1)
+        row_feat = jnp.take_along_axis(bf, lc, axis=1)  # (T, n)
+        if use_contract:
+            row_bin = jax.vmap(
+                lambda rf_: _contract_gather(packed, rf_[:, None])[:, 0]
+            )(row_feat)
+        else:
+            row_bin = jax.vmap(
+                lambda rf_: jnp.take_along_axis(
+                    bins, jnp.clip(rf_, 0, d_pad - 1)[:, None], axis=1
+                )[:, 0].astype(jnp.int32)
+            )(row_feat)
+        go_right = (row_bin > jnp.take_along_axis(bb, lc, axis=1)).astype(
+            jnp.int32
+        )
+        child = 2 * node + 1 + go_right
+        moves = in_level & jnp.take_along_axis(do_split, lc, axis=1)
+        node = jnp.where(moves, child, node)
+
+    out = {
+        "feature": feat,
+        "threshold_bin": thr_bin,
+        "leaf_stats": leaf,
+        "gain": gains,
+    }
+    if return_rows:
+        out["node"] = node
+    return out
+
+
+def _build_trees_batched(
+    bins: jax.Array,    # (n, d_pad) uint8
+    stats: jax.Array,   # (n, S) float
+    valid: jax.Array,   # (n,) float row mask
+    keys: jax.Array,    # (T, 2) uint32
+    cfg: ForestConfig,
+) -> Dict[str, jax.Array]:
+    """RF front half of the batched builder: per-tree bootstrap weights as
+    a leading batch axis. RNG goes through ``lax.map`` of the sequential
+    builder's exact split/poisson calls, so every tree draws identical
+    weights to ``_build_tree(key)`` — the root of the bit-identity
+    guarantee."""
+    n = bins.shape[0]
+    dt = stats.dtype
+    kk = lax.map(jax.random.split, keys)                # (T, 2, 2)
+    kb, kf = kk[:, 0], kk[:, 1]
+    if cfg.bootstrap:
+        logical = jnp.clip(
+            jnp.cumsum(valid.astype(jnp.int32)) - 1, 0, n - 1
+        )
+        draws = lax.map(
+            lambda k: jax.random.poisson(k, 1.0, (n,)), kb
+        ).astype(dt)                                    # (T, n)
+        w = draws[:, logical] * valid[None, :]
+    else:
+        w = jnp.broadcast_to(valid.astype(dt), (keys.shape[0], n))
+    sw = stats[None] * w[:, :, None]                    # (T, n, S)
+    return _grow_trees_batched(bins, sw, kf, cfg)
+
+
+# ---------------------------------------------------------------------------
 # forest build over the mesh
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "cfg", "gather"))
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "cfg", "gather", "tree_batch")
+)
 def build_forest(
     bins: jax.Array,   # (N_pad, d_pad) uint8, dp-sharded
     mask: jax.Array,   # (N_pad,) float, dp-sharded
@@ -994,6 +1705,7 @@ def build_forest(
     mesh: Mesh,
     cfg: ForestConfig,
     gather: bool = False,
+    tree_batch: int = 1,
 ) -> Dict[str, jax.Array]:
     """Each device grows ``trees_per_device`` trees; the stacked forest
     materializes via the out-sharding — the analog of the reference's
@@ -1013,8 +1725,23 @@ def build_forest(
             bins_l = lax.all_gather(bins_l, DP_AXIS, axis=0, tiled=True)
             mask_l = lax.all_gather(mask_l, DP_AXIS, axis=0, tiled=True)
             stats_l = lax.all_gather(stats_l, DP_AXIS, axis=0, tiled=True)
+        kl = keys_l[0]
+        t_local = kl.shape[0]
+        if tree_batch > 1 and t_local % tree_batch == 0:
+            # tree-batched growth: (G, B, 2) key batches, B trees per
+            # level dispatch (bit-identical to the sequential path —
+            # see _grow_trees_batched)
+            out = lax.map(
+                lambda kb: _build_trees_batched(
+                    bins_l, stats_l, mask_l, kb, cfg
+                ),
+                kl.reshape(t_local // tree_batch, tree_batch, 2),
+            )
+            return jax.tree_util.tree_map(
+                lambda a: a.reshape((t_local,) + a.shape[2:]), out
+            )
         return lax.map(
-            lambda k: _build_tree(bins_l, stats_l, mask_l, k, cfg), keys_l[0]
+            lambda k: _build_tree(bins_l, stats_l, mask_l, k, cfg), kl
         )
 
     return shard_map(

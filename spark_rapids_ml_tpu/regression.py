@@ -2,9 +2,16 @@
 ``spark_rapids_ml.regression`` (``/root/reference/python/src/spark_rapids_ml/regression.py``)."""
 
 from .models.regression import LinearRegression, LinearRegressionModel
-from .models.tree import RandomForestRegressionModel, RandomForestRegressor
+from .models.tree import (
+    GBTRegressionModel,
+    GBTRegressor,
+    RandomForestRegressionModel,
+    RandomForestRegressor,
+)
 
 __all__ = [
+    "GBTRegressor",
+    "GBTRegressionModel",
     "LinearRegression",
     "LinearRegressionModel",
     "RandomForestRegressor",

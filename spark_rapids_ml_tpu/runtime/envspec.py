@@ -276,6 +276,32 @@ SPEC: Dict[str, EnvVar] = _registry(
         "documented negative result kept for future toolchains.",
         category="random-forest",
     ),
+    EnvVar(
+        "TPUML_RF_TREE_BATCH", "str", "auto",
+        "Trees advanced per batched level dispatch inside one worker: "
+        "`auto` sizes the batch to the HBM budget (histogram tile scales "
+        "xT), `off` pins the sequential per-tree builder, an integer pins "
+        "a batch width (clamped to a divisor of the dispatch group). "
+        "Batched and sequential builders are bit-identical at the same "
+        "keys (see `docs/rf_performance.md`).",
+        category="random-forest",
+        also_documented_in=("docs/rf_performance.md",),
+    ),
+    EnvVar(
+        "TPUML_RF_TREE_BATCH_BUDGET", "float", None,
+        "HBM budget in bytes for the tree-batched builder's per-level "
+        "residents under `TPUML_RF_TREE_BATCH=auto` (default: a quarter "
+        "of the fused-selection budget, see `TPUML_RF_SEL_HBM_BUDGET`).",
+        exclusive_minimum=0, category="random-forest",
+    ),
+    # --- gradient boosted trees ------------------------------------------
+    EnvVar(
+        "TPUML_GBT_ROUND_LOG_EVERY", "int", 0,
+        "Log training-loss progress every N boosting rounds during "
+        "GBTClassifier/GBTRegressor fit (0 = off; each probe is a host "
+        "fetch of the margin vector).",
+        minimum=0, category="gbt",
+    ),
     # --- knn / umap -------------------------------------------------------
     EnvVar(
         "TPUML_KNN_TOPK", "choice", "auto",

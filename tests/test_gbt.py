@@ -1,0 +1,297 @@
+"""GradientBoostedTrees: sklearn parity oracles, Spark param surface,
+persistence, engine agreement, and the defaults-inert guarantee (adding
+GBT must not perturb RandomForest fits)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import (
+    GBTClassificationModel,
+    GBTClassifier,
+)
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.regression import GBTRegressionModel, GBTRegressor
+
+
+def _binary_data(n=1200, d=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    logit = 1.6 * X[:, 0] - 1.1 * X[:, 3] + 0.7 * X[:, 5] * X[:, 1]
+    y = (logit + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def _regression_data(n=1200, d=8, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, size=(n, d)).astype(np.float32)
+    y = (
+        np.sin(X[:, 0]) * 3
+        + X[:, 1] ** 2
+        + 0.5 * X[:, 2]
+        + 0.05 * rng.normal(size=n)
+    )
+    return X, y.astype(np.float64)
+
+
+def _multiclass_data(n=900, d=8, k=3, seed=2):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)) * 2.5
+    y = rng.integers(0, k, size=n)
+    X = (centers[y] + rng.normal(size=(n, d))).astype(np.float32)
+    return X, y.astype(np.float64)
+
+
+def _auc(y, score):
+    order = np.argsort(score)
+    ranks = np.empty(len(y))
+    ranks[order] = np.arange(1, len(y) + 1)
+    pos = y > 0
+    n1, n0 = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0)
+
+
+def _r2(y, pred):
+    return 1 - ((pred - y) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+
+
+# ---------------------------------------------------------------------------
+# sklearn parity (the reference project's test oracle style)
+# ---------------------------------------------------------------------------
+
+
+def test_classifier_matches_sklearn_auc():
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    X, y = _binary_data()
+    df = DataFrame({"features": X, "label": y})
+    model = GBTClassifier(maxIter=30, maxDepth=4, seed=5).fit(df)
+    prob = np.asarray(model.transform(df)["probability"])[:, 1]
+    auc = _auc(y, prob)
+
+    sk = GradientBoostingClassifier(
+        n_estimators=30, max_depth=4, learning_rate=0.1, random_state=5
+    ).fit(X, y)
+    sk_auc = _auc(y, sk.predict_proba(X)[:, 1])
+    assert auc >= sk_auc - 0.01, (auc, sk_auc)
+
+
+def test_regressor_matches_sklearn_r2():
+    from sklearn.ensemble import GradientBoostingRegressor
+
+    X, y = _regression_data()
+    df = DataFrame({"features": X, "label": y})
+    model = GBTRegressor(maxIter=50, maxDepth=4, seed=7).fit(df)
+    pred = np.asarray(model.transform(df)["prediction"])
+    r2 = _r2(y, pred)
+
+    sk = GradientBoostingRegressor(
+        n_estimators=50, max_depth=4, learning_rate=0.1, random_state=7
+    ).fit(X, y)
+    sk_r2 = _r2(y, sk.predict(X))
+    assert r2 >= sk_r2 - 0.01, (r2, sk_r2)
+
+
+def test_multiclass_softmax_boosting():
+    X, y = _multiclass_data()
+    df = DataFrame({"features": X, "label": y})
+    model = GBTClassifier(maxIter=10, maxDepth=3, seed=3).fit(df)
+    out = model.transform(df)
+    acc = (np.asarray(out["prediction"]) == y).mean()
+    assert acc > 0.9, acc
+    # one tree per class per round, rounds-major
+    assert model.getNumTrees() == 30
+    assert model.numClasses == 3
+    prob = np.asarray(out["probability"])
+    assert prob.shape == (len(y), 3)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_learning_rate_shrinkage():
+    """Lower stepSize with the same rounds must underfit relative to the
+    default — the shrinkage actually reaches the leaf values."""
+    X, y = _regression_data(n=600)
+    df = DataFrame({"features": X, "label": y})
+    fast = GBTRegressor(maxIter=10, maxDepth=3, stepSize=0.5, seed=1).fit(df)
+    slow = GBTRegressor(maxIter=10, maxDepth=3, stepSize=0.01, seed=1).fit(df)
+    r2_fast = _r2(y, np.asarray(fast.transform(df)["prediction"]))
+    r2_slow = _r2(y, np.asarray(slow.transform(df)["prediction"]))
+    assert r2_fast > r2_slow + 0.1, (r2_fast, r2_slow)
+
+
+# ---------------------------------------------------------------------------
+# param surface
+# ---------------------------------------------------------------------------
+
+
+def test_param_mapping_and_defaults():
+    est = GBTClassifier()
+    assert est.getMaxIter() == 20
+    assert est.getMaxDepth() == 5
+    assert est.getMaxBins() == 32
+    assert est.getStepSize() == pytest.approx(0.1)
+    assert est.getLossType() == "logistic"
+    assert est.getFeatureSubsetStrategy() == "all"
+    assert est.tpu_params["n_estimators"] == 20
+    est2 = GBTClassifier(maxIter=7, stepSize=0.3, maxDepth=2)
+    assert est2.tpu_params["n_estimators"] == 7
+    assert est2.tpu_params["learning_rate"] == pytest.approx(0.3)
+    assert est2.tpu_params["max_depth"] == 2
+
+
+def test_setters_chain():
+    est = (
+        GBTRegressor()
+        .setMaxIter(4)
+        .setMaxDepth(3)
+        .setStepSize(0.2)
+        .setSeed(9)
+        .setFeatureSubsetStrategy("sqrt")
+    )
+    assert est.tpu_params["n_estimators"] == 4
+    assert est.tpu_params["max_features"] == "sqrt"
+
+
+def test_loss_type_validation():
+    X, y = _regression_data(n=200)
+    df = DataFrame({"features": X, "label": y})
+    with pytest.raises(ValueError, match="absolute"):
+        GBTRegressor(maxIter=2, lossType="absolute").fit(df)
+    Xc, yc = _binary_data(n=200)
+    dfc = DataFrame({"features": Xc, "label": yc})
+    with pytest.raises(ValueError, match="lossType"):
+        GBTClassifier(maxIter=2, lossType="squared").fit(dfc)
+
+
+def test_unsupported_params_raise():
+    with pytest.raises(ValueError, match="not supported"):
+        GBTClassifier(weightCol="w")
+    with pytest.raises(ValueError, match="not supported"):
+        GBTRegressor(validationIndicatorCol="v")
+
+
+def test_non_integer_labels_raise():
+    X, _ = _binary_data(n=100)
+    y = np.linspace(0.0, 1.0, 100)
+    df = DataFrame({"features": X, "label": y})
+    with pytest.raises(RuntimeError, match="integers"):
+        GBTClassifier(maxIter=2).fit(df)
+
+
+# ---------------------------------------------------------------------------
+# persistence + engines
+# ---------------------------------------------------------------------------
+
+
+def test_classifier_persistence_roundtrip(tmp_path):
+    X, y = _binary_data(n=400)
+    df = DataFrame({"features": X, "label": y})
+    model = GBTClassifier(maxIter=8, maxDepth=3, seed=11).fit(df)
+    path = str(tmp_path / "gbt_cls")
+    model.save(path)
+    loaded = GBTClassificationModel.load(path)
+    assert loaded.numClasses == 2
+    assert loaded.getNumTrees() == 8
+    assert loaded.getNumRounds() == 8
+    for col in ("prediction", "probability", "rawPrediction"):
+        np.testing.assert_array_equal(
+            np.asarray(model.transform(df)[col]),
+            np.asarray(loaded.transform(df)[col]),
+        )
+
+
+def test_regressor_persistence_roundtrip(tmp_path):
+    X, y = _regression_data(n=400)
+    df = DataFrame({"features": X, "label": y})
+    model = GBTRegressor(maxIter=6, maxDepth=3, seed=13).fit(df)
+    path = str(tmp_path / "gbt_reg")
+    model.save(path)
+    loaded = GBTRegressionModel.load(path)
+    np.testing.assert_array_equal(
+        np.asarray(model.transform(df)["prediction"]),
+        np.asarray(loaded.transform(df)["prediction"]),
+    )
+
+
+def test_transform_engines_agree(monkeypatch):
+    """bins and legacy descents must agree: the bin-space routing rule
+    x >= edges[f, b] <=> bin(x) > b makes them equivalent on any input."""
+    X, y = _binary_data(n=500)
+    df = DataFrame({"features": X, "label": y})
+    model = GBTClassifier(maxIter=6, maxDepth=3, seed=2).fit(df)
+
+    monkeypatch.setenv("TPUML_RF_APPLY", "bins")
+    model._transform_engine_cache = None
+    p_bins = np.asarray(model.transform(df)["probability"])
+    monkeypatch.setenv("TPUML_RF_APPLY", "legacy")
+    model._transform_engine_cache = None
+    p_leg = np.asarray(model.transform(df)["probability"])
+    np.testing.assert_allclose(p_bins, p_leg, rtol=1e-5, atol=1e-6)
+
+
+def test_fit_report_stage_timings():
+    X, y = _regression_data(n=300)
+    df = DataFrame({"features": X, "label": y})
+    model = GBTRegressor(maxIter=3, maxDepth=2, seed=1).fit(df)
+    rep = model._fit_report
+    assert rep["rounds"] == 3 and rep["trees"] == 3
+    assert rep["quantize_seconds"] > 0 and rep["boost_seconds"] > 0
+    # the report is transient fit metadata, not a persisted attribute
+    assert "_fit_report" not in model._model_attributes
+
+
+def test_feature_importances_and_structure():
+    X, y = _regression_data(n=400)
+    df = DataFrame({"features": X, "label": y})
+    model = GBTRegressor(maxIter=5, maxDepth=3, seed=4).fit(df)
+    imp = model.featureImportances
+    assert imp.shape == (X.shape[1],)
+    assert imp.sum() == pytest.approx(1.0, abs=1e-6)
+    # the target depends on features 0..2 only
+    assert imp[:3].sum() > 0.9
+    assert model.totalNumNodes > model.getNumTrees()
+
+
+def test_round_loss_logging(monkeypatch, caplog):
+    import logging
+
+    monkeypatch.setenv("TPUML_GBT_ROUND_LOG_EVERY", "1")
+    X, y = _binary_data(n=300)
+    df = DataFrame({"features": X, "label": y})
+    est = GBTClassifier(maxIter=3, maxDepth=2, seed=1)
+    # the package logger does not propagate to root, so hook caplog's
+    # handler onto it directly
+    est.logger.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(logging.INFO):
+            est.fit(df)
+    finally:
+        est.logger.removeHandler(caplog.handler)
+    msgs = [r.getMessage() for r in caplog.records if "GBT round" in r.getMessage()]
+    assert len(msgs) == 3
+    # training loss is monotone non-increasing on this easy problem
+    losses = [float(m.rsplit(" ", 1)[-1]) for m in msgs]
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# defaults inert: RF untouched by the GBT addition
+# ---------------------------------------------------------------------------
+
+
+def test_rf_outputs_unchanged_by_gbt_presence():
+    """Fitting a GBT model must not perturb an RF fit in the same process
+    (no shared global state leaks through the kernels)."""
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+    X, y = _binary_data(n=400)
+    df = DataFrame({"features": X, "label": y})
+    kw = dict(numTrees=4, maxDepth=4, seed=6)
+    m_before = RandomForestClassifier(**kw).fit(df)
+    GBTClassifier(maxIter=2, maxDepth=2, seed=1).fit(df)
+    m_after = RandomForestClassifier(**kw).fit(df)
+    np.testing.assert_array_equal(
+        m_before._features_arr, m_after._features_arr
+    )
+    np.testing.assert_array_equal(
+        m_before._leaf_stats_arr, m_after._leaf_stats_arr
+    )
