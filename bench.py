@@ -474,12 +474,122 @@ def bench_logreg(X, mask, y, mesh, n_chips):
         "per_iter": True,
         "rows": n_rows,
         "objective_dtype": obj_dtype,
+        "gang_lanes": 1,
         "flops_model": flops,
         "baseline_samples_per_sec": 2.9e8,
         "baseline_inputs": {
             "formula": "a10g_logreg_flat_per_iter_v1",
             "samples_per_sec_per_iter": 2.9e8,
             "d": N_COLS,
+        },
+    }
+
+
+LOGREG_MULTI_FOLDS = 3
+LOGREG_MULTI_MAPS = 8
+
+
+def bench_logreg_multi(X, mask, y, mesh, n_chips):
+    """Gang-scheduled CV-shaped grid: numFolds=3 × 8 maps = 24 fold-masked
+    L-BFGS lanes through ONE ``logreg_fit_batched`` dispatch over the
+    shared resident X, against the same 24 solves run sequentially (solo
+    ``logreg_fit`` with the fold mask folded into the row mask — exactly
+    what the unganged CrossValidator dispatches). The gang leg reads X
+    once per iteration for all 24 lanes; ``vs_sequential`` is the measured
+    amortization."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.logreg_kernels import (
+        logreg_fit,
+        logreg_fit_batched,
+    )
+    from spark_rapids_ml_tpu.parallel.mesh import shard_aligned
+
+    n_folds, n_maps = LOGREG_MULTI_FOLDS, LOGREG_MULTI_MAPS
+    B = n_folds * n_maps
+    fold_host = (
+        np.random.default_rng(11).integers(0, n_folds, size=N_ROWS).astype(np.int32)
+    )
+    fid = shard_aligned(fold_host, mesh, X.shape[0])
+    l2s = np.logspace(-6, -2, n_maps).astype(np.float32)
+    lane_l2 = jnp.asarray(np.tile(l2s, n_folds))
+    lane_fold = jnp.asarray(np.repeat(np.arange(n_folds, dtype=np.int32), n_maps))
+    zeros_b = jnp.zeros((B,), jnp.float32)
+
+    def gang_fn(X, m, y, l2v):
+        out = logreg_fit_batched(
+            X, m, y,
+            n_classes=2, multinomial=False, fit_intercept=True,
+            standardization=False,
+            l1=zeros_b, l2=l2v, use_l1=False,
+            max_iter=LOGREG_ITERS, tol=zeros_b,
+            mesh=mesh, objective_dtype="float32",
+            fold_id=fid, lane_fold=lane_fold, n_folds=n_folds,
+        )
+        return _checksum(out, aux=out["n_iter"].max())
+
+    gang_timed = jax.jit(gang_fn)
+    warm = np.asarray(gang_timed(X, mask, y, lane_l2))  # compile
+    iters = max(int(warm[1]), 1)
+    t, _ = _best_time(
+        lambda rep: (X, mask, y, lane_l2 * jnp.float32(1.0 + (rep + 1) * 1e-3)),
+        gang_timed,
+    )
+
+    # sequential leg: same 24 (fold, map) solves, one device program each
+    def solo_fn(X, m, y, l2, fsel):
+        m_f = m * (fid != fsel).astype(m.dtype)
+        out = logreg_fit(
+            X, m_f, y,
+            n_classes=2, multinomial=False, fit_intercept=True,
+            standardization=False,
+            l1=jnp.float32(0.0), l2=l2,
+            use_l1=False, max_iter=LOGREG_ITERS, tol=jnp.float32(0.0),
+            mesh=mesh, objective_dtype="float32",
+        )
+        return _checksum(out, aux=out["n_iter"])
+
+    solo_timed = jax.jit(solo_fn)
+    warm_s = np.asarray(
+        solo_timed(X, mask, y, jnp.float32(float(l2s[0])), jnp.int32(0))
+    )  # compile
+    t0 = time.perf_counter()
+    out = None
+    for f in range(n_folds):
+        for j in range(n_maps):
+            # perturbed l2 -> distinct scalar input buffer per solve
+            out = solo_timed(
+                X, mask, y,
+                jnp.float32(float(l2s[j]) * 1.000123), jnp.int32(f),
+            )
+    np.asarray(out)  # block on the last solve: the device ran all 24
+    t_seq = time.perf_counter() - t0
+
+    # batched objective: ~2 evals/iter, fwd+grad = 4*n*d each, ×B lanes
+    # riding ONE read of X per evaluation
+    flops = 8.0 * N_ROWS * N_COLS * iters * B
+    return {
+        # lane-samples per second: B solves × rows × iters (per-iter
+        # normalized, matching the logreg entry's convention) — against
+        # the same solo per-iter baseline, so vs_baseline directly shows
+        # the gang amortization over a one-lane solve
+        "samples_per_sec_per_chip": N_ROWS * B * iters / t / n_chips,
+        "fit_seconds": t,
+        "seq_fit_seconds": t_seq,
+        "solves_per_sec": B / t,
+        "vs_sequential": t_seq / t,
+        "gang_lanes": B,
+        "iters": iters,
+        "per_iter": True,
+        "rows": N_ROWS,
+        "flops_model": flops,
+        "baseline_samples_per_sec": 2.9e8,
+        "baseline_inputs": {
+            "formula": "a10g_logreg_flat_per_iter_v1",
+            "samples_per_sec_per_iter": 2.9e8,
+            "d": N_COLS,
+            "lanes": B,
         },
     }
 
@@ -528,6 +638,7 @@ def bench_linreg(X, mask, y, mesh, n_chips):
         "transform_seconds": t_tr,
         "transform_samples_per_sec_per_chip": n / t_tr / n_chips,
         "inner_fits_per_dispatch": INNER_FITS,
+        "gang_lanes": 1,
         "flops_model": flops,
         "baseline_samples_per_sec": 1.1e8,
         "baseline_inputs": {
@@ -1733,6 +1844,7 @@ def main() -> None:
         "pca": lambda: bench_pca(*_X()[:2], mesh, n_chips),
         "kmeans": lambda: bench_kmeans(*_X()[:2], mesh, n_chips),
         "logreg": lambda: bench_logreg(*_X(), mesh, n_chips),
+        "logreg_multi": lambda: bench_logreg_multi(*_X(), mesh, n_chips),
         "linreg": lambda: bench_linreg(*_X(), mesh, n_chips),
         "rf": lambda: bench_rf(*_X(), mesh, n_chips),
         "gbt": lambda: bench_gbt(*_X(), mesh, n_chips),
@@ -1905,6 +2017,7 @@ def _emit_line(results, meta, watchdog_tripped):
         "wire_dtype", "decode_seconds",
         "hist_strategy", "tree_batch", "seconds_per_level",
         "level_seconds", "rounds", "depth", "seconds_per_round",
+        "gang_lanes", "solves_per_sec", "vs_sequential", "seq_fit_seconds",
     )
     for name, r in results.items():
         line[name] = {

@@ -387,4 +387,82 @@ print("bench pca_stream wire columns OK:", entry["wire_dtype"],
       entry["ingest_gbps"], "GB/s logical")
 EOF
 
+echo "== gang-fit dispatch smoke =="
+# TPUML_GANG_FIT=4 CV run must come back with gang provenance in every
+# sub-model's _fit_report, and with the env UNSET the sequential path must
+# be bit-identical across runs with zero gang counters (defaults inert).
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+import numpy as np
+
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.evaluation import MulticlassClassificationEvaluator
+from spark_rapids_ml_tpu.runtime import counters
+from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1500, 12))
+y = (X @ rng.normal(size=12) + 0.5 * rng.normal(size=1500) > 0).astype(float)
+df = DataFrame({"features": X, "label": y})
+lr = LogisticRegression(maxIter=15, tol=1e-6)
+grid = (
+    ParamGridBuilder()
+    .addGrid(lr.getParam("regParam"), [0.01, 0.1])
+    .addGrid(lr.getParam("elasticNetParam"), [0.0, 0.5])
+    .build()
+)
+eva = MulticlassClassificationEvaluator(metricName="accuracy")
+
+# defaults-inert: env unset, two runs bitwise identical, no gang counters
+os.environ.pop("TPUML_GANG_FIT", None)
+counters.reset()
+a = [m for _, m in lr.fitMultiple(df, grid)]
+b = [m for _, m in lr.fitMultiple(df, grid)]
+for x, z in zip(a, b):
+    assert np.array_equal(np.asarray(x.coef_), np.asarray(z.coef_))
+    assert x._fit_report == {}
+assert counters.get("gang_dispatches") == 0, counters.snapshot()
+
+os.environ["TPUML_GANG_FIT"] = "4"
+counters.reset()
+cv = CrossValidator(
+    estimator=lr, estimatorParamMaps=grid, evaluator=eva, numFolds=3,
+    seed=1, collectSubModels=True,
+)
+model = cv.fit(df)
+lanes = {
+    m._fit_report.get("gang_lanes")
+    for fold in model.subModels for m in fold
+}
+assert lanes and None not in lanes, lanes
+assert max(lanes) <= 4, lanes  # pinned width respected
+assert counters.get("gang_dispatches") >= 1, counters.snapshot()
+assert counters.get("gang_lanes_total") == 12, counters.snapshot()
+print(
+    "gang-fit smoke OK: dispatches", counters.get("gang_dispatches"),
+    "lane widths", sorted(lanes),
+)
+EOF
+
+# bench logreg_multi artifact: the gang leg must carry its amortization
+# columns (tiny CPU scale — metric plumbing, not the TPU 3x target)
+BENCH_ONLY=logreg_multi BENCH_ROWS=20000 BENCH_COLS=64 \
+JAX_PLATFORMS=cpu python bench.py cpu > /tmp/tpuml_bench_gang.out
+python - <<'EOF'
+import json
+
+with open("/tmp/tpuml_bench_gang.out") as f:
+    line = json.loads(f.read().strip().splitlines()[-1])
+entry = line["logreg_multi"]
+assert entry["gang_lanes"] == 24, entry
+assert entry["solves_per_sec"] > 0 and entry["vs_sequential"] > 0, entry
+assert "mfu" in entry and "seq_fit_seconds" in entry, entry
+print(
+    "bench logreg_multi columns OK: vs_sequential",
+    round(entry["vs_sequential"], 2),
+)
+EOF
+
 echo "CI OK"

@@ -110,6 +110,67 @@ def _x64_ctx(dtype: Any):
     return contextlib.nullcontext()
 
 
+# one-time (per process) debug log of the gang-fit static-bucket partition
+_GANG_PARTITION_LOGGED = False
+
+
+def _default_gang_budget() -> float:
+    """Default HBM budget for gang-fit lane residents: a quarter of the
+    device memory limit (4 GB when the backend reports none, e.g. the CPU
+    test mesh)."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = float(stats.get("bytes_limit", 0.0))
+    except Exception:
+        limit = 0.0
+    if limit <= 0.0:
+        limit = float(16 << 30)
+    return limit / 4.0
+
+
+def _gang_env_on() -> bool:
+    """Cheap gate: is ``TPUML_GANG_FIT`` set to anything but off?
+
+    Deliberately does NOT validate the value — :func:`resolve_gang_fit`
+    does, so a typo'd value raises ``EnvSpecError`` on the gang path
+    instead of silently running sequential."""
+    return str(envspec.get("TPUML_GANG_FIT")).strip().lower() != "off"
+
+
+def resolve_gang_fit(n_lanes: int, lane_bytes: float) -> int:
+    """Lanes fitted per gang dispatch (1 = the sequential per-param loop).
+
+    ``TPUML_GANG_FIT``: ``off`` (default) keeps the sequential path, an
+    integer pins a lane width, ``auto`` targets the whole static bucket.
+    The result is clamped to the widest gang whose per-lane residents
+    (estimator's ``_gang_lane_bytes`` estimate — dominated by the (n, B, K)
+    logits block and its backward twin) fit the HBM budget
+    (``TPUML_GANG_FIT_BUDGET``, default a quarter of device memory) —
+    mirroring the ``TPUML_RF_TREE_BATCH`` resolver.
+    """
+    raw = str(envspec.get("TPUML_GANG_FIT")).strip().lower()
+    if raw == "off":
+        return 1
+    if raw == "auto":
+        want = n_lanes
+    else:
+        try:
+            want = int(raw)
+        except ValueError:
+            raise envspec.EnvSpecError(
+                f"TPUML_GANG_FIT={raw!r}: expected 'auto', 'off', or a "
+                "positive integer"
+            ) from None
+        if want < 1:
+            raise envspec.EnvSpecError(
+                f"TPUML_GANG_FIT={want}: lane width must be >= 1"
+            )
+    budget = envspec.get("TPUML_GANG_FIT_BUDGET")
+    budget = float(budget) if budget else _default_gang_budget()
+    fit = max(1, int(budget // max(1.0, float(lane_bytes))))
+    return max(1, min(want, fit))
+
+
 @dataclass
 class FitInputs:
     """Everything a fit function needs: the sharded design matrix + metadata.
@@ -208,6 +269,201 @@ class _TpuEstimator(Params, _TpuParams):
         """Chunked out-of-core fit, or None when the algorithm requires the
         resident-matrix path. Engaged by :meth:`_should_stream`."""
         return None
+
+    # ---- gang-fit hooks --------------------------------------------------
+    def _gang_fit_groups(
+        self, param_sets: List[Dict[str, Any]]
+    ) -> Optional[List[Tuple[Any, List[int]]]]:
+        """Static-bucket partition of ``param_sets`` for the gang path: a
+        list of ``(bucket_key, [lane indices])`` where every lane in a
+        bucket shares the batched kernel's *static* parameters (continuous
+        params ride traced ``(B,)`` lane arrays and never split a bucket).
+        ``None`` (default): estimator has no gang path."""
+        return None
+
+    def _get_tpu_gang_fit_func(
+        self, dataset: DataFrame
+    ) -> Optional[Callable[..., List[Dict[str, Any]]]]:
+        """Gang companion of :meth:`_get_tpu_fit_func`: returns
+        ``fn(inputs, group_param_sets, **fold_kwargs) -> [result, ...]``
+        fitting one whole static bucket in a single device dispatch, or
+        ``None`` when this dataset can't gang (e.g. degenerate labels)."""
+        return None
+
+    def _gang_fit_supports_folds(self) -> bool:
+        """Whether the gang fit func accepts ``fold_id``/``lane_fold``/
+        ``n_folds`` for fold-masked CV lanes."""
+        return False
+
+    def _gang_lane_bytes(self, inputs: "FitInputs") -> float:
+        """Estimated HBM bytes each additional gang lane keeps resident
+        (drives the ``TPUML_GANG_FIT_BUDGET`` clamp). Default assumes a
+        few f32 row-vector temporaries per lane."""
+        return 16.0 * float(inputs.X.shape[0])
+
+    def _gang_dispatch(
+        self,
+        inputs: "FitInputs",
+        param_sets: List[Dict[str, Any]],
+        *,
+        gang_fit: Callable[..., List[Dict[str, Any]]],
+        cls_name: str,
+        fold_id: Optional[jax.Array] = None,
+        lane_folds: Optional[List[int]] = None,
+        n_folds: int = 0,
+        allow_singletons: bool = False,
+    ) -> Tuple[Dict[int, Dict[str, Any]], Dict[int, Dict[str, Any]], Dict[int, Dict[str, int]]]:
+        """Fit as many lanes of ``param_sets`` as the resolver allows in
+        batched device dispatches. Returns ``(results, reports, res_deltas)``
+        keyed by lane index; lanes NOT in the maps fall through to the
+        caller's sequential loop (singleton chunks stay sequential so solo
+        numerics are untouched, unless ``allow_singletons`` — the fold-masked
+        CV path — where even a stray lane needs the batched kernel)."""
+        global _GANG_PARTITION_LOGGED
+        groups = self._gang_fit_groups(param_sets)
+        if not groups:
+            return {}, {}, {}
+        from .runtime import counters as _res_counters
+        from .utils.profiling import annotate, timed
+
+        lane_bytes = float(self._gang_lane_bytes(inputs))
+        min_chunk = 1 if allow_singletons else 2
+        plan: List[Tuple[Any, List[int]]] = []
+        for key, idxs in groups:
+            width = resolve_gang_fit(len(idxs), lane_bytes)
+            if width < min_chunk:
+                continue
+            for c0 in range(0, len(idxs), width):
+                chunk = idxs[c0 : c0 + width]
+                if len(chunk) >= min_chunk:
+                    plan.append((key, chunk))
+        if not plan:
+            return {}, {}, {}
+        if not _GANG_PARTITION_LOGGED:
+            self.logger.debug(
+                "gang-fit static-bucket partition: %s",
+                [(str(k), len(c)) for k, c in plan],
+            )
+            _GANG_PARTITION_LOGGED = True
+        results: Dict[int, Dict[str, Any]] = {}
+        reports: Dict[int, Dict[str, Any]] = {}
+        deltas: Dict[int, Dict[str, int]] = {}
+        for key, chunk in plan:
+            res_base = _res_counters.snapshot()
+            group_ps = [param_sets[i] for i in chunk]
+            kw: Dict[str, Any] = {}
+            if fold_id is not None:
+                assert lane_folds is not None
+                kw = dict(
+                    fold_id=fold_id,
+                    lane_fold=np.asarray([lane_folds[i] for i in chunk], np.int32),
+                    n_folds=n_folds,
+                )
+            with annotate(f"{cls_name}.gang_fit"), timed(self.logger, "gang_fit"):
+                outs = gang_fit(inputs, group_ps, **kw)
+            res_delta = _res_counters.delta_since(res_base)
+            _res_counters.bump("gang_dispatches")
+            _res_counters.bump("gang_lanes_total", len(chunk))
+            for lane_pos, i in enumerate(chunk):
+                results[i] = outs[lane_pos]
+                deltas[i] = res_delta
+                reports[i] = {
+                    "gang_lanes": len(chunk),
+                    "gang_groups": len(plan),
+                    "gang_bucket": str(key),
+                }
+                if lane_folds is not None:
+                    reports[i]["gang_fold"] = int(lane_folds[i])
+        return results, reports, deltas
+
+    def _gang_cv_fit_multiple(
+        self,
+        dataset: DataFrame,
+        paramMaps: Sequence[Dict[Any, Any]],
+        n_folds: int,
+        seed: int,
+    ) -> Optional[List[List["_TpuModel"]]]:
+        """Fold-masked gang CV: fit the whole ``n_folds × len(paramMaps)``
+        grid as gang lanes over ONE resident X, each lane's objective
+        masking ``fold_id == lane_fold`` rows on the fly. Returns
+        ``models[fold][map]`` or ``None`` (caller falls back to the
+        per-fold sequential path). All-or-nothing: a grid that can't gang
+        completely is declined rather than half-ganged."""
+        if not _gang_env_on():
+            return None
+        if self._gang_fit_supports_folds() is False:
+            return None
+        stream_func = self._get_tpu_streaming_fit_func(dataset)
+        if stream_func is not None and self._should_stream(dataset):
+            # fold masking needs the resident design matrix
+            return None
+        gang_fit = self._get_tpu_gang_fit_func(dataset)
+        if gang_fit is None:
+            return None
+        with _x64_ctx(np.float64 if not self._float32_inputs else np.float32):
+            return self._gang_cv_fit_x64scoped(
+                dataset, paramMaps, n_folds, seed, gang_fit
+            )
+
+    def _gang_cv_fit_x64scoped(
+        self,
+        dataset: DataFrame,
+        paramMaps: Sequence[Dict[Any, Any]],
+        n_folds: int,
+        seed: int,
+        gang_fit: Callable[..., List[Dict[str, Any]]],
+    ) -> Optional[List[List["_TpuModel"]]]:
+        from .data.dataframe import kfold_ids
+        from .utils.profiling import annotate, timed
+
+        self._apply_verbosity()
+        cls_name = type(self).__name__
+        with annotate(f"{cls_name}.preprocess"), timed(self.logger, "preprocess"):
+            inputs = self._pre_process_data(dataset)
+        # the SAME seeded draw kfold() makes, so masked lanes see exactly
+        # the rows the sequential per-fold path trains on
+        fold_host = kfold_ids(dataset.count(), n_folds, seed)
+        fold_dev = shard_aligned(
+            fold_host.astype(np.int32), inputs.mesh, inputs.X.shape[0]
+        )
+        estimators: List[_TpuEstimator] = []
+        map_param_sets: List[Dict[str, Any]] = []
+        for pm in paramMaps:
+            est = self.copy()
+            self._copy_tpu_params(est)
+            kw = {p.name if hasattr(p, "name") else p: v for p, v in pm.items()}
+            est._set_params(**kw)
+            estimators.append(est)
+            map_param_sets.append(dict(est._tpu_params))
+        lanes = [(f, j) for f in range(n_folds) for j in range(len(paramMaps))]
+        lane_ps = [map_param_sets[j] for _, j in lanes]
+        lane_folds = [f for f, _ in lanes]
+        results, reports, deltas = self._gang_dispatch(
+            inputs,
+            lane_ps,
+            gang_fit=gang_fit,
+            cls_name=cls_name,
+            fold_id=fold_dev,
+            lane_folds=lane_folds,
+            n_folds=n_folds,
+            allow_singletons=True,
+        )
+        if len(results) < len(lanes):
+            return None
+        out: List[List[_TpuModel]] = []
+        for f in range(n_folds):
+            row: List[_TpuModel] = []
+            for j in range(len(paramMaps)):
+                i = lanes.index((f, j))
+                est = estimators[j]
+                model = est._create_model(results[i])
+                est._copyValues(model)
+                est._copy_tpu_params(model)
+                model._resilience_report = deltas.get(i, {})
+                model._fit_report = reports[i]
+                row.append(model)
+            out.append(row)
+        return out
 
     def _resolved_weight_col(self) -> Optional[str]:
         """The explicitly-set weight column, or None — the ONE definition
@@ -529,7 +785,30 @@ class _TpuEstimator(Params, _TpuParams):
                 param_sets.append(dict(est._tpu_params))
         from .runtime import counters as _res_counters
 
-        for est, ps in zip(estimators, param_sets):
+        # gang path: batch param lanes sharing static kernel params into one
+        # device dispatch over the resident X. Env-gated (TPUML_GANG_FIT,
+        # default off) so the default path below is bit-identical to HEAD;
+        # any lane the gang declines (off, singleton bucket, streaming,
+        # estimator without a gang kernel) falls through to the loop.
+        gang_results: Dict[int, Dict[str, Any]] = {}
+        gang_reports: Dict[int, Dict[str, Any]] = {}
+        gang_deltas: Dict[int, Dict[str, int]] = {}
+        if not streaming and len(param_sets) > 1 and _gang_env_on():
+            gang_fit = self._get_tpu_gang_fit_func(dataset)
+            if gang_fit is not None:
+                gang_results, gang_reports, gang_deltas = self._gang_dispatch(
+                    inputs, param_sets, gang_fit=gang_fit, cls_name=cls_name
+                )
+
+        for lane, (est, ps) in enumerate(zip(estimators, param_sets)):
+            if lane in gang_results:
+                model = est._create_model(gang_results[lane])
+                est._copyValues(model)
+                est._copy_tpu_params(model)
+                model._resilience_report = gang_deltas.get(lane, {})
+                model._fit_report = gang_reports[lane]
+                models.append(model)
+                continue
             res_base = _res_counters.snapshot()
             with annotate(f"{cls_name}.fit"), timed(self.logger, "fit"):
                 result = fit_func(inputs, ps)
@@ -611,6 +890,11 @@ class _TpuModel(Params, _TpuParams):
     # delta; {} on a clean path). Class-level default so models that never
     # went through a fit loop (e.g. load()ed from disk) still expose it.
     _resilience_report: Dict[str, int] = {}
+
+    # gang-fit provenance ({"gang_lanes": B, "gang_groups": G,
+    # "gang_bucket": key} when this model came out of a batched dispatch;
+    # {} on the sequential path).
+    _fit_report: Dict[str, Any] = {}
 
     # ingest provenance of a STREAMED fit (resolved wire dtype + pipeline
     # depths from ops.streaming.last_ingest_report); {} for resident fits

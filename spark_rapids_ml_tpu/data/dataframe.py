@@ -552,11 +552,18 @@ class AugmentedScanFrame(ParquetScanFrame):
         return out
 
 
+def kfold_ids(n_rows: int, n_folds: int, seed: int = 0) -> np.ndarray:
+    """Per-row fold assignment — the single seeded draw shared by
+    :func:`kfold` and the gang-CV fold-masked path, so a masked lane trains
+    on exactly the rows the materialized per-fold split would."""
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, n_folds, size=n_rows).astype(np.int8)
+
+
 def kfold(df: DataFrame, n_folds: int, seed: int = 0) -> List[Tuple[DataFrame, DataFrame]]:
     """Random k-fold split -> list of (train, validation) pairs, the analog
     of pyspark CrossValidator's ``_kFold``."""
-    rng = np.random.default_rng(seed)
-    fold_of = rng.integers(0, n_folds, size=df.count())
+    fold_of = kfold_ids(df.count(), n_folds, seed)
     out = []
     for f in range(n_folds):
         val_mask = fold_of == f

@@ -17,7 +17,7 @@ stacks models for single-pass CV evaluation (``classification.py:1504-1519``).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -43,7 +43,7 @@ from ..params import (
     TypeConverters,
     _mk,
 )
-from ..ops.logreg_kernels import logreg_fit, logreg_predict
+from ..ops.logreg_kernels import logreg_fit, logreg_fit_batched, logreg_predict
 from ..runtime import envspec
 from ..utils.logging import get_logger
 
@@ -295,6 +295,111 @@ class LogisticRegression(
             }
 
         return _fit
+
+    # ---- gang-fit path ---------------------------------------------------
+    @staticmethod
+    def _gang_reg_pair(ps: Dict[str, Any]) -> Tuple[float, float]:
+        """Per-lane (l1, l2) strengths from the stored C/l1_ratio params —
+        the same arithmetic the solo ``_fit`` uses."""
+        c = float(ps["C"])
+        reg = 1.0 / c if c > 0.0 else 0.0
+        l1_ratio = float(ps["l1_ratio"])
+        return reg * l1_ratio, reg * (1.0 - l1_ratio)
+
+    def _gang_fit_groups(
+        self, param_sets: List[Dict[str, Any]]
+    ) -> Optional[List[Tuple[Any, List[int]]]]:
+        # static kernel params split buckets; l1/l2/tol ride traced (B,)
+        # arrays. use_l1 is static on purpose: OWL-QN's direction sign-fix
+        # and orthant projection are NOT identities at l1=0, so plain and
+        # OWL-QN lanes compile different programs.
+        groups: Dict[Tuple[Any, ...], List[int]] = {}
+        for i, ps in enumerate(param_sets):
+            l1, _ = self._gang_reg_pair(ps)
+            key = (
+                bool(ps["fit_intercept"]),
+                bool(ps["standardization"]),
+                l1 > 0.0,
+                int(ps["max_iter"]),
+                _resolve_objective_dtype(ps),
+            )
+            groups.setdefault(key, []).append(i)
+        return list(groups.items())
+
+    def _gang_fit_supports_folds(self) -> bool:
+        return True
+
+    def _gang_lane_bytes(self, inputs: FitInputs) -> float:
+        # dominated by the (n, B, K) logits block and its backward twin:
+        # ~4 such f32 temporaries live per objective evaluation
+        k_eff = float(getattr(self, "_gang_k_eff", 1))
+        return 16.0 * float(inputs.X.shape[0]) * k_eff
+
+    def _get_tpu_gang_fit_func(self, dataset: DataFrame):
+        from ..parallel.mesh import global_label_summary
+
+        label_col = self.getOrDefault("labelCol")
+        ls = global_label_summary(np.asarray(dataset.column(label_col)))
+        if ls["total"] == 0 or ls["y_min"] < 0 or not ls["all_int"]:
+            return None  # solo path raises the user-facing error
+        if ls["all_same"]:
+            # degenerate single-label fits bypass the solver entirely
+            return None
+        n_classes = max(int(ls["y_max"]) + 1, 2)
+        multinomial = n_classes > 2
+        self._gang_k_eff = n_classes if multinomial else 1
+
+        def _gang_fit(
+            inputs: FitInputs,
+            group_ps: List[Dict[str, Any]],
+            *,
+            fold_id: Any = None,
+            lane_fold: Any = None,
+            n_folds: int = 0,
+        ) -> List[Dict[str, Any]]:
+            ps0 = group_ps[0]
+            pairs = [self._gang_reg_pair(ps) for ps in group_ps]
+            l1 = jnp.asarray([p[0] for p in pairs], inputs.dtype)
+            l2 = jnp.asarray([p[1] for p in pairs], inputs.dtype)
+            tol = jnp.asarray([float(ps["tol"]) for ps in group_ps], inputs.dtype)
+            out = logreg_fit_batched(
+                inputs.X,
+                inputs.mask,
+                inputs.y,
+                n_classes=n_classes,
+                multinomial=multinomial,
+                fit_intercept=bool(ps0["fit_intercept"]),
+                standardization=bool(ps0["standardization"]),
+                l1=l1,
+                l2=l2,
+                use_l1=bool(pairs[0][0] > 0.0),
+                max_iter=int(ps0["max_iter"]),
+                tol=tol,
+                mesh=inputs.mesh,
+                objective_dtype=_resolve_objective_dtype(ps0),
+                fold_id=fold_id,
+                lane_fold=(
+                    None if lane_fold is None else jnp.asarray(lane_fold, jnp.int32)
+                ),
+                n_folds=int(n_folds),
+            )
+            coef = np.asarray(out["coef_"])
+            intercept = np.asarray(out["intercept_"])
+            n_iter = np.asarray(out["n_iter"])
+            objective = np.asarray(out["objective"])
+            return [
+                {
+                    "coef_": coef[b],
+                    "intercept_": intercept[b],
+                    "n_classes": n_classes,
+                    "multinomial": multinomial,
+                    "n_iter": int(n_iter[b]),
+                    "objective": float(objective[b]),
+                }
+                for b in range(len(group_ps))
+            ]
+
+        return _gang_fit
 
     def _get_tpu_streaming_fit_func(self, dataset: DataFrame):
         """Out-of-core fit: host-driven L-BFGS/OWL-QN where every objective

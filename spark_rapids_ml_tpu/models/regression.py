@@ -48,6 +48,7 @@ from ..ops.linreg_kernels import (
     linreg_suffstats,
     linreg_suffstats_chunked,
     solve_elasticnet,
+    solve_elasticnet_batched,
     solve_normal,
 )
 
@@ -207,6 +208,84 @@ class LinearRegression(
         # temporaries to O(chunk·d) so a near-HBM-sized X cannot OOM on the
         # centered √w-scaled copy (see linreg_suffstats_chunked)
         return self._equal_chunk_rows(n_rows, n_dp, 65_536)
+
+    # ---- gang-fit path ---------------------------------------------------
+    def _gang_fit_groups(self, param_sets: List[Dict[str, Any]]):
+        # only the ITERATIVE solver lanes gang (batched FISTA); l1 == 0
+        # lanes are one Cholesky each — already a single dispatch over the
+        # shared suffstats, nothing to amortize — and fall through to the
+        # sequential loop by being left out of the partition.
+        groups: Dict[Any, List[int]] = {}
+        for i, ps in enumerate(param_sets):
+            if float(ps["alpha"]) * float(ps["l1_ratio"]) == 0.0:
+                continue
+            key = (
+                bool(ps["fit_intercept"]),
+                bool(ps["standardization"]),
+                int(ps["max_iter"]),
+            )
+            groups.setdefault(key, []).append(i)
+        return list(groups.items()) or None
+
+    def _gang_lane_bytes(self, inputs: FitInputs) -> float:
+        # FISTA state is O(d) per lane over the replicated d×d system
+        return 32.0 * float(inputs.n_features)
+
+    def _get_tpu_gang_fit_func(self, dataset: DataFrame):
+        stats_cache: Dict[bool, Dict[str, jax.Array]] = {}
+
+        def _gang_fit(
+            inputs: FitInputs, group_ps: List[Dict[str, Any]]
+        ) -> List[Dict[str, Any]]:
+            ps0 = group_ps[0]
+            fit_intercept = bool(ps0["fit_intercept"])
+            if fit_intercept not in stats_cache:
+                csize = inputs.csize
+                if self.rows_chunkable(inputs.X.shape[0], inputs.mesh, csize):
+                    stats_cache[fit_intercept] = linreg_suffstats_chunked(
+                        inputs.X, inputs.mask, inputs.y, inputs.weight,
+                        mesh=inputs.mesh, csize=csize,
+                        fit_intercept=fit_intercept,
+                        weighted=inputs.weight is not None,
+                    )
+                else:
+                    stats_cache[fit_intercept] = linreg_suffstats(
+                        inputs.X, inputs.mask, inputs.y, inputs.weight,
+                        fit_intercept=fit_intercept,
+                    )
+            l1 = jnp.asarray(
+                [float(ps["alpha"]) * float(ps["l1_ratio"]) for ps in group_ps],
+                inputs.dtype,
+            )
+            l2 = jnp.asarray(
+                [
+                    float(ps["alpha"]) * (1.0 - float(ps["l1_ratio"]))
+                    for ps in group_ps
+                ],
+                inputs.dtype,
+            )
+            tol = jnp.asarray([float(ps["tol"]) for ps in group_ps], inputs.dtype)
+            beta, intercept, it = solve_elasticnet_batched(
+                stats_cache[fit_intercept],
+                l1,
+                l2,
+                standardization=bool(ps0["standardization"]),
+                max_iter=int(ps0["max_iter"]),
+                tol=tol,
+            )
+            beta_h = np.asarray(beta)
+            intercept_h = np.asarray(intercept)
+            it_h = np.asarray(it)
+            return [
+                {
+                    "coefficients": beta_h[b],
+                    "intercept": float(intercept_h[b]),
+                    "n_iter": int(it_h[b]),
+                }
+                for b in range(len(group_ps))
+            ]
+
+        return _gang_fit
 
     def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
         stats_cache: Dict[bool, Dict[str, jax.Array]] = {}

@@ -194,6 +194,156 @@ def minimize_lbfgs(
     return LbfgsResult(w=w, f=f, n_iter=it, converged=converged)
 
 
+class LbfgsBatchedResult(NamedTuple):
+    w: jax.Array          # (B, p) per-lane solutions
+    f: jax.Array          # (B,) final objectives (incl. L1 term)
+    n_iter: jax.Array     # (B,) iterations each lane took
+    converged: jax.Array  # (B,) bool
+
+
+# vmapping the SAME two-loop the solo solver runs (rather than rewriting
+# the reductions with a batch axis) keeps the per-lane op sequence —
+# dot_general contractions, scatter updates, index arithmetic — identical
+# to a solo solve, which is what the lane/solo bit-parity contract rests on
+_two_loop_batched = jax.vmap(_two_loop)
+_vdot_batched = jax.vmap(jnp.vdot)
+
+
+def minimize_lbfgs_batched(
+    fun: Callable[[jax.Array], jax.Array],
+    w0: jax.Array,
+    *,
+    max_iter: int,
+    tol: jax.Array,
+    l1_weights: Optional[jax.Array] = None,
+    history: int = 10,
+    max_ls: int = 30,
+) -> LbfgsBatchedResult:
+    """Gang-scheduled :func:`minimize_lbfgs`: B independent lanes, one loop.
+
+    ``fun`` is the *batched* smooth loss ``(B, p) -> (B,)`` — lane b's value
+    may only depend on row b of the argument (per-lane gradients come from
+    one vjp with a ones cotangent, i.e. one fused fwd+bwd data pass for all
+    lanes). ``w0`` is ``(B, p)``; ``tol`` is per-lane ``(B,)``;
+    ``l1_weights`` (optional) is per-lane ``(B, p)`` and switches the whole
+    group to OWL-QN (lanes wanting plain L-BFGS must go in a separate call —
+    OWL-QN's direction sign-fix is not the identity even at l1=0).
+
+    The ``lax.while_loop`` runs until every lane is done. Correctness core:
+    a lane that converges (or exhausts ``max_iter``) is FROZEN — every state
+    update is guarded by ``jnp.where(active, new, old)`` — so its final
+    state is bit-identical to a solo :func:`minimize_lbfgs` run of the same
+    problem, no matter how long the slowest lane keeps the gang looping.
+    (A plain vmap-of-while has no such guarantee: it keeps executing the
+    body for finished lanes, and OWL-QN's orthant projection can move a
+    converged iterate again.) The line search is per-lane: each lane halves
+    its own step until its own Armijo test passes, riding the shared data
+    pass of the lanes still searching.
+    """
+    dtype = w0.dtype
+    B, p = w0.shape
+    use_l1 = l1_weights is not None
+    l1w = l1_weights if use_l1 else jnp.zeros((B, p), dtype)
+
+    def full_obj_parts(W: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Per-lane (L1-inclusive objective, smooth gradient), ONE shared
+        fwd+bwd data pass. The ones-cotangent vjp is exact per-lane: lane
+        b's loss depends only on lane b's params, so rows of the vjp output
+        are the per-lane gradients."""
+        f, vjp = jax.vjp(fun, W)
+        (g,) = vjp(jnp.ones_like(f))
+        return f + jnp.abs(l1w * W).sum(axis=-1), g
+
+    f0, g0 = full_obj_parts(w0)
+
+    S0 = jnp.zeros((B, history, p), dtype)
+    Y0 = jnp.zeros((B, history, p), dtype)
+    zi = jnp.zeros((B,), jnp.int32)
+    state0 = (w0, f0, g0, S0, Y0, zi, zi, jnp.zeros((B,), bool))
+
+    c1 = jnp.asarray(1e-4, dtype)
+
+    def cond(state):
+        _, _, _, _, _, _, it, converged = state
+        return jnp.any(jnp.logical_and(jnp.logical_not(converged), it < max_iter))
+
+    def body(state):
+        w, f, g, S, Y, k, it, converged = state
+        # lanes still running this iteration; everything a frozen lane
+        # "computes" below is discarded by the where-guards at the bottom
+        active = jnp.logical_and(jnp.logical_not(converged), it < max_iter)
+
+        pg = _pseudo_gradient(w, g, l1w) if use_l1 else g
+        d = -_two_loop_batched(pg, S, Y, k)
+        if use_l1:
+            d = jnp.where(d * pg < 0.0, d, 0.0)
+            xi = jnp.where(w != 0.0, jnp.sign(w), -jnp.sign(pg))
+        dir_deriv = _vdot_batched(pg, d)
+
+        d_norm = jnp.sqrt(_vdot_batched(d, d))
+        t0 = jnp.where(
+            k == 0, 1.0 / jnp.maximum(d_norm, 1.0), jnp.asarray(1.0, dtype)
+        )
+
+        def trial_point(t):
+            w_t = w + t[:, None] * d
+            if use_l1:
+                w_t = jnp.where(w_t * xi < 0.0, 0.0, w_t)
+            return w_t
+
+        # Per-lane Armijo backtracking. One batched data pass per halving
+        # round serves every lane still searching; lanes already accepted
+        # (and frozen lanes) keep their (t, f, g) via the need-guard, so
+        # each lane sees exactly the solo solver's trial sequence.
+        def ls_cond(carry):
+            _, _, _, n_try, ok = carry
+            return jnp.any(active & ~ok & (n_try < max_ls))
+
+        def ls_body(carry):
+            t, f_t, g_t, n_try, ok = carry
+            need = active & ~ok & (n_try < max_ls)
+            t_new = jnp.where(need, t * 0.5, t)
+            f_n, g_n = full_obj_parts(trial_point(t_new))
+            f_t = jnp.where(need, f_n, f_t)
+            g_t = jnp.where(need[:, None], g_n, g_t)
+            ok = jnp.where(need, f_t <= f + c1 * t_new * dir_deriv, ok)
+            return t_new, f_t, g_t, n_try + need.astype(jnp.int32), ok
+
+        f_t0, g_t0 = full_obj_parts(trial_point(t0))
+        ok0 = f_t0 <= f + c1 * t0 * dir_deriv
+        t, f_new, g_new, _, _ = lax.while_loop(
+            ls_cond, ls_body, (t0, f_t0, g_t0, jnp.zeros((B,), jnp.int32), ok0)
+        )
+        w_new = trial_point(t)
+
+        s = w_new - w
+        yv = g_new - g
+        curv = _vdot_batched(s, yv)
+        store = active & (curv > jnp.asarray(1e-10, dtype))
+        idx = k % history
+        S_set = jax.vmap(lambda Sb, i, sb: Sb.at[i].set(sb))(S, idx, s)
+        Y_set = jax.vmap(lambda Yb, i, yb: Yb.at[i].set(yb))(Y, idx, yv)
+        S = jnp.where(store[:, None, None], S_set, S)
+        Y = jnp.where(store[:, None, None], Y_set, Y)
+        k = jnp.where(store, k + 1, k)
+
+        denom = jnp.maximum(jnp.maximum(jnp.abs(f), jnp.abs(f_new)), 1.0)
+        rel_impr = (f - f_new) / denom
+        conv_now = jnp.logical_or(rel_impr <= tol, dir_deriv >= 0.0)
+
+        # the freeze: frozen lanes keep w/f/g (and S/Y/k via the store
+        # guard above, which requires `active`) bit-exactly
+        w = jnp.where(active[:, None], w_new, w)
+        f = jnp.where(active, f_new, f)
+        g = jnp.where(active[:, None], g_new, g)
+        converged = jnp.where(active, conv_now, converged)
+        it = it + active.astype(jnp.int32)
+        return (w, f, g, S, Y, k, it, converged)
+
+    w, f, g, S, Y, k, it, converged = lax.while_loop(cond, body, state0)
+    return LbfgsBatchedResult(w=w, f=f, n_iter=it, converged=converged)
+
+
 def minimize_lbfgs_host(
     value_grad: Callable,
     w0,

@@ -20,6 +20,12 @@ Counter names in use:
                         ``delete()`` raised (``ops/streaming.py`` release
                         helper); a nonzero delta means retired wire
                         buffers may be leaking host/device memory.
+- ``gang_dispatches``  — batched gang-fit device dispatches issued by
+                        ``core._TpuEstimator._gang_dispatch``
+                        (``TPUML_GANG_FIT``); one per static-bucket chunk.
+- ``gang_lanes_total`` — param lanes fitted across all gang dispatches
+                        (``gang_lanes_total / gang_dispatches`` = mean
+                        gang width).
 """
 
 from __future__ import annotations

@@ -207,6 +207,28 @@ SPEC: Dict[str, EnvVar] = _registry(
         "which wins over the env.",
         choices=("float32", "bfloat16"), category="logreg",
     ),
+    # --- gang fit ---------------------------------------------------------
+    EnvVar(
+        "TPUML_GANG_FIT", "str", "off",
+        "Gang-scheduled batched fitting of a fitMultiple/CrossValidator "
+        "grid: `off` (default) keeps the sequential per-param loop, `auto` "
+        "fits each static bucket of the grid as one batched device "
+        "dispatch over the shared resident X, an integer pins the lane "
+        "width (clamped to the HBM budget). Continuous params (regParam, "
+        "elasticNetParam, tol) ride traced lane arrays; static params "
+        "split dispatch groups (see `docs/gang_fit.md`).",
+        category="gang-fit",
+        also_documented_in=("docs/gang_fit.md",),
+    ),
+    EnvVar(
+        "TPUML_GANG_FIT_BUDGET", "float", None,
+        "HBM budget in bytes for gang-fit per-lane residents (default: a "
+        "quarter of the device's reported memory, 4 GB fallback). The lane "
+        "width is clamped so the batched objective's `(n, B, K)` "
+        "temporaries fit.",
+        exclusive_minimum=0, category="gang-fit",
+        also_documented_in=("docs/gang_fit.md",),
+    ),
     # --- random forest ----------------------------------------------------
     EnvVar(
         "TPUML_RF_ROWS_PER_TREE", "choice", "auto",

@@ -176,56 +176,87 @@ class CrossValidator(_CrossValidatorParams):
         # argmin (and is visibly ±inf in avgMetrics)
         worst = -np.inf if eva.isLargerBetter() else np.inf
 
-        def run_fold(i: int) -> Tuple[np.ndarray, Optional[List[_TpuModel]]]:
-            # Device work is serialized across fold threads: jax 0.4.x can
-            # deadlock (futex wedge inside the dispatch lock) when several
-            # threads race the *first* compile of the same jitted fit. The
-            # ThreadPool keeps the pyspark parallelism API/semantics; folds
-            # still overlap host-side prep outside this critical section.
-            with _FOLD_DEVICE_LOCK:
-                train, validation = folds[i]
-                if single_pass:
-                    try:
-                        # ONE barrier-pass fit of all maps + ONE evaluate pass
-                        models = [m for _, m in est.fitMultiple(train, epm)]
-                        combined = type(models[0])._combine(models)
-                        vals = combined._transformEvaluate(validation, eva)
-                        return (
-                            np.asarray(vals, dtype=np.float64),
-                            models if collect_sub else None,
-                        )
-                    except Exception:
-                        if failfast:
-                            raise
-                        # the single-pass fit is all-or-nothing; fall through
-                        # to the per-param-map loop so only the offending
-                        # combos are recorded as failed
-                        self.logger.exception(
-                            "fold %d: single-pass fit failed; retrying "
-                            "per-param-map (TPUML_CV_FAILFAST=0)", i
-                        )
-                vals, models = [], []
-                for j, pm in enumerate(epm):
-                    try:
-                        model = est.fit(train, pm)
-                        vals.append(eva.evaluate(model.transform(validation)))
-                    except Exception:
-                        if failfast:
-                            raise
-                        self.logger.exception(
-                            "fold %d param map %d: fit/evaluate failed; "
-                            "recording worst metric (TPUML_CV_FAILFAST=0)",
-                            i, j,
-                        )
-                        _res_counters.bump("cv_failed_fits")
-                        vals.append(worst)
-                        model = None
-                    if collect_sub:
-                        models.append(model)
-                return (
-                    np.asarray(vals, dtype=np.float64),
-                    models if collect_sub else None,
+        # gang path: fit the whole folds × maps grid as fold-masked lanes
+        # over ONE resident X (TPUML_GANG_FIT; estimator declines with None
+        # and the per-fold path below runs unchanged). Runs before the
+        # thread pool spins up, so no device lock is needed here.
+        gang_grid: Optional[List[List[_TpuModel]]] = None
+        if single_pass:
+            try:
+                gang_grid = est._gang_cv_fit_multiple(
+                    dataset, epm, n_folds, self.getSeed()
                 )
+            except envspec.EnvSpecError:
+                raise  # config errors surface regardless of failfast mode
+            except Exception:
+                if failfast:
+                    raise
+                self.logger.exception(
+                    "gang CV fit failed; falling back to the per-fold path "
+                    "(TPUML_CV_FAILFAST=0)"
+                )
+                gang_grid = None
+
+        def run_fold(i: int) -> Tuple[np.ndarray, Optional[List[_TpuModel]]]:
+            # Device passes are serialized across fold threads: jax 0.4.x
+            # can deadlock (futex wedge inside the dispatch lock) when
+            # several threads race the *first* compile of the same jitted
+            # fit. The lock covers ONLY device work — fold selection,
+            # host-side _combine stacking, and metric aggregation run
+            # outside the critical section so fold threads overlap there.
+            train, validation = folds[i]
+            if single_pass:
+                try:
+                    if gang_grid is not None:
+                        models: List[_TpuModel] = gang_grid[i]
+                    else:
+                        with _FOLD_DEVICE_LOCK:
+                            # ONE barrier-pass fit of all maps
+                            models = [m for _, m in est.fitMultiple(train, epm)]
+                    # host numpy stacking — no device work
+                    combined = type(models[0])._combine(models)
+                    with _FOLD_DEVICE_LOCK:
+                        # ONE evaluate pass for every candidate
+                        vals = combined._transformEvaluate(validation, eva)
+                    return (
+                        np.asarray(vals, dtype=np.float64),
+                        models if collect_sub else None,
+                    )
+                except Exception:
+                    if failfast:
+                        raise
+                    # the single-pass fit is all-or-nothing; fall through
+                    # to the per-param-map loop so only the offending
+                    # combos are recorded as failed
+                    self.logger.exception(
+                        "fold %d: single-pass fit failed; retrying "
+                        "per-param-map (TPUML_CV_FAILFAST=0)", i
+                    )
+            vals, models = [], []
+            for j, pm in enumerate(epm):
+                try:
+                    with _FOLD_DEVICE_LOCK:
+                        model = est.fit(train, pm)
+                        transformed = model.transform(validation)
+                    # metric aggregation is host-side — outside the lock
+                    vals.append(eva.evaluate(transformed))
+                except Exception:
+                    if failfast:
+                        raise
+                    self.logger.exception(
+                        "fold %d param map %d: fit/evaluate failed; "
+                        "recording worst metric (TPUML_CV_FAILFAST=0)",
+                        i, j,
+                    )
+                    _res_counters.bump("cv_failed_fits")
+                    vals.append(worst)
+                    model = None
+                if collect_sub:
+                    models.append(model)
+            return (
+                np.asarray(vals, dtype=np.float64),
+                models if collect_sub else None,
+            )
 
         par = max(1, self.getParallelism())
         if par > 1:
