@@ -1882,11 +1882,13 @@ def main() -> None:
         results=results, meta=meta, tripped=watchdog_tripped, emitted=False
     )
     from spark_rapids_ml_tpu.runtime import counters as _res_counters
+    from spark_rapids_ml_tpu.runtime import telemetry as _telemetry
 
     for name, fn in runs.items():
         for attempt in (0, 1):
             try:
                 res_base = _res_counters.snapshot()
+                tele_base = _telemetry.span_stats()
                 # per-algo TensorBoard profile capture when requested
                 with trace(
                     os.path.join(profile_dir, name) if profile_dir else None
@@ -1900,6 +1902,22 @@ def main() -> None:
                     "chunk_halvings", 0
                 )
                 res["resumed_from"] = res_delta.get("resumed_from", 0)
+                # span provenance when tracing is on: device seconds measured
+                # by span fencing, and per-site span counts for this entry
+                if _telemetry.enabled():
+                    tele_now = _telemetry.span_stats()
+                    dev = 0.0
+                    spans = {}
+                    for site, st in tele_now.items():
+                        prev = tele_base.get(site, {})
+                        dc = st["count"] - prev.get("count", 0)
+                        if dc > 0:
+                            spans[site] = dc
+                            dev += st["device_seconds"] - prev.get(
+                                "device_seconds", 0.0
+                            )
+                    res["device_seconds"] = round(dev, 4)
+                    res["spans"] = spans
                 res["mfu"] = res["flops_model"] / (
                     res["fit_seconds"] * peak * n_chips
                 )
@@ -1966,6 +1984,9 @@ def main() -> None:
     # emission from the handler (interleaved/duplicate JSON lines)
     _PARTIAL["emitted"] = True
     _emit_line(results, meta, watchdog_tripped)
+    if _telemetry.enabled():
+        # Prometheus + JSON metric dump next to the trace files
+        _telemetry.write_metrics()
     if watchdog_tripped:
         # a tripped watchdog means a worker thread is still parked inside
         # a device call that never returned; normal interpreter exit would
@@ -2014,7 +2035,7 @@ def _emit_line(results, meta, watchdog_tripped):
         "ann_nprobe", "build_seconds", "nlist", "nprobe", "recall",
         "init_seconds", "sgd_seconds", "epoch_ms",
         "sgd_engine", "retries", "resumed_from",
-        "wire_dtype", "decode_seconds",
+        "wire_dtype", "decode_seconds", "device_seconds", "spans",
         "hist_strategy", "tree_batch", "seconds_per_level",
         "level_seconds", "rounds", "depth", "seconds_per_round",
         "gang_lanes", "solves_per_sec", "vs_sequential", "seq_fit_seconds",

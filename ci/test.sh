@@ -465,4 +465,133 @@ print(
 )
 EOF
 
+echo "== telemetry trace smoke =="
+# A traced streamed KMeans fit must produce a Perfetto-loadable trace
+# whose spans cover the fit end to end: the root span brackets the whole
+# wall time and its direct children account for >=95% of it, with the
+# streaming pipeline sites all present.
+rm -rf /tmp/tpuml_trace_smoke
+TPUML_TRACE=/tmp/tpuml_trace_smoke JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+
+import numpy as np
+
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.runtime import telemetry
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(8192, 16)).astype(np.float32)
+df = DataFrame({"features": X})
+PCA(k=3).setFeaturesCol("features").fit(df)
+KMeans(
+    k=4, maxIter=3, seed=0, num_workers=4, streaming=True,
+    stream_chunk_rows=1024,
+).setFeaturesCol("features").fit(df)
+telemetry.flush()
+
+stats = telemetry.span_stats()
+for site in ("PCA.fit", "KMeans.fit", "preprocess", "fit.dispatch",
+             "stream.ingest", "stream.decode", "stream.fold",
+             "kmeans.lloyd_pass"):
+    assert site in stats, (site, sorted(stats))
+
+tdir = "/tmp/tpuml_trace_smoke"
+traces = [f for f in os.listdir(tdir) if f.startswith("trace-")]
+assert len(traces) == 1, os.listdir(tdir)
+with open(os.path.join(tdir, traces[0])) as f:
+    doc = json.load(f)  # Perfetto accepts exactly this JSON object form
+events = doc["traceEvents"]
+assert all(e["ph"] in ("X", "M") for e in events), events[:3]
+names = {e["name"] for e in events if e["ph"] == "X"}
+assert {"KMeans.fit", "stream.ingest", "stream.decode",
+        "stream.fold", "kmeans.lloyd_pass"} <= names, sorted(names)
+# cross-thread parenting survived: every non-root span's parent exists
+ids = {e["args"]["span_id"] for e in events if e["ph"] == "X"}
+for e in events:
+    if e["ph"] == "X" and "parent_id" in e["args"]:
+        assert e["args"]["parent_id"] in ids, e
+# the KMeans root's direct children account for >=95% of its wall time
+xs = [e for e in events if e["ph"] == "X"]
+root_ev = next(e for e in xs if e["name"] == "KMeans.fit")
+covered = sum(
+    e["dur"] for e in xs
+    if e["args"].get("parent_id") == root_ev["args"]["span_id"]
+)
+assert covered >= 0.95 * root_ev["dur"], (covered, root_ev["dur"])
+logs = [f for f in os.listdir(tdir) if f.startswith("events-")]
+assert len(logs) == 1, os.listdir(tdir)
+with open(os.path.join(tdir, logs[0])) as f:
+    for line in f:
+        json.loads(line)
+print(f"telemetry trace smoke OK: {len(names)} span sites, "
+      f"coverage {covered / root_ev['dur']:.3f}")
+EOF
+
+# bench artifact with tracing on: every entry carries span provenance
+# columns, and the run drops Prometheus/JSON metric dumps next to the
+# trace
+rm -rf /tmp/tpuml_trace_bench
+BENCH_ONLY=pca_stream BENCH_STREAM_SECONDS=3 BENCH_STREAM_CHUNK=65536 \
+TPUML_TRACE=/tmp/tpuml_trace_bench JAX_PLATFORMS=cpu python bench.py cpu \
+  > /tmp/tpuml_bench_tele.out
+python - <<'EOF'
+import json
+import os
+
+with open("/tmp/tpuml_bench_tele.out") as f:
+    line = json.loads(f.read().strip().splitlines()[-1])
+entry = line["pca_stream"]
+assert "device_seconds" in entry, entry
+assert entry["spans"] and all(v >= 1 for v in entry["spans"].values()), entry
+assert "suffstats.pass" in entry["spans"], entry
+assert "stream.ingest" in entry["spans"], entry
+files = os.listdir("/tmp/tpuml_trace_bench")
+assert any(f.startswith("metrics-") and f.endswith(".prom") for f in files), files
+assert any(f.startswith("metrics-") and f.endswith(".json") for f in files), files
+prom = [f for f in files if f.endswith(".prom")][0]
+with open(os.path.join("/tmp/tpuml_trace_bench", prom)) as f:
+    text = f.read()
+assert "# TYPE tpuml_span_seconds summary" in text, text[:400]
+print("bench telemetry columns OK:", sorted(entry["spans"])[:4], "...")
+EOF
+
+# defaults inert: with TPUML_TRACE unset nothing is recorded, nothing is
+# written, and a traced fit's math is bit-identical to an untraced one
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import tempfile
+
+import numpy as np
+
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.runtime import telemetry
+
+os.environ.pop("TPUML_TRACE", None)
+rng = np.random.default_rng(5)
+X = rng.normal(size=(2048, 8)).astype(np.float32)
+df = DataFrame({"features": X})
+
+def fit():
+    return KMeans(k=3, maxIter=5, seed=0).setFeaturesCol("features").fit(df)
+
+plain = fit()
+assert telemetry.span_stats() == {}, telemetry.span_stats()
+assert telemetry.flush() is None and telemetry.write_metrics() is None
+assert telemetry.span("x") is telemetry.span("y")  # shared no-op singleton
+
+tdir = tempfile.mkdtemp(prefix="tpuml-tele-inert-")
+try:
+    os.environ["TPUML_TRACE"] = tdir
+    traced = fit()
+finally:
+    os.environ.pop("TPUML_TRACE", None)
+assert np.asarray(plain.cluster_centers_).tobytes() == \
+    np.asarray(traced.cluster_centers_).tobytes()
+print("telemetry defaults-inert smoke OK")
+EOF
+
 echo "CI OK"

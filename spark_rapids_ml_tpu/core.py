@@ -41,7 +41,7 @@ import jax.numpy as jnp
 
 from .data.dataframe import DataFrame, _is_sparse
 from .params import Params, _TpuParams, HasLabelCol, HasPredictionCol, HasWeightCol
-from .runtime import envspec
+from .runtime import envspec, telemetry
 from .parallel.mesh import (
     global_row_count,
     make_mesh,
@@ -168,7 +168,9 @@ def resolve_gang_fit(n_lanes: int, lane_bytes: float) -> int:
     budget = envspec.get("TPUML_GANG_FIT_BUDGET")
     budget = float(budget) if budget else _default_gang_budget()
     fit = max(1, int(budget // max(1.0, float(lane_bytes))))
-    return max(1, min(want, fit))
+    lanes = max(1, min(want, fit))
+    telemetry.record_hbm_estimate("gang_fit", float(lane_bytes) * lanes)
+    return lanes
 
 
 @dataclass
@@ -359,8 +361,15 @@ class _TpuEstimator(Params, _TpuParams):
                     lane_fold=np.asarray([lane_folds[i] for i in chunk], np.int32),
                     n_folds=n_folds,
                 )
-            with annotate(f"{cls_name}.gang_fit"), timed(self.logger, "gang_fit"):
+            with annotate(f"{cls_name}.gang_fit"), timed(
+                self.logger, "gang_fit"
+            ), telemetry.span(
+                f"{cls_name}.gang_fit",
+                lanes=len(chunk),
+                bucket=str(key),
+            ) as g_span:
                 outs = gang_fit(inputs, group_ps, **kw)
+                g_span.fence(outs)
             res_delta = _res_counters.delta_since(res_base)
             _res_counters.bump("gang_dispatches")
             _res_counters.bump("gang_lanes_total", len(chunk))
@@ -418,7 +427,9 @@ class _TpuEstimator(Params, _TpuParams):
 
         self._apply_verbosity()
         cls_name = type(self).__name__
-        with annotate(f"{cls_name}.preprocess"), timed(self.logger, "preprocess"):
+        with annotate(f"{cls_name}.preprocess"), timed(
+            self.logger, "preprocess"
+        ), telemetry.span("preprocess", gang_cv=True):
             inputs = self._pre_process_data(dataset)
         # the SAME seeded draw kfold() makes, so masked lanes see exactly
         # the rows the sequential per-fold path trains on
@@ -749,6 +760,15 @@ class _TpuEstimator(Params, _TpuParams):
     def _fit_internal_x64scoped(
         self, dataset: DataFrame, paramMaps: Optional[List[Dict[Any, Any]]]
     ) -> List["_TpuModel"]:
+        # root telemetry span: every preprocess/dispatch/streaming span
+        # of this fit nests under it, so the exported trace accounts the
+        # fit's full wall time
+        with telemetry.span(f"{type(self).__name__}.fit"):
+            return self._fit_lanes_x64scoped(dataset, paramMaps)
+
+    def _fit_lanes_x64scoped(
+        self, dataset: DataFrame, paramMaps: Optional[List[Dict[Any, Any]]]
+    ) -> List["_TpuModel"]:
         # phase annotations land as named ranges on the profiler timeline
         # (the reference's NVTX ranges, ``RapidsRowMatrix.scala:62,70``)
         from .utils.profiling import annotate, timed
@@ -761,11 +781,15 @@ class _TpuEstimator(Params, _TpuParams):
             self.logger.info(
                 "Streaming fit engaged (out-of-core chunked ingestion)."
             )
-            with annotate(f"{cls_name}.preprocess"), timed(self.logger, "preprocess"):
+            with annotate(f"{cls_name}.preprocess"), timed(
+                self.logger, "preprocess"
+            ), telemetry.span("preprocess", streaming=True):
                 inputs: Any = self._pre_process_stream(dataset)
             fit_func: Any = stream_func
         else:
-            with annotate(f"{cls_name}.preprocess"), timed(self.logger, "preprocess"):
+            with annotate(f"{cls_name}.preprocess"), timed(
+                self.logger, "preprocess"
+            ), telemetry.span("preprocess", streaming=False):
                 inputs = self._pre_process_data(dataset)
             fit_func = self._get_tpu_fit_func(dataset)
         models: List[_TpuModel] = []
@@ -810,8 +834,13 @@ class _TpuEstimator(Params, _TpuParams):
                 models.append(model)
                 continue
             res_base = _res_counters.snapshot()
-            with annotate(f"{cls_name}.fit"), timed(self.logger, "fit"):
+            with annotate(f"{cls_name}.fit"), timed(
+                self.logger, "fit"
+            ), telemetry.span(
+                "fit.dispatch", lane=lane, streaming=streaming
+            ) as d_span:
                 result = fit_func(inputs, ps)
+                d_span.fence(result)
             model = est._create_model(result)
             est._copyValues(model)
             est._copy_tpu_params(model)
@@ -955,6 +984,8 @@ class _TpuModel(Params, _TpuParams):
                     fn = self._get_tpu_transform_func(dataset)
                     with annotate(f"{type(self).__name__}.transform"), timed(
                         self.logger, "transform(streamed)"
+                    ), telemetry.span(
+                        f"{type(self).__name__}.transform", streamed=True
                     ):
                         out_columns = self._apply_streamed(fn, dataset, input_col)
                     self._log_transform_stages()
@@ -964,6 +995,8 @@ class _TpuModel(Params, _TpuParams):
             fn = self._get_tpu_transform_func(dataset)
             with annotate(f"{type(self).__name__}.transform"), timed(
                 self.logger, "transform"
+            ), telemetry.span(
+                f"{type(self).__name__}.transform", streamed=False
             ):
                 out_columns = self._apply_batched(fn, X)
             self._log_transform_stages()

@@ -31,6 +31,7 @@ from ..data.dataframe import DataFrame
 from ..params import Params, TypeConverters, _TpuParams, _mk
 from ..parallel.mesh import make_mesh, shard_rows
 from ..ops.knn_kernels import resolve_knn_topk, ring_knn
+from ..runtime import telemetry
 from ..utils.logging import get_logger
 
 _DEFAULT_ID_COL = "unique_id"
@@ -645,23 +646,24 @@ class ApproximateNearestNeighborsModel(
             ids_arr = allgather_ragged_any(ids_arr)
 
         timer = StageTimer("ann.kneighbors")
-        with timer.stage("build"):
-            index = self._ivf_index(Xi, nlist, seed)
-        mesh = make_mesh(self.num_workers)
-        with timer.stage("search"):
-            Xq_d, _ = shard_rows(Xq, mesh)
-            d2, idx = ivf_search(
-                Xq_d, index, k=k, nprobe=nprobe,
-                topk_impl=resolve_knn_topk(), mesh=mesh,
-            )
-            nq = Xq.shape[0]
-            if nproc > 1:
-                d2 = local_row_block(d2)[:nq]
-                idx = local_row_block(idx)[:nq]
-            else:
-                d2 = np.asarray(d2)[:nq]
-                idx = np.asarray(idx)[:nq]
-        knn_df = self._knn_result_df(query_df_withid, d2, idx, ids_arr)
+        with telemetry.span("ann.kneighbors", nlist=nlist, nprobe=nprobe):
+            with timer.stage("build"):
+                index = self._ivf_index(Xi, nlist, seed)
+            mesh = make_mesh(self.num_workers)
+            with timer.stage("search"):
+                Xq_d, _ = shard_rows(Xq, mesh)
+                d2, idx = ivf_search(
+                    Xq_d, index, k=k, nprobe=nprobe,
+                    topk_impl=resolve_knn_topk(), mesh=mesh,
+                )
+                nq = Xq.shape[0]
+                if nproc > 1:
+                    d2 = local_row_block(d2)[:nq]
+                    idx = local_row_block(idx)[:nq]
+                else:
+                    d2 = np.asarray(d2)[:nq]
+                    idx = np.asarray(idx)[:nq]
+            knn_df = self._knn_result_df(query_df_withid, d2, idx, ids_arr)
         stages = dict(timer.totals)
         self._ann_report = {
             "engine": "ivf",

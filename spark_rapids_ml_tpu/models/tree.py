@@ -56,7 +56,7 @@ from ..ops.tree_kernels import (
     rf_classify,
     rf_regress,
 )
-from ..runtime import envspec
+from ..runtime import envspec, telemetry
 
 _MAX_SUPPORTED_DEPTH = 18  # full binary layout: 2^(d+1)-1 nodes per tree
 
@@ -411,16 +411,25 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
             for g0 in range(0, t_local, group):
                 kg = keys[:, g0 : min(g0 + group, t_local)]
                 gsz = kg.shape[1]
-                outg = build_forest(
-                    bins, inputs.mask, stats, kg,
-                    mesh=inputs.mesh, cfg=cfg, gather=gather,
-                    tree_batch=resolve_tree_batch(gsz, cfg, rows_per_tree),
-                )
-                for k, a in outg.items():
-                    h = fetch_global(a, inputs.mesh)
-                    pieces.setdefault(k, []).append(
-                        h.reshape(n_dp, gsz, *h.shape[1:])
+                tree_batch = resolve_tree_batch(gsz, cfg, rows_per_tree)
+                with telemetry.span(
+                    "forest.grow_group",
+                    trees=gsz,
+                    tree_batch=tree_batch,
+                    hist_strategy=cfg.hist_strategy,
+                    gather=gather,
+                ) as f_span:
+                    outg = build_forest(
+                        bins, inputs.mask, stats, kg,
+                        mesh=inputs.mesh, cfg=cfg, gather=gather,
+                        tree_batch=tree_batch,
                     )
+                    f_span.fence(outg)
+                    for k, a in outg.items():
+                        h = fetch_global(a, inputs.mesh)
+                        pieces.setdefault(k, []).append(
+                            h.reshape(n_dp, gsz, *h.shape[1:])
+                        )
 
             # interleave device-major -> tree-major so the slice to n_trees
             # takes trees evenly from every device

@@ -63,7 +63,7 @@ from ..ops.umap_pallas import (
     select_sgd_engine,
     umap_sgd_pallas,
 )
-from ..runtime import counters
+from ..runtime import counters, telemetry
 from ..runtime.checkpoint import FitCheckpointer, array_digest
 from ..runtime.faults import fault_site, fault_sites_active
 from ..utils.profiling import StageTimer
@@ -340,6 +340,13 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
             self._copy_tpu_params(est)
             est._set_params(**{p.name if hasattr(p, "name") else p: v for p, v in params.items()})
             return est.fit(dataset)
+        # UMAP overrides fit() and skips the core per-fit loop, so it
+        # opens the root telemetry span itself (same name shape as
+        # core._fit_internal_x64scoped)
+        with telemetry.span("UMAP.fit"):
+            return self._fit_umap(dataset)
+
+    def _fit_umap(self, dataset: DataFrame) -> "UMAPModel":
         from ..parallel.context import ensure_distributed
 
         ensure_distributed()  # idempotent (package import already ran it)

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from ..data.chunks import Chunk, ChunkSource
 from ..parallel.mesh import row_sharding
-from ..runtime import counters, envspec
+from ..runtime import counters, envspec, telemetry
 from ..runtime.faults import SimulatedPreemption, fault_site
 from ..runtime.retry import (
     backoff_schedule,
@@ -118,10 +118,11 @@ class StreamGuard:
         self._i = 0
 
     def _sync_and_release(self, acc) -> None:
-        leaf = jax.tree_util.tree_leaves(acc)[0]
-        np.asarray(jnp.ravel(leaf)[:1])
-        _release_buffers(self._pending)
-        self._pending.clear()
+        with telemetry.span("stream.sync", pending=len(self._pending)):
+            leaf = jax.tree_util.tree_leaves(acc)[0]
+            np.asarray(jnp.ravel(leaf)[:1])
+            _release_buffers(self._pending)
+            self._pending.clear()
 
     def tick(self, dev, acc) -> None:
         for v in dev.values():
@@ -177,7 +178,14 @@ def prefetch_chunks(it, depth: Optional[int] = None):
 
     def worker():
         try:
-            for c in it:
+            src = iter(it)
+            while True:
+                # span covers the source's decode of ONE chunk (parquet
+                # read / synthetic gen), not the backpressured put
+                with telemetry.span("stream.decode"):
+                    c = next(src, end)
+                if c is end:
+                    break
                 while not cancel.is_set():
                     try:
                         q.put(c, timeout=0.1)
@@ -197,7 +205,11 @@ def prefetch_chunks(it, depth: Optional[int] = None):
                     continue
 
     th = threading.Thread(
-        target=worker, name="tpuml-chunk-prefetch", daemon=True
+        # the bound context parents this thread's decode spans under the
+        # caller's ingest span
+        target=telemetry.bind_context(worker),
+        name="tpuml-chunk-prefetch",
+        daemon=True,
     )
     th.start()
     try:
@@ -540,9 +552,11 @@ def stage_chunks(
     """
     budget = resolve_retries()
     if budget <= 0:
-        yield chunk, put_chunk(
-            chunk, mesh, dtype, need_y=need_y, need_w=need_w, wire=wire
-        )
+        with telemetry.span("stream.stage", rows=chunk.X.shape[0]):
+            dev = put_chunk(
+                chunk, mesh, dtype, need_y=need_y, need_w=need_w, wire=wire
+            )
+        yield chunk, dev
         return
     import time as _time
 
@@ -553,9 +567,11 @@ def stage_chunks(
     while pending:
         piece = pending[0]
         try:
-            dev = put_chunk(
-                piece, mesh, dtype, need_y=need_y, need_w=need_w, wire=wire
-            )
+            with telemetry.span("stream.stage", rows=piece.X.shape[0]):
+                dev = put_chunk(
+                    piece, mesh, dtype, need_y=need_y, need_w=need_w,
+                    wire=wire,
+                )
         except SimulatedPreemption:
             raise
         except Exception as exc:
@@ -625,9 +641,13 @@ def _staged_chunks(chunks, mesh, dtype, *, need_y, need_w, wire, depth):
     def worker():
         try:
             for chunk in chunks:
-                dev = put_chunk(
-                    chunk, mesh, dtype, need_y=need_y, need_w=need_w, wire=wire
-                )
+                # span covers wire-encode + async device_put of ONE
+                # chunk, not the backpressured put
+                with telemetry.span("stream.stage", rows=chunk.X.shape[0]):
+                    dev = put_chunk(
+                        chunk, mesh, dtype,
+                        need_y=need_y, need_w=need_w, wire=wire,
+                    )
                 while not cancel.is_set():
                     try:
                         q.put((chunk, dev), timeout=0.1)
@@ -646,7 +666,13 @@ def _staged_chunks(chunks, mesh, dtype, *, need_y, need_w, wire, depth):
                 except queue.Full:
                     continue
 
-    th = threading.Thread(target=worker, name="tpuml-chunk-stage", daemon=True)
+    th = threading.Thread(
+        # bound context: the ring thread's stage spans nest under the
+        # consumer's ingest span
+        target=telemetry.bind_context(worker),
+        name="tpuml-chunk-stage",
+        daemon=True,
+    )
     th.start()
     try:
         while True:
@@ -701,10 +727,15 @@ def iter_device_chunks(
     consumer thread where :func:`stage_chunks` can halve/retry
     synchronously — the ring is bypassed (resilience wins over overlap).
     """
+    import contextlib
     import itertools
 
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     it = prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
+    # manual enter/exit: a `with` around a generator body would not
+    # survive the consumer abandoning the iterator mid-pass
+    ingest_span = telemetry.span("stream.ingest")
+    ingest_span.__enter__()
     try:
         first = next(it, None)
         if first is None:
@@ -717,19 +748,40 @@ def iter_device_chunks(
             stage_depth=depth,
             prefetch_depth=int(envspec.get("TPUML_STREAM_PREFETCH")),
         )
+        ingest_span.set_attr(wire=kind, stage_depth=depth)
+        # staged slabs resident ahead of the fold: the streaming analog
+        # of the gang/tree-batch budget gauges
+        telemetry.record_hbm_estimate(
+            "stream_stage", float(first.X.nbytes) * float(max(1, depth))
+        )
         chunks = itertools.chain([first], it)
         if depth > 0 and resolve_retries() <= 0:
-            yield from _staged_chunks(
+            staged = _staged_chunks(
                 chunks, mesh, dtype,
                 need_y=need_y, need_w=need_w, wire=kind, depth=depth,
             )
         else:
-            for chunk in chunks:
-                yield from stage_chunks(
-                    chunk, mesh, dtype, need_y=need_y, need_w=need_w, wire=kind
+            staged = (
+                pair
+                for chunk in chunks
+                for pair in stage_chunks(
+                    chunk, mesh, dtype,
+                    need_y=need_y, need_w=need_w, wire=kind,
                 )
+            )
+        with contextlib.closing(staged) as staged_it:
+            for i, (piece, dev) in enumerate(staged_it):
+                # the fold span brackets the yield: it measures the
+                # CONSUMER's accumulate/dispatch work on this chunk
+                fold_span = telemetry.span("stream.fold", chunk=i)
+                fold_span.__enter__()
+                try:
+                    yield piece, dev
+                finally:
+                    fold_span.__exit__(None, None, None)
     finally:
         it.close()
+        ingest_span.__exit__(None, None, None)
 
 
 # ---------------------------------------------------------------------------
@@ -949,16 +1001,17 @@ def streamed_suffstats(
     guard = StreamGuard()
     # closing() so an exception in the loop body tears down the pipeline
     # threads promptly instead of at GC time (caveat on prefetch_chunks).
-    with contextlib.closing(
-        iter_device_chunks(source, mesh, chunk_rows, dtype, need_y=with_y)
-    ) as chunks:
-        for _, dev in chunks:
-            rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
-            acc1 = moments1_step(
-                acc1, dev["X"], rw, dev["y"] if with_y else None
-            )
-            guard.tick(dev, acc1)
-    guard.flush(acc1)
+    with telemetry.span("suffstats.pass", which="moments"):
+        with contextlib.closing(
+            iter_device_chunks(source, mesh, chunk_rows, dtype, need_y=with_y)
+        ) as chunks:
+            for _, dev in chunks:
+                rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
+                acc1 = moments1_step(
+                    acc1, dev["X"], rw, dev["y"] if with_y else None
+                )
+                guard.tick(dev, acc1)
+        guard.flush(acc1)
     # cross-process allreduce of the first-moment partials (the NCCL
     # allreduce analog; identity single-process)
     if with_y:
@@ -977,17 +1030,18 @@ def streamed_suffstats(
 
     acc2 = gram2_init(d, dtype, with_y)
     guard = StreamGuard()
-    with contextlib.closing(
-        iter_device_chunks(source, mesh, chunk_rows, dtype, need_y=with_y)
-    ) as chunks:
-        for _, dev in chunks:
-            rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
-            acc2 = gram2_step(
-                acc2, dev["X"], rw, mean_x,
-                dev["y"] if with_y else None, mean_y,
-            )
-            guard.tick(dev, acc2)
-    guard.flush(acc2)
+    with telemetry.span("suffstats.pass", which="gram"):
+        with contextlib.closing(
+            iter_device_chunks(source, mesh, chunk_rows, dtype, need_y=with_y)
+        ) as chunks:
+            for _, dev in chunks:
+                rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
+                acc2 = gram2_step(
+                    acc2, dev["X"], rw, mean_x,
+                    dev["y"] if with_y else None, mean_y,
+                )
+                guard.tick(dev, acc2)
+        guard.flush(acc2)
     if with_y:
         G_h, Xy_h, yy_h = allreduce_sum_host(acc2["G"], acc2["Xy"], acc2["yy"])
     else:
@@ -1096,18 +1150,21 @@ def streamed_logreg_fit(
         wd = jnp.asarray(w_np, dtype)
         acc = {"f": jnp.zeros((), dtype), "g": jnp.zeros((p,), dtype)}
         guard = StreamGuard()
-        with contextlib.closing(
-            iter_device_chunks(source, mesh, chunk_rows, dtype, need_w=False)
-        ) as chunks:
-            for _, dev in chunks:
-                acc = logreg_chunk_vg_step(
-                    acc, dev["X"], dev["mask"], dev["y"], wd, mean_dev,
-                    inv_std,
-                    n_classes=n_classes, multinomial=multinomial,
-                    fit_intercept=fit_intercept, use_center=use_center,
+        with telemetry.span("logreg.objective_pass"):
+            with contextlib.closing(
+                iter_device_chunks(
+                    source, mesh, chunk_rows, dtype, need_w=False
                 )
-                guard.tick(dev, acc)
-        guard.flush(acc)
+            ) as chunks:
+                for _, dev in chunks:
+                    acc = logreg_chunk_vg_step(
+                        acc, dev["X"], dev["mask"], dev["y"], wd, mean_dev,
+                        inv_std,
+                        n_classes=n_classes, multinomial=multinomial,
+                        fit_intercept=fit_intercept, use_center=use_center,
+                    )
+                    guard.tick(dev, acc)
+            guard.flush(acc)
         # per-evaluation allreduce of (loss, grad) partials — the QN-loop
         # NCCL allreduce of the reference's distributed L-BFGS; every rank
         # then takes identical optimizer steps
@@ -1173,24 +1230,26 @@ def streamed_kmeans_lloyd(
     k, d = centers0.shape
     centers = jnp.asarray(centers0, dtype)
 
-    def one_pass(cts, mm=matmul_dtype):
+    def one_pass(cts, mm=matmul_dtype, _it=None):
         acc = {
             "sums": jnp.zeros((k, d), dtype),
             "counts": jnp.zeros((k,), jnp.int32),
             "cost": jnp.zeros((), dtype),
         }
         guard = StreamGuard()
-        with contextlib.closing(
-            iter_device_chunks(
-                source, mesh, chunk_rows, dtype, need_y=False, need_w=False
-            )
-        ) as chunks:
-            for _, dev in chunks:
-                acc = kmeans_chunk_step(
-                    acc, dev["X"], dev["mask"], cts, matmul_dtype=mm
+        with telemetry.span("kmeans.lloyd_pass", iteration=_it) as p_span:
+            with contextlib.closing(
+                iter_device_chunks(
+                    source, mesh, chunk_rows, dtype, need_y=False, need_w=False
                 )
-                guard.tick(dev, acc)
-        guard.flush(acc)
+            ) as chunks:
+                for _, dev in chunks:
+                    acc = kmeans_chunk_step(
+                        acc, dev["X"], dev["mask"], cts, matmul_dtype=mm
+                    )
+                    guard.tick(dev, acc)
+            guard.flush(acc)
+            p_span.fence(acc)
         # per-iteration allreduce of (sums, counts, cost) partials — the
         # Lloyd-loop NCCL allreduce; every rank then updates identically
         s_h, c_h, cost_h = allreduce_sum_host(
@@ -1209,7 +1268,7 @@ def streamed_kmeans_lloyd(
         counters.note("resumed_from", it)
     while it < max_iter and prev_shift > tol * tol:
         fault_site("sgd:epoch")
-        acc = one_pass(centers)
+        acc = one_pass(centers, _it=it)
         sums = np.asarray(acc["sums"], np.float64)
         counts = np.asarray(acc["counts"])
         safe = np.maximum(counts.astype(np.float64), 1.0)
@@ -1228,7 +1287,7 @@ def streamed_kmeans_lloyd(
 
     # final cost pass always f32 (bf16 distance expansion cancels near
     # centroids — see kmeans_kernels.kmeans_lloyd)
-    final = one_pass(centers, mm=None)
+    final = one_pass(centers, mm=None, _it="final")
     if checkpointer is not None:
         checkpointer.clear()
     return np.asarray(centers), float(final["cost"]), it

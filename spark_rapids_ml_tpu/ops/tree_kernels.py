@@ -36,7 +36,7 @@ from ._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DP_AXIS
-from ..runtime import envspec
+from ..runtime import envspec, telemetry
 
 # elements per (F, nodes, bins, stats) histogram tile; bounds peak HBM of the
 # deepest level (tile is float32: 1<<22 elems = 16 MiB)
@@ -152,7 +152,9 @@ def resolve_tree_batch(t_group: int, cfg: "ForestConfig", n_rows: int) -> int:
         + 16 * tile
     )
     fit = max(1, int(budget // max(1, per_tree)))
-    return _largest_divisor_leq(t_group, min(want, fit))
+    batch = _largest_divisor_leq(t_group, min(want, fit))
+    telemetry.record_hbm_estimate("tree_batch", float(per_tree) * batch)
+    return batch
 
 
 class ForestConfig(NamedTuple):

@@ -1,14 +1,18 @@
-"""Resilience runtime: checkpoint/resume, bounded retries, fault injection.
+"""Resilience + observability runtime: checkpoint/resume, bounded
+retries, fault injection, and the telemetry layer.
 
-See ``docs/fault_tolerance.md`` for the operator-facing contract. All
-pieces are env-gated and fully inert by default:
+See ``docs/fault_tolerance.md`` and ``docs/observability.md`` for the
+operator-facing contracts. All pieces are env-gated and fully inert by
+default:
 
 - ``TPUML_CKPT_DIR`` / ``TPUML_CKPT_EVERY`` — :class:`FitCheckpointer`
 - ``TPUML_RETRIES`` / ``TPUML_BACKOFF_MS``  — :func:`with_retries`
 - ``TPUML_FAULT_SPEC``                      — :func:`fault_site` hooks
+- ``TPUML_TRACE`` / ``TPUML_TELEMETRY_*``   — :mod:`telemetry` spans,
+  typed metrics, and the retrace/HBM watchdogs
 """
 
-from . import counters
+from . import counters, metricspec, telemetry
 from .checkpoint import CKPT_VERSION, FitCheckpointer, array_digest, params_hash
 from .faults import (
     FaultInjector,
@@ -49,4 +53,6 @@ __all__ = [
     "resolve_retries",
     "with_retries",
     "counters",
+    "metricspec",
+    "telemetry",
 ]

@@ -1,85 +1,66 @@
-"""Process-wide resilience counters.
+"""Process-wide resilience counters (back-compat shim).
 
-The resilience runtime (checkpoint/retry/fault-injection) reports what it
-did through a tiny thread-safe counter registry instead of logs-only, so
-bench.py can attach ``retries`` / ``resumed_from`` columns to every entry
-and tests can assert the clean path is fully inert (all deltas zero).
+The original int-dict registry this module held now lives in the typed
+metrics layer (:mod:`runtime.telemetry`), with the metric catalog in
+:mod:`runtime.metricspec` — gauge-vs-counter semantics are a property
+of the registered metric, not a name check here. This shim keeps the
+API every call site and test already uses (``bump`` / ``note`` /
+``get`` / ``snapshot`` / ``delta_since`` / ``reset``), so bench.py can
+still attach ``retries`` / ``resumed_from`` columns to every entry and
+tests can still assert the clean path is fully inert (all deltas zero).
 
-Counter names in use:
-
-- ``retries``         — attempts beyond the first made by ``with_retries``.
-- ``chunk_halvings``  — chunk splits performed after RESOURCE_EXHAUSTED
-                        staging failures (``ops/streaming.py``).
-- ``resumed_fits``    — fits that restored optimizer state from a
-                        checkpoint instead of starting at iteration 0.
-- ``resumed_from``    — gauge: iteration/epoch the most recent resume
-                        continued from (0 when nothing resumed).
-- ``cv_failed_fits``  — param combos recorded as worst-metric by the
-                        CrossValidator tolerant mode (``TPUML_CV_FAILFAST=0``).
-- ``wire_release_errors`` — chunk device buffers whose post-fold
-                        ``delete()`` raised (``ops/streaming.py`` release
-                        helper); a nonzero delta means retired wire
-                        buffers may be leaking host/device memory.
-- ``gang_dispatches``  — batched gang-fit device dispatches issued by
-                        ``core._TpuEstimator._gang_dispatch``
-                        (``TPUML_GANG_FIT``); one per static-bucket chunk.
-- ``gang_lanes_total`` — param lanes fitted across all gang dispatches
-                        (``gang_lanes_total / gang_dispatches`` = mean
-                        gang width).
+Names bumped through this shim must be declared in
+``runtime/metricspec.py`` — lint rule TPU007 rejects uncataloged metric
+names in repo code (the counter analog of TPU002's env/doc drift rule).
 """
 
 from __future__ import annotations
 
-import threading
 from typing import Dict
 
-_lock = threading.Lock()
-_counters: Dict[str, int] = {}
+from . import telemetry
 
 
 def bump(name: str, by: int = 1) -> None:
     """Increment counter ``name`` by ``by`` (creates it at 0)."""
-    with _lock:
-        _counters[name] = _counters.get(name, 0) + int(by)
+    telemetry._legacy_metric(name, "counter").inc(int(by))
 
 
 def note(name: str, value: int) -> None:
     """Set gauge ``name`` to ``value`` (last-write-wins semantics)."""
-    with _lock:
-        _counters[name] = int(value)
+    telemetry._legacy_metric(name, "gauge").set(int(value))
 
 
 def get(name: str) -> int:
-    with _lock:
-        return _counters.get(name, 0)
+    return int(telemetry._legacy_snapshot().get(name, 0))
 
 
 def snapshot() -> Dict[str, int]:
-    """A point-in-time copy of every counter."""
-    with _lock:
-        return dict(_counters)
+    """A point-in-time copy of every legacy-visible counter/gauge."""
+    return telemetry._legacy_snapshot()
 
 
 def delta_since(base: Dict[str, int]) -> Dict[str, int]:
     """Counter changes since ``base`` (a prior :func:`snapshot`).
 
-    Gauges (``resumed_from``) are reported as their current value when it
-    changed; plain counters as the difference. Keys with zero delta are
-    omitted so the clean path reports ``{}``.
+    Gauges are reported as their current value when it changed; plain
+    counters as the difference — decided by each metric's registered
+    kind (``metricspec`` / the live registry), not its name. Keys with
+    zero delta are omitted so the clean path reports ``{}``.
     """
     cur = snapshot()
     out: Dict[str, int] = {}
     for name, value in cur.items():
-        d = value - base.get(name, 0)
-        if name == "resumed_from":
+        if telemetry.metric_kind(name) == "gauge":
             if value != base.get(name, 0):
                 out[name] = value
-        elif d:
-            out[name] = d
+        else:
+            d = value - base.get(name, 0)
+            if d:
+                out[name] = d
     return out
 
 
 def reset() -> None:
     """Zero every counter (test isolation)."""
-    with _lock:
-        _counters.clear()
+    telemetry._reset_metrics()

@@ -441,6 +441,45 @@ SPEC: Dict[str, EnvVar] = _registry(
         category="resilience",
         also_documented_in=("docs/fault_tolerance.md",),
     ),
+    # --- observability (docs/observability.md) ----------------------------
+    EnvVar(
+        "TPUML_TRACE", "path", None,
+        "Directory for structured telemetry output: a Chrome-trace/"
+        "Perfetto JSON (`trace-<pid>.json`), a JSONL span event log "
+        "(`events-<pid>.jsonl`), and Prometheus/JSON metric dumps on "
+        "request. Unset (the default) keeps the whole telemetry path "
+        "inert: no files, no span allocation, outputs bit-identical.",
+        category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
+    EnvVar(
+        "TPUML_TELEMETRY_DEVICE_TIME", "bool", False,
+        "Opt-in device-time fencing: spans that wrap device work call "
+        "`block_until_ready` on close so their duration includes device "
+        "execution, and per-span `device_seconds` aggregates become "
+        "meaningful. Off by default because the fence serializes "
+        "dispatch against the host. Only read when `TPUML_TRACE` is set.",
+        category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
+    EnvVar(
+        "TPUML_TELEMETRY_RETRACE_LIMIT", "int", 16,
+        "Retrace-watchdog threshold: warn once per span site when XLA "
+        "compilations attributed to it exceed this count in steady state "
+        "(the runtime enforcement of lint rule TPU003). `0` disables the "
+        "watchdog. The listener installs when `TPUML_TRACE` is set or "
+        "this variable is set explicitly.",
+        minimum=0, category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
+    EnvVar(
+        "TPUML_TELEMETRY_RESERVOIR", "int", 512,
+        "Bound of each histogram metric's observation ring (a "
+        "deterministic last-N window feeding the exported quantiles); "
+        "running count/sum/min/max are exact regardless of the bound.",
+        minimum=1, category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
 )
 
 

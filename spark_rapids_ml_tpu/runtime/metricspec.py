@@ -1,0 +1,167 @@
+"""Typed catalog of every telemetry metric name.
+
+Single source of truth for the name, kind (counter / gauge / histogram),
+and one-line doc of each metric the library records — the metric analog
+of :mod:`envspec` for ``TPUML_*`` variables. All recording goes through
+:mod:`runtime.telemetry` (or the legacy :mod:`runtime.counters` shim);
+``tpuml_lint`` rule TPU007 rejects metric names used in code but missing
+from this catalog, so the registry and the call sites cannot drift.
+
+Deliberately stdlib-only (no jax/numpy, no relative imports): the linter
+loads this file directly via ``importlib`` without importing the
+package, so the catalog check runs even where jax does not.
+
+Kinds:
+
+- ``counter``   — monotonically increasing int; ``delta_since`` reports
+                  the difference.
+- ``gauge``     — last-write-wins value; ``delta_since`` reports the
+                  current value when it changed (not a difference).
+- ``histogram`` — observation stream with exact running count/sum/min/
+                  max plus a bounded deterministic ring of the last N
+                  observations feeding exported quantiles
+                  (``TPUML_TELEMETRY_RESERVOIR``).
+
+``legacy=True`` marks the eight pre-telemetry resilience counters that
+remain visible through ``counters.snapshot()`` / ``delta_since`` (the
+``_resilience_report`` contract); newer metrics live only in the typed
+registry and its Prometheus/JSON exports.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+KINDS = ("counter", "gauge", "histogram")
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    """One cataloged metric. ``kind`` is counter|gauge|histogram."""
+
+    name: str
+    kind: str
+    doc: str
+    # visible through the legacy counters.snapshot()/delta_since API
+    # (the _resilience_report contract established before the typed
+    # registry existed)
+    legacy: bool = False
+
+
+def _registry(*specs: MetricSpec) -> Dict[str, MetricSpec]:
+    out: Dict[str, MetricSpec] = {}
+    for s in specs:
+        assert s.kind in KINDS, f"{s.name}: bad kind {s.kind}"
+        assert s.name not in out, f"duplicate registration {s.name}"
+        out[s.name] = s
+    return out
+
+
+SPEC: Dict[str, MetricSpec] = _registry(
+    # --- resilience (legacy counters.py catalog, PRs 4-7) -----------------
+    MetricSpec(
+        "retries", "counter",
+        "Attempts beyond the first made by `with_retries`.",
+        legacy=True,
+    ),
+    MetricSpec(
+        "chunk_halvings", "counter",
+        "Chunk splits performed after RESOURCE_EXHAUSTED staging "
+        "failures (`ops/streaming.py`).",
+        legacy=True,
+    ),
+    MetricSpec(
+        "resumed_fits", "counter",
+        "Fits that restored optimizer state from a checkpoint instead "
+        "of starting at iteration 0.",
+        legacy=True,
+    ),
+    MetricSpec(
+        "resumed_from", "gauge",
+        "Iteration/epoch the most recent resume continued from (0 when "
+        "nothing resumed).",
+        legacy=True,
+    ),
+    MetricSpec(
+        "cv_failed_fits", "counter",
+        "Param combos recorded as worst-metric by the CrossValidator "
+        "tolerant mode (`TPUML_CV_FAILFAST=0`).",
+        legacy=True,
+    ),
+    MetricSpec(
+        "wire_release_errors", "counter",
+        "Chunk device buffers whose post-fold `delete()` raised "
+        "(`ops/streaming.py` release helper); a nonzero delta means "
+        "retired wire buffers may be leaking host/device memory.",
+        legacy=True,
+    ),
+    MetricSpec(
+        "gang_dispatches", "counter",
+        "Batched gang-fit device dispatches issued by "
+        "`core._TpuEstimator._gang_dispatch` (`TPUML_GANG_FIT`); one "
+        "per static-bucket chunk.",
+        legacy=True,
+    ),
+    MetricSpec(
+        "gang_lanes_total", "counter",
+        "Param lanes fitted across all gang dispatches "
+        "(`gang_lanes_total / gang_dispatches` = mean gang width).",
+        legacy=True,
+    ),
+    # --- telemetry runtime (PR 9) -----------------------------------------
+    MetricSpec(
+        "spans_recorded", "counter",
+        "Spans closed and recorded by the tracing layer while "
+        "`TPUML_TRACE` is set (0 forever when unset — the inertness "
+        "sentinel).",
+    ),
+    MetricSpec(
+        "span_seconds", "histogram",
+        "Wall-clock duration of every recorded span, labeled by span "
+        "name (the distribution behind the Chrome-trace export).",
+    ),
+    MetricSpec(
+        "xla_compiles", "counter",
+        "XLA backend compilations observed by the retrace watchdog, "
+        "labeled by the innermost active span at compile time "
+        "(`jax.monitoring` backend_compile events).",
+    ),
+    MetricSpec(
+        "xla_compile_seconds", "histogram",
+        "Duration of each observed XLA backend compilation, labeled "
+        "like `xla_compiles`.",
+    ),
+    MetricSpec(
+        "retrace_storms", "counter",
+        "Span sites whose attributed compilation count crossed "
+        "`TPUML_TELEMETRY_RETRACE_LIMIT` (each site warns and counts "
+        "once).",
+    ),
+    MetricSpec(
+        "hbm_budget_bytes", "gauge",
+        "Most recent HBM peak estimate produced by a budget resolver, "
+        "labeled by site (`gang_fit`, `tree_batch`, `stream_stage`).",
+    ),
+    MetricSpec(
+        "hbm_live_bytes", "gauge",
+        "Live device memory in use when an HBM estimate was recorded, "
+        "as reported by `Device.memory_stats()` (absent on backends "
+        "that report none).",
+    ),
+)
+
+
+def registered_names() -> Tuple[str, ...]:
+    return tuple(SPEC)
+
+
+def kind_of(name: str) -> str:
+    """The registered kind of ``name``; KeyError names the registry."""
+    try:
+        return SPEC[name].kind
+    except KeyError:
+        raise KeyError(
+            f"{name} is not a cataloged metric "
+            f"(spark_rapids_ml_tpu/runtime/metricspec.py is the registry)"
+        ) from None

@@ -1,0 +1,730 @@
+"""Unified telemetry runtime: structured spans, typed metrics, watchdogs.
+
+One layer answers "where did this fit's wall time go" across host
+threads, streaming stages, and device dispatches:
+
+- **Spans** — hierarchical wall-clock intervals with ``contextvars``
+  parent propagation that survives worker threads (the fold pool in
+  ``tuning.py``, the decode/stage threads in ``ops/streaming.py``) via
+  :func:`bind_context`. Wall time is always measured; device time is
+  opt-in (``TPUML_TELEMETRY_DEVICE_TIME``) through a
+  ``block_until_ready`` fence at span close. Spans export as a
+  Chrome-trace/Perfetto JSON plus a JSONL event log under
+  ``TPUML_TRACE=<dir>``.
+- **Typed metrics** — counter / gauge / histogram-with-bounded-ring,
+  optionally labeled, cataloged in :mod:`metricspec` (lint rule TPU007
+  keeps call sites and catalog in sync). The legacy
+  :mod:`runtime.counters` API is a shim over this registry. Exports:
+  Prometheus text format and a JSON snapshot.
+- **Retrace watchdog** — counts XLA backend compilations per innermost
+  active span (``jax.monitoring`` events) and warns once per site past
+  ``TPUML_TELEMETRY_RETRACE_LIMIT`` — the runtime enforcement of lint
+  rule TPU003.
+- **HBM accounting** — :func:`record_hbm_estimate` files each budget
+  resolver's peak estimate (gang fit, tree batch, stream staging) as a
+  labeled gauge next to the backend's live memory stats.
+
+Defaults are inert: with ``TPUML_TRACE`` unset, :func:`span` returns a
+shared no-op, nothing is recorded or written, and outputs are
+bit-identical to an uninstrumented run (``tests/test_telemetry.py``
+asserts this bitwise).
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextvars
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from . import envspec, metricspec
+
+_LOGGER = logging.getLogger("spark_rapids_ml_tpu")
+
+__all__ = [
+    "enabled",
+    "span",
+    "timed_span",
+    "bind_context",
+    "counter",
+    "gauge",
+    "histogram",
+    "metric_kind",
+    "span_stats",
+    "flush",
+    "prometheus_dump",
+    "metrics_snapshot",
+    "write_metrics",
+    "record_hbm_estimate",
+    "install_retrace_watchdog",
+    "reset_telemetry",
+]
+
+
+# --------------------------------------------------------------------------
+# enable gates
+# --------------------------------------------------------------------------
+
+
+def enabled() -> bool:
+    """True when ``TPUML_TRACE`` is set (spans record and export)."""
+    return envspec.is_set("TPUML_TRACE")
+
+
+def _trace_dir() -> Optional[str]:
+    return envspec.get("TPUML_TRACE")
+
+
+def _device_time() -> bool:
+    return bool(envspec.get("TPUML_TELEMETRY_DEVICE_TIME"))
+
+
+# --------------------------------------------------------------------------
+# typed metrics registry
+# --------------------------------------------------------------------------
+
+_MLOCK = threading.Lock()
+_METRICS: Dict[str, "_Metric"] = {}
+
+
+class _Hist:
+    """Exact running count/sum/min/max plus a deterministic last-N ring
+    (no sampling randomness — TPU004 applies to telemetry too)."""
+
+    __slots__ = ("count", "sum", "min", "max", "ring")
+
+    def __init__(self, reservoir: int) -> None:
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.ring: Deque[float] = deque(maxlen=reservoir)
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        if self.min is None or value < self.min:
+            self.min = value
+        if self.max is None or value > self.max:
+            self.max = value
+        self.ring.append(value)
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self.ring:
+            return None
+        ordered = sorted(self.ring)
+        return ordered[int(q * (len(ordered) - 1))]
+
+
+class _Metric:
+    """One named metric: kind + labeled series map.
+
+    ``legacy`` series stay visible through ``counters.snapshot()`` /
+    ``delta_since`` (the ``_resilience_report`` contract); typed-only
+    metrics export through Prometheus/JSON instead.
+    """
+
+    __slots__ = ("name", "kind", "legacy", "series")
+
+    def __init__(self, name: str, kind: str, legacy: bool) -> None:
+        self.name = name
+        self.kind = kind
+        self.legacy = legacy
+        self.series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+
+    @staticmethod
+    def _key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def inc(self, by: int = 1, **labels: Any) -> None:
+        if self.kind != "counter":
+            raise ValueError(f"{self.name} is a {self.kind}, not a counter")
+        key = self._key(labels)
+        with _MLOCK:
+            self.series[key] = self.series.get(key, 0) + int(by)
+
+    def set(self, value: float, **labels: Any) -> None:
+        if self.kind != "gauge":
+            raise ValueError(f"{self.name} is a {self.kind}, not a gauge")
+        key = self._key(labels)
+        with _MLOCK:
+            self.series[key] = value
+
+    def observe(self, value: float, **labels: Any) -> None:
+        if self.kind != "histogram":
+            raise ValueError(
+                f"{self.name} is a {self.kind}, not a histogram"
+            )
+        key = self._key(labels)
+        with _MLOCK:
+            h = self.series.get(key)
+            if h is None:
+                h = self.series[key] = _Hist(
+                    int(envspec.get("TPUML_TELEMETRY_RESERVOIR"))
+                )
+            h.observe(value)
+
+    def value(self, **labels: Any) -> Any:
+        with _MLOCK:
+            return self.series.get(self._key(labels))
+
+
+def _metric(name: str, kind: str, *, legacy: bool = False) -> _Metric:
+    """The metric instance for ``name``, created on first use.
+
+    Cataloged names take their kind (and legacy visibility) from
+    :mod:`metricspec` — asking for a cataloged gauge as a counter is a
+    ``ValueError``, which is what makes gauge-vs-counter a property of
+    the metric rather than a name check. Uncataloged names are allowed
+    at runtime (lint rule TPU007 rejects them statically in repo code).
+    """
+    with _MLOCK:
+        m = _METRICS.get(name)
+        if m is None:
+            spec = metricspec.SPEC.get(name)
+            if spec is not None:
+                m = _Metric(name, spec.kind, spec.legacy)
+            else:
+                m = _Metric(name, kind, legacy)
+            _METRICS[name] = m
+    if m.kind != kind:
+        raise ValueError(
+            f"metric {name!r} is registered as a {m.kind}, not a {kind}"
+        )
+    return m
+
+
+def counter(name: str) -> _Metric:
+    return _metric(name, "counter")
+
+
+def gauge(name: str) -> _Metric:
+    return _metric(name, "gauge")
+
+
+def histogram(name: str) -> _Metric:
+    return _metric(name, "histogram")
+
+
+def metric_kind(name: str) -> str:
+    """The kind of ``name`` — live instance first, then the catalog,
+    defaulting to ``counter`` for uncataloged dynamic names."""
+    with _MLOCK:
+        m = _METRICS.get(name)
+    if m is not None:
+        return m.kind
+    spec = metricspec.SPEC.get(name)
+    return spec.kind if spec is not None else "counter"
+
+
+# legacy counters.py bridge -------------------------------------------------
+
+
+def _legacy_metric(name: str, kind: str) -> _Metric:
+    """Shim entry point: uncataloged names created here stay visible in
+    ``counters.snapshot()`` like the pre-registry dict did."""
+    return _metric(name, kind, legacy=True)
+
+
+def _legacy_snapshot() -> Dict[str, int]:
+    with _MLOCK:
+        out: Dict[str, int] = {}
+        for name, m in _METRICS.items():
+            if not m.legacy or m.kind == "histogram":
+                continue
+            v = m.series.get(())
+            if v is not None:
+                out[name] = int(v)
+        return out
+
+
+def _reset_metrics() -> None:
+    with _MLOCK:
+        _METRICS.clear()
+
+
+# --------------------------------------------------------------------------
+# spans
+# --------------------------------------------------------------------------
+
+_CURRENT: "contextvars.ContextVar[Optional[_Span]]" = contextvars.ContextVar(
+    "tpuml_current_span", default=None
+)
+_IDS = itertools.count(1)
+
+_RLOCK = threading.Lock()
+_EPOCH: Optional[float] = None  # perf_counter origin of trace timestamps
+_EVENTS: List[Dict[str, Any]] = []  # chrome-trace "X" events
+_PENDING_LINES: List[str] = []  # jsonl lines not yet appended to disk
+_THREADS: Dict[int, str] = {}  # tid -> thread name (trace metadata)
+_STATS: Dict[str, List[float]] = {}  # name -> [count, wall_s, device_s]
+_ATEXIT_REGISTERED = False
+
+
+class _NullSpan:
+    """Shared no-op returned while tracing is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        return None
+
+    def set_attr(self, **attrs: Any) -> None:
+        return None
+
+    def fence(self, arrays: Any) -> None:
+        return None
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    """One live span: wall interval + optional device fence + attrs."""
+
+    __slots__ = (
+        "name",
+        "attrs",
+        "span_id",
+        "parent_id",
+        "_token",
+        "_t0",
+        "device_s",
+        "_fences",
+        "tid",
+        "thread_name",
+    )
+
+    def __init__(self, name: str, attrs: Dict[str, Any]) -> None:
+        self.name = name
+        self.attrs = attrs
+        self.device_s = 0.0
+        self._fences: List[Any] = []
+
+    def __enter__(self) -> "_Span":
+        parent = _CURRENT.get()
+        self.parent_id = parent.span_id if parent is not None else None
+        self.span_id = next(_IDS)
+        self._token = _CURRENT.set(self)
+        t = threading.current_thread()
+        self.tid = t.ident or 0
+        self.thread_name = t.name
+        self._t0 = time.perf_counter()
+        return self
+
+    def set_attr(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    def fence(self, arrays: Any) -> None:
+        """Register device arrays to ``block_until_ready`` at close when
+        ``TPUML_TELEMETRY_DEVICE_TIME`` is on, so the span's duration
+        includes device execution and the blocked wait is accounted as
+        ``device_seconds``."""
+        self._fences.append(arrays)
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._fences and _device_time():
+            t_fence = time.perf_counter()
+            try:
+                import jax
+
+                jax.block_until_ready(self._fences)
+                self.device_s = time.perf_counter() - t_fence
+            except Exception:  # fencing must never fail the fit
+                pass
+        dur = time.perf_counter() - self._t0
+        _CURRENT.reset(self._token)
+        _record(self, dur)
+        return None
+
+
+def span(name: str, **attrs: Any) -> Any:
+    """A context manager for one named span.
+
+    No-op (a shared singleton, no allocation or recording) while
+    ``TPUML_TRACE`` is unset. The returned object supports
+    ``set_attr(**kw)`` and ``fence(arrays)`` in both modes.
+    """
+    if not enabled():
+        return _NULL
+    _ensure_watchdog()
+    return _Span(name, attrs)
+
+
+class timed_span:
+    """A span that always measures wall time (``.seconds`` after exit),
+    recording to the trace only when tracing is enabled. The report
+    dicts (``_fit_report`` / ``_transform_report`` / ...) read their
+    stage seconds from this layer, so enabling the trace never changes
+    what they contain."""
+
+    __slots__ = ("_span", "_t0", "seconds")
+
+    def __init__(self, name: str, **attrs: Any) -> None:
+        self._span = span(name, **attrs)
+        self.seconds = 0.0
+
+    def __enter__(self) -> "timed_span":
+        self._span.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> Any:
+        self.seconds = time.perf_counter() - self._t0
+        return self._span.__exit__(*exc)
+
+
+def bind_context(fn: Any) -> Any:
+    """Wrap ``fn`` so invocations on another thread inherit the caller's
+    span stack. Captures the current ``contextvars`` context once; each
+    call runs in a private copy (one Context object cannot be entered
+    concurrently). Identity while tracing is disabled."""
+    if not enabled():
+        return fn
+    snap = contextvars.copy_context()
+
+    def _bound(*args: Any, **kwargs: Any) -> Any:
+        return snap.copy().run(fn, *args, **kwargs)
+
+    return _bound
+
+
+def _record(s: _Span, dur: float) -> None:
+    global _EPOCH, _ATEXIT_REGISTERED
+    root_closed = s.parent_id is None
+    with _RLOCK:
+        if _EPOCH is None:
+            _EPOCH = s._t0
+        ts_us = (s._t0 - _EPOCH) * 1e6
+        args: Dict[str, Any] = dict(s.attrs)
+        args["span_id"] = s.span_id
+        if s.parent_id is not None:
+            args["parent_id"] = s.parent_id
+        if s.device_s:
+            args["device_seconds"] = round(s.device_s, 6)
+        _EVENTS.append(
+            {
+                "name": s.name,
+                "ph": "X",
+                "ts": round(ts_us, 3),
+                "dur": round(dur * 1e6, 3),
+                "pid": os.getpid(),
+                "tid": s.tid,
+                "args": args,
+            }
+        )
+        _THREADS.setdefault(s.tid, s.thread_name)
+        _PENDING_LINES.append(
+            json.dumps(
+                {
+                    "event": "span",
+                    "name": s.name,
+                    "span_id": s.span_id,
+                    "parent_id": s.parent_id,
+                    "thread": s.thread_name,
+                    "ts_us": round(ts_us, 3),
+                    "wall_seconds": round(dur, 6),
+                    "device_seconds": round(s.device_s, 6),
+                    "attrs": s.attrs,
+                },
+                sort_keys=True,
+                default=str,
+            )
+        )
+        st = _STATS.get(s.name)
+        if st is None:
+            st = _STATS[s.name] = [0, 0.0, 0.0]
+        st[0] += 1
+        st[1] += dur
+        st[2] += s.device_s
+        if not _ATEXIT_REGISTERED:
+            _ATEXIT_REGISTERED = True
+            atexit.register(flush)
+    counter("spans_recorded").inc()
+    histogram("span_seconds").observe(dur, name=s.name)
+    if root_closed:
+        flush()
+
+
+def span_stats() -> Dict[str, Dict[str, float]]:
+    """Per-span-name running aggregates:
+    ``{name: {count, wall_seconds, device_seconds}}`` (empty while
+    tracing never enabled — the inertness sentinel)."""
+    with _RLOCK:
+        return {
+            name: {
+                "count": int(st[0]),
+                "wall_seconds": st[1],
+                "device_seconds": st[2],
+            }
+            for name, st in _STATS.items()
+        }
+
+
+def flush() -> Optional[str]:
+    """Write the Chrome-trace JSON (rewritten whole) and append pending
+    JSONL span events under ``TPUML_TRACE``. Called automatically at
+    every root-span close and at interpreter exit; safe to call any
+    time. Returns the trace file path, or None when there is nothing to
+    write or the env was unset meanwhile."""
+    out_dir = _trace_dir()
+    with _RLOCK:
+        if out_dir is None or not _EVENTS:
+            return None
+        meta = [
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": os.getpid(),
+                "tid": 0,
+                "args": {"name": "spark_rapids_ml_tpu"},
+            }
+        ]
+        for tid, tname in sorted(_THREADS.items()):
+            meta.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": os.getpid(),
+                    "tid": tid,
+                    "args": {"name": tname},
+                }
+            )
+        doc = {"traceEvents": meta + _EVENTS, "displayTimeUnit": "ms"}
+        pending, _PENDING_LINES[:] = _PENDING_LINES[:], []
+        os.makedirs(out_dir, exist_ok=True)
+        trace_path = os.path.join(out_dir, f"trace-{os.getpid()}.json")
+        tmp = trace_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, trace_path)
+        if pending:
+            events_path = os.path.join(
+                out_dir, f"events-{os.getpid()}.jsonl"
+            )
+            with open(events_path, "a") as f:
+                f.write("\n".join(pending) + "\n")
+        return trace_path
+
+
+def reset_telemetry() -> None:
+    """Clear spans, metrics, and watchdog state (test isolation)."""
+    global _EPOCH
+    with _RLOCK:
+        _EPOCH = None
+        _EVENTS.clear()
+        _PENDING_LINES.clear()
+        _THREADS.clear()
+        _STATS.clear()
+    _reset_metrics()
+    with _WD_LOCK:
+        _WD_COUNTS.clear()
+        _WD_WARNED.clear()
+
+
+# --------------------------------------------------------------------------
+# metric exports
+# --------------------------------------------------------------------------
+
+_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _label_str(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [
+        f'{k}="{v}"'.replace("\n", " ")
+        for k, v in key
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_dump() -> str:
+    """Every live metric in Prometheus text exposition format
+    (``tpuml_`` prefix; histograms exported summary-style from the
+    bounded ring plus exact ``_count`` / ``_sum``)."""
+    with _MLOCK:
+        metrics = sorted(_METRICS.items())
+        lines: List[str] = []
+        for name, m in metrics:
+            spec = metricspec.SPEC.get(name)
+            doc = spec.doc if spec is not None else "(uncataloged metric)"
+            pname = f"tpuml_{name}"
+            ptype = "summary" if m.kind == "histogram" else m.kind
+            lines.append(f"# HELP {pname} {doc}".replace("\n", " "))
+            lines.append(f"# TYPE {pname} {ptype}")
+            for key, v in sorted(m.series.items()):
+                if m.kind == "histogram":
+                    for q in _QUANTILES:
+                        qv = v.quantile(q)
+                        if qv is None:
+                            continue
+                        qlabel = 'quantile="%g"' % q
+                        lines.append(
+                            f"{pname}{_label_str(key, qlabel)} {qv:g}"
+                        )
+                    lines.append(
+                        f"{pname}_count{_label_str(key)} {v.count}"
+                    )
+                    lines.append(f"{pname}_sum{_label_str(key)} {v.sum:g}")
+                else:
+                    lines.append(f"{pname}{_label_str(key)} {v:g}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def metrics_snapshot() -> Dict[str, Any]:
+    """A JSON-able snapshot of every live metric: kind plus each labeled
+    series (histograms as count/sum/min/max + ring quantiles)."""
+    with _MLOCK:
+        out: Dict[str, Any] = {}
+        for name, m in sorted(_METRICS.items()):
+            series = []
+            for key, v in sorted(m.series.items()):
+                labels = dict(key)
+                if m.kind == "histogram":
+                    series.append(
+                        {
+                            "labels": labels,
+                            "count": v.count,
+                            "sum": v.sum,
+                            "min": v.min,
+                            "max": v.max,
+                            **{
+                                f"p{int(q * 100)}": v.quantile(q)
+                                for q in _QUANTILES
+                            },
+                        }
+                    )
+                else:
+                    series.append({"labels": labels, "value": v})
+            out[name] = {"kind": m.kind, "series": series}
+        return out
+
+
+def write_metrics(out_dir: Optional[str] = None) -> Optional[Tuple[str, str]]:
+    """Write ``metrics-<pid>.prom`` (text format) and
+    ``metrics-<pid>.json`` (snapshot) into ``out_dir`` (default: the
+    ``TPUML_TRACE`` directory). Returns the two paths, or None when no
+    directory is configured."""
+    out_dir = out_dir or _trace_dir()
+    if out_dir is None:
+        return None
+    os.makedirs(out_dir, exist_ok=True)
+    prom = os.path.join(out_dir, f"metrics-{os.getpid()}.prom")
+    js = os.path.join(out_dir, f"metrics-{os.getpid()}.json")
+    with open(prom, "w") as f:
+        f.write(prometheus_dump())
+    with open(js, "w") as f:
+        json.dump(metrics_snapshot(), f, indent=2, sort_keys=True)
+    return prom, js
+
+
+# --------------------------------------------------------------------------
+# retrace watchdog (runtime TPU003)
+# --------------------------------------------------------------------------
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_WD_LOCK = threading.Lock()
+_WD_INSTALLED = False
+_WD_CHECKED = False
+_WD_COUNTS: Dict[str, int] = {}
+_WD_WARNED: set = set()
+
+
+def _retrace_limit() -> int:
+    return int(envspec.get("TPUML_TELEMETRY_RETRACE_LIMIT"))
+
+
+def _on_event_duration(event: str, duration: float, **kw: Any) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    try:  # a listener exception would poison every jax compile
+        cur = _CURRENT.get()
+        site = cur.name if cur is not None else "<untraced>"
+        counter("xla_compiles").inc(1, site=site)
+        histogram("xla_compile_seconds").observe(duration, site=site)
+        storm = False
+        with _WD_LOCK:
+            count = _WD_COUNTS[site] = _WD_COUNTS.get(site, 0) + 1
+            if site not in _WD_WARNED:
+                limit = _retrace_limit()
+                storm = limit > 0 and count > limit
+                if storm:
+                    _WD_WARNED.add(site)
+        if storm:
+            counter("retrace_storms").inc()
+            _LOGGER.warning(
+                "retrace storm: %d XLA compilations attributed to span "
+                "site %r (limit %d) — a traced argument is likely "
+                "changing every call (static shape/env read inside jit; "
+                "see docs/static_analysis.md TPU003 and "
+                "docs/observability.md)",
+                count,
+                site,
+                limit,
+            )
+    except Exception:
+        pass
+
+
+def install_retrace_watchdog() -> bool:
+    """Register the compile-event listener (idempotent). Returns True
+    when installed (now or earlier), False when jax.monitoring is
+    unavailable. Listeners cannot be unregistered, so this only happens
+    on explicit opt-in: ``TPUML_TRACE`` set, an explicit
+    ``TPUML_TELEMETRY_RETRACE_LIMIT``, or a direct call."""
+    global _WD_INSTALLED
+    with _WD_LOCK:
+        if _WD_INSTALLED:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:
+            return False
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _WD_INSTALLED = True
+        return True
+
+
+def _ensure_watchdog() -> None:
+    """Install on the first enabled span; cheap after the first call."""
+    global _WD_CHECKED
+    if _WD_CHECKED:
+        return
+    _WD_CHECKED = True
+    if _retrace_limit() > 0:
+        install_retrace_watchdog()
+
+
+# --------------------------------------------------------------------------
+# HBM accounting
+# --------------------------------------------------------------------------
+
+
+def record_hbm_estimate(site: str, nbytes: float) -> None:
+    """File a budget resolver's peak HBM estimate (``site`` is
+    ``gang_fit`` / ``tree_batch`` / ``stream_stage``) next to the
+    backend's live bytes-in-use where reported. No-op while tracing is
+    disabled, so budget resolution stays allocation-free by default."""
+    if not enabled():
+        return
+    gauge("hbm_budget_bytes").set(float(nbytes), site=site)
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "bytes_in_use" in stats:
+            gauge("hbm_live_bytes").set(
+                float(stats["bytes_in_use"]), site=site
+            )
+    except Exception:  # backends without memory_stats
+        pass

@@ -27,7 +27,7 @@ from .data.dataframe import DataFrame, kfold
 from .evaluation import Evaluator
 from .params import Param, Params, TypeConverters, _mk
 from .runtime import counters as _res_counters
-from .runtime import envspec
+from .runtime import envspec, telemetry
 from .utils.logging import get_logger
 
 
@@ -198,6 +198,12 @@ class CrossValidator(_CrossValidatorParams):
                 gang_grid = None
 
         def run_fold(i: int) -> Tuple[np.ndarray, Optional[List[_TpuModel]]]:
+            with telemetry.span("cv.fold", fold=i):
+                return _run_fold(i)
+
+        def _run_fold(
+            i: int,
+        ) -> Tuple[np.ndarray, Optional[List[_TpuModel]]]:
             # Device passes are serialized across fold threads: jax 0.4.x
             # can deadlock (futex wedge inside the dispatch lock) when
             # several threads race the *first* compile of the same jitted
@@ -261,7 +267,11 @@ class CrossValidator(_CrossValidatorParams):
         par = max(1, self.getParallelism())
         if par > 1:
             with ThreadPool(processes=min(par, n_folds)) as pool:
-                fold_results = pool.map(run_fold, range(n_folds))
+                # pool threads inherit the caller's span stack so fold
+                # spans nest under the surrounding fit/tuning span
+                fold_results = pool.map(
+                    telemetry.bind_context(run_fold), range(n_folds)
+                )
         else:
             fold_results = [run_fold(i) for i in range(n_folds)]
         metrics_per_fold = [m for m, _ in fold_results]

@@ -13,15 +13,21 @@ and the Python benchmarks do phase wall-clock timing
   ``bench.py`` when ``BENCH_PROFILE_DIR`` is set.
 * :func:`timed` — phase wall-clock logging at debug level, the benchmark
   harness's ``with_benchmark`` analog for library internals.
+* :class:`StageTimer` — accumulating per-stage breakdown; each stage is
+  also a ``runtime.telemetry`` span, so the report dicts built from
+  ``totals`` and the exported trace see the same measurement.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Iterator, Optional
 
 import jax
+
+from ..runtime import telemetry
 
 
 def annotate(name: str):
@@ -71,25 +77,32 @@ class StageTimer:
         self.name = name
         self.totals: dict = {}
         self.counts: dict = {}
+        # fold threads overlap host-side transform/eval work since the
+        # PR-8 _FOLD_DEVICE_LOCK narrowing, so the accumulators need a
+        # real lock
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def stage(self, label: str) -> Iterator[None]:
-        t0 = time.perf_counter()
+        ts = telemetry.timed_span(f"{self.name}.{label}")
+        ts.__enter__()
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self.totals[label] = self.totals.get(label, 0.0) + dt
-            self.counts[label] = self.counts.get(label, 0) + 1
+            ts.__exit__(None, None, None)
+            with self._lock:
+                self.totals[label] = self.totals.get(label, 0.0) + ts.seconds
+                self.counts[label] = self.counts.get(label, 0) + 1
 
     def log_summary(self, logger) -> None:
         """Debug-log accumulated stages and reset for the next call."""
-        if not self.totals:
-            return
-        parts = ", ".join(
-            f"{k}={v:.4f}s/{self.counts[k]}x"
-            for k, v in sorted(self.totals.items())
-        )
+        with self._lock:
+            if not self.totals:
+                return
+            parts = ", ".join(
+                f"{k}={v:.4f}s/{self.counts[k]}x"
+                for k, v in sorted(self.totals.items())
+            )
+            self.totals.clear()
+            self.counts.clear()
         logger.debug("%s stages: %s", self.name, parts)
-        self.totals.clear()
-        self.counts.clear()

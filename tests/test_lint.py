@@ -18,6 +18,7 @@ from tpuml_lint import (
     tpu004_nondeterminism,
     tpu005_static_args,
     tpu006_lane_align,
+    tpu007_metric_catalog,
 )
 from tpuml_lint.core import (
     Finding,
@@ -320,6 +321,71 @@ def test_tpu006_clean_specs():
     assert findings == []
 
 
+# --- TPU007: metric catalog ------------------------------------------------
+
+
+def lint_project_snippet(rule, code, path="pkg/mod.py"):
+    """Run one project rule over a single-file snippet; suppressions
+    applied (mirrors how the runner filters project findings)."""
+    text = textwrap.dedent(code)
+    sf = SourceFile(
+        path=path, abspath="/" + path, text=text,
+        tree=ast.parse(text),
+    )
+    return [
+        f for f in rule.check_project([sf], REPO_ROOT)
+        if f.path != sf.path or not sf.suppressed(f)
+    ]
+
+
+def test_tpu007_flags_undeclared_names():
+    findings = lint_project_snippet(tpu007_metric_catalog, """
+        from spark_rapids_ml_tpu.runtime import counters, telemetry
+        counters.bump("bogus_counter")
+        counters.note("bogus_gauge", 3)
+        telemetry.counter("bogus_tele").inc()
+        telemetry.histogram("bogus_hist").observe(0.5)
+    """)
+    assert len(findings) == 4
+    assert all(f.rule == "TPU007" for f in findings)
+    assert all("not declared" in f.message for f in findings)
+
+
+def test_tpu007_flags_kind_mismatch():
+    # resumed_from is declared as a gauge; bump() implies a counter
+    findings = lint_project_snippet(tpu007_metric_catalog, """
+        from spark_rapids_ml_tpu.runtime import counters
+        counters.bump("resumed_from")
+    """)
+    assert len(findings) == 1
+    assert "declared as a gauge" in findings[0].message
+
+
+def test_tpu007_allows_declared_and_dynamic_names():
+    findings = lint_project_snippet(tpu007_metric_catalog, """
+        from spark_rapids_ml_tpu.runtime import counters, telemetry
+        counters.bump("retries")
+        counters.note("resumed_from", 7)
+        counters.get("retries")
+        telemetry.counter("gang_dispatches").inc(2)
+        telemetry.gauge("hbm_budget_bytes").set(1.0)
+        name = "retr" + "ies"
+        counters.bump(name)  # dynamic: out of scope
+        unrelated.bump("whatever")  # not a counters/telemetry call
+    """)
+    assert findings == []
+
+
+def test_tpu007_suppression_comment():
+    findings = lint_project_snippet(tpu007_metric_catalog, """
+        from spark_rapids_ml_tpu.runtime import counters
+        counters.bump("bogus_one")  # tpuml: ignore[TPU007]
+        counters.bump("bogus_two")
+    """)
+    assert len(findings) == 1
+    assert "bogus_two" in findings[0].message
+
+
 # --- baseline + suppression mechanics --------------------------------------
 
 
@@ -440,6 +506,10 @@ def test_lint_fails_on_each_rule(tmp_path):
         "TPU006": (
             "import jax.experimental.pallas as pl\n"
             "s = pl.BlockSpec((8, 100), lambda i: (i, 0))\n"
+        ),
+        "TPU007": (
+            "from spark_rapids_ml_tpu.runtime import counters\n"
+            'counters.bump("not_in_the_catalog")\n'
         ),
     }
     for code, src in bad.items():

@@ -1,0 +1,314 @@
+"""Telemetry runtime: span nesting (including across threads), trace
+export validity, Prometheus format, histogram ring bounds, the retrace
+watchdog, the counters shim's kind-aware deltas, and the defaults-inert
+contract (env unset => no files, no spans, bit-identical results)."""
+
+import json
+import logging
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.runtime import counters, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset_telemetry()
+    yield
+    telemetry.reset_telemetry()
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    """Enable tracing into a per-test directory."""
+    monkeypatch.setenv("TPUML_TRACE", str(tmp_path))
+    return tmp_path
+
+
+def _load_trace(tdir):
+    files = [f for f in os.listdir(tdir) if f.startswith("trace-")]
+    assert len(files) == 1, files
+    with open(os.path.join(tdir, files[0])) as f:
+        return json.load(f)
+
+
+# --- spans -----------------------------------------------------------------
+
+
+def test_span_nesting_and_attrs(traced):
+    with telemetry.span("outer", phase="a"):
+        with telemetry.span("inner") as sp:
+            sp.set_attr(rows=42)
+    telemetry.flush()
+
+    doc = _load_trace(traced)
+    xs = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert set(xs) == {"outer", "inner"}
+    outer, inner = xs["outer"], xs["inner"]
+    assert inner["args"]["parent_id"] == outer["args"]["span_id"]
+    assert "parent_id" not in outer["args"]  # root spans have no parent
+    assert outer["args"]["phase"] == "a"
+    assert inner["args"]["rows"] == 42
+    # complete events nest in time: ts/dur are microseconds
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
+
+    stats = telemetry.span_stats()
+    assert stats["outer"]["count"] == 1
+    assert stats["outer"]["wall_seconds"] >= stats["inner"]["wall_seconds"]
+
+
+def test_span_parenting_across_threads(traced):
+    """bind_context carries the active span into worker threads — the
+    same mechanism the CV fold pool and the streaming decode/stage
+    threads use."""
+    def work():
+        with telemetry.span("child"):
+            pass
+
+    with telemetry.span("root"):
+        t = threading.Thread(target=telemetry.bind_context(work))
+        t.start()
+        t.join()
+    telemetry.flush()
+
+    doc = _load_trace(traced)
+    xs = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert xs["child"]["args"]["parent_id"] == xs["root"]["args"]["span_id"]
+    # distinct threads get distinct tids (and thread_name metadata)
+    assert xs["child"]["tid"] != xs["root"]["tid"]
+    meta_tids = {
+        e["tid"] for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    assert {xs["child"]["tid"], xs["root"]["tid"]} <= meta_tids
+
+
+def test_trace_file_and_event_log_valid(traced):
+    with telemetry.span("a"):
+        pass
+    with telemetry.span("b"):
+        pass
+    telemetry.flush()
+
+    doc = _load_trace(traced)
+    assert isinstance(doc["traceEvents"], list)
+    for e in doc["traceEvents"]:
+        assert e["ph"] in ("X", "M")
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float))
+            assert e["dur"] >= 0
+
+    logs = [f for f in os.listdir(traced) if f.startswith("events-")]
+    assert len(logs) == 1
+    with open(os.path.join(traced, logs[0])) as f:
+        lines = [json.loads(line) for line in f]
+    assert {rec["name"] for rec in lines} == {"a", "b"}
+    assert all("wall_seconds" in rec for rec in lines)
+
+
+def test_timed_span_measures_even_untraced():
+    ts = telemetry.timed_span("anything")
+    with ts:
+        pass
+    assert ts.seconds >= 0.0
+    # nothing recorded: tracing is off
+    assert telemetry.span_stats() == {}
+
+
+def test_kmeans_fit_trace_covers_fit(traced):
+    """End-to-end: a traced fit produces a loadable trace whose root
+    span covers the whole fit and whose children account for the bulk
+    of it."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(512, 4)).astype(np.float32)
+    df = DataFrame({"features": X})
+    KMeans(k=3, maxIter=2, seed=0).setFeaturesCol("features").fit(df)
+    telemetry.flush()
+
+    stats = telemetry.span_stats()
+    assert "KMeans.fit" in stats
+    assert "preprocess" in stats and "fit.dispatch" in stats
+    root = stats["KMeans.fit"]["wall_seconds"]
+    covered = (
+        stats["preprocess"]["wall_seconds"]
+        + stats["fit.dispatch"]["wall_seconds"]
+    )
+    assert covered <= root
+    assert covered >= 0.95 * root
+
+    doc = _load_trace(traced)
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert {"KMeans.fit", "preprocess", "fit.dispatch"} <= names
+
+
+# --- metrics ---------------------------------------------------------------
+
+
+def test_histogram_ring_is_bounded(monkeypatch):
+    monkeypatch.setenv("TPUML_TELEMETRY_RESERVOIR", "4")
+    h = telemetry.histogram("span_seconds")
+    for i in range(100):
+        h.observe(float(i))
+    series = h.value()
+    assert series.count == 100
+    assert series.sum == sum(range(100))
+    assert series.min == 0.0 and series.max == 99.0
+    # deterministic last-N ring, not an unbounded (or sampled) buffer
+    assert list(series.ring) == [96.0, 97.0, 98.0, 99.0]
+
+
+def test_metric_kind_mismatch_raises():
+    with pytest.raises(ValueError, match="registered as a gauge"):
+        # deliberate kind mismatch: the runtime check under test
+        # tpuml: ignore[TPU007]
+        telemetry.counter("resumed_from")
+
+
+def test_prometheus_dump_format():
+    telemetry.counter("retries").inc(3)
+    telemetry.gauge("hbm_budget_bytes").set(1024.0, site="gang_fit")
+    telemetry.histogram("span_seconds").observe(0.5, name="x")
+    text = telemetry.prometheus_dump()
+    lines = text.splitlines()
+    assert "# TYPE tpuml_retries counter" in lines
+    assert "tpuml_retries 3" in lines
+    assert "# TYPE tpuml_hbm_budget_bytes gauge" in lines
+    assert 'tpuml_hbm_budget_bytes{site="gang_fit"} 1024' in lines
+    assert "# TYPE tpuml_span_seconds summary" in lines
+    assert 'tpuml_span_seconds{name="x",quantile="0.5"} 0.5' in lines
+    assert 'tpuml_span_seconds_count{name="x"} 1' in lines
+    assert 'tpuml_span_seconds_sum{name="x"} 0.5' in lines
+    # every sample line belongs to a HELP/TYPE-declared family
+    for line in lines:
+        if line and not line.startswith("#"):
+            assert line.startswith("tpuml_")
+
+    snap = telemetry.metrics_snapshot()
+    assert snap["retries"]["kind"] == "counter"
+    json.dumps(snap)  # snapshot must be JSON-clean
+
+
+def test_write_metrics_files(traced):
+    telemetry.counter("retries").inc()
+    paths = telemetry.write_metrics()
+    assert paths is not None
+    prom, js = paths
+    assert os.path.exists(prom) and os.path.exists(js)
+    with open(js) as f:
+        snap = json.load(f)
+    assert snap["retries"]["series"][0]["value"] == 1
+
+
+# --- counters shim ---------------------------------------------------------
+
+
+def test_counters_shim_roundtrip():
+    counters.bump("retries")
+    counters.bump("retries", 2)
+    counters.note("resumed_from", 7)
+    snap = counters.snapshot()
+    assert snap["retries"] == 3
+    assert snap["resumed_from"] == 7
+    assert counters.get("retries") == 3
+
+
+def test_delta_since_gauge_is_kind_driven():
+    """Regression: gauge semantics in delta_since must follow the
+    declared metric kind, not a hard-coded name match."""
+    counters.note("my_shim_gauge", 5)  # tpuml: ignore[TPU007]
+    counters.bump("my_shim_counter", 2)  # tpuml: ignore[TPU007]
+    base = counters.snapshot()
+    counters.note("my_shim_gauge", 9)  # tpuml: ignore[TPU007]
+    counters.bump("my_shim_counter", 3)  # tpuml: ignore[TPU007]
+    delta = counters.delta_since(base)
+    # gauge: last value, NOT 9 - 5; counter: the increment
+    assert delta["my_shim_gauge"] == 9
+    assert delta["my_shim_counter"] == 3
+    # unchanged metrics are omitted
+    assert counters.delta_since(counters.snapshot()) == {}
+    # shim-created metrics carry the right registry kinds
+    assert telemetry.metric_kind("my_shim_gauge") == "gauge"
+    assert telemetry.metric_kind("my_shim_counter") == "counter"
+
+
+# --- retrace watchdog ------------------------------------------------------
+
+
+def test_retrace_watchdog_detects_storm(traced, monkeypatch):
+    monkeypatch.setenv("TPUML_TELEMETRY_RETRACE_LIMIT", "2")
+    assert telemetry.install_retrace_watchdog()
+
+    # the package logger doesn't propagate to root (caplog can't see
+    # it) — attach a capturing handler directly
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = logging.getLogger("spark_rapids_ml_tpu")
+    handler = _Capture(level=logging.WARNING)
+    logger.addHandler(handler)
+    try:
+        with telemetry.span("retrace.victim"):
+            # a fresh jit per call: every invocation recompiles — the
+            # storm TPU003 exists to catch, forced deliberately
+            for n in range(1, 6):
+                # deliberate recompile storm: the watchdog under test
+                # tpuml: ignore[TPU003]
+                fn = jax.jit(lambda x: x * 2.0)
+                fn(jnp.ones((n, 3), jnp.float32)).block_until_ready()
+    finally:
+        logger.removeHandler(handler)
+
+    compiles = telemetry.counter("xla_compiles").value(
+        site="retrace.victim"
+    )
+    assert compiles is not None and compiles > 2
+    assert telemetry.counter("retrace_storms").value() == 1
+    warnings = [r for r in records if "retrace storm" in r.getMessage()]
+    assert len(warnings) == 1  # warn-once per site
+    assert "retrace.victim" in warnings[0].getMessage()
+
+
+# --- defaults-inert --------------------------------------------------------
+
+
+def test_defaults_inert_no_spans_no_files(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPUML_TRACE", raising=False)
+    assert not telemetry.enabled()
+    # the disabled span is a shared singleton: zero per-call allocation
+    assert telemetry.span("a") is telemetry.span("b", k=1)
+    with telemetry.span("a") as sp:
+        sp.set_attr(x=1)
+        sp.fence(None)
+    assert telemetry.span_stats() == {}
+    assert telemetry.flush() is None
+    assert telemetry.write_metrics() is None
+    assert os.listdir(tmp_path) == []
+
+
+def test_traced_fit_bit_identical_to_untraced(tmp_path, monkeypatch):
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(512, 4)).astype(np.float32)
+    df = DataFrame({"features": X})
+
+    def centers():
+        m = KMeans(k=3, maxIter=4, seed=0).setFeaturesCol("features").fit(df)
+        return m.cluster_centers_
+
+    monkeypatch.delenv("TPUML_TRACE", raising=False)
+    plain = centers()
+    monkeypatch.setenv("TPUML_TRACE", str(tmp_path))
+    traced = centers()
+    assert plain.tobytes() == traced.tobytes()

@@ -16,6 +16,7 @@ from . import (
     tpu004_nondeterminism,
     tpu005_static_args,
     tpu006_lane_align,
+    tpu007_metric_catalog,
 )
 from .core import (
     Finding,
@@ -39,7 +40,7 @@ FILE_RULES = (
     tpu005_static_args,
     tpu006_lane_align,
 )
-PROJECT_RULES = (tpu002_env_docs,)
+PROJECT_RULES = (tpu002_env_docs, tpu007_metric_catalog)
 ALL_RULES = FILE_RULES + PROJECT_RULES
 
 

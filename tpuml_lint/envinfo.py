@@ -16,6 +16,9 @@ from typing import Any, Optional
 ENVSPEC_RELPATH = os.path.join(
     "spark_rapids_ml_tpu", "runtime", "envspec.py"
 )
+METRICSPEC_RELPATH = os.path.join(
+    "spark_rapids_ml_tpu", "runtime", "metricspec.py"
+)
 
 _cache: dict = {}
 
@@ -32,12 +35,10 @@ def repo_root_from(start: str) -> Optional[str]:
         cur = nxt
 
 
-def load_envspec(repo_root: str) -> Any:
-    """The executed envspec module (cached per path)."""
-    path = os.path.join(repo_root, ENVSPEC_RELPATH)
+def _load_by_path(modname: str, path: str) -> Any:
     if path in _cache:
         return _cache[path]
-    spec = importlib.util.spec_from_file_location("_tpuml_lint_envspec", path)
+    spec = importlib.util.spec_from_file_location(modname, path)
     assert spec is not None and spec.loader is not None, path
     mod = importlib.util.module_from_spec(spec)
     # dataclass creation resolves the defining module through
@@ -46,3 +47,18 @@ def load_envspec(repo_root: str) -> Any:
     spec.loader.exec_module(mod)
     _cache[path] = mod
     return mod
+
+
+def load_envspec(repo_root: str) -> Any:
+    """The executed envspec module (cached per path)."""
+    return _load_by_path(
+        "_tpuml_lint_envspec", os.path.join(repo_root, ENVSPEC_RELPATH)
+    )
+
+
+def load_metricspec(repo_root: str) -> Any:
+    """The executed metric catalog (cached per path; stdlib-only like
+    envspec, so TPU007 works where jax is absent)."""
+    return _load_by_path(
+        "_tpuml_lint_metricspec", os.path.join(repo_root, METRICSPEC_RELPATH)
+    )

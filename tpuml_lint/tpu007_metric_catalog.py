@@ -1,0 +1,105 @@
+"""TPU007 — metric names must be declared in the metric catalog.
+
+Counter analog of TPU002: every metric name the code passes to the
+telemetry registry (``telemetry.counter/gauge/histogram``) or to the
+legacy counters shim (``counters.bump/note/get``) must be declared in
+``runtime/metricspec.py``.  An undeclared name is either a typo (the
+increments land in a metric nobody exports a description for) or a new
+metric missing its catalog entry — both silently corrupt dashboards
+built on the Prometheus dump.
+
+Two checks per call site with a literal first argument:
+
+1. the name is declared in the catalog;
+2. the call's implied kind matches the declared kind (``bump`` and
+   ``counter`` imply a counter, ``note`` and ``gauge`` a gauge,
+   ``histogram`` a histogram) — the runtime raises on mismatch, this
+   catches it before anything runs.
+
+Dynamic (non-literal) names are out of scope, as with TPU002.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, List, Optional, Tuple
+
+from .core import Finding, SourceFile, dotted_name, str_const
+from .envinfo import METRICSPEC_RELPATH, load_metricspec
+
+CODE = "TPU007"
+NAME = "metric-catalog"
+
+# leaf function -> metric kind it implies (None: any kind, read-only)
+_COUNTERS_FNS = {"bump": "counter", "note": "gauge", "get": None}
+_TELEMETRY_FNS = {
+    "counter": "counter",
+    "gauge": "gauge",
+    "histogram": "histogram",
+}
+_TELEMETRY_RELPATH = "spark_rapids_ml_tpu/runtime/telemetry.py"
+
+
+def _used_names(
+    sf: SourceFile,
+) -> Iterator[Tuple[str, Optional[str], ast.AST]]:
+    """(metric name, implied kind, node) for every literal-name call."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = dotted_name(node.func)
+        if fn is None:
+            continue
+        leaf = fn.rsplit(".", 1)[-1]
+        if leaf in _COUNTERS_FNS and "counters" in fn:
+            kind = _COUNTERS_FNS[leaf]
+        elif leaf in _TELEMETRY_FNS and (
+            "telemetry" in fn
+            # inside telemetry.py itself the registry functions are
+            # bare names — still catalog-bound
+            or (fn == leaf and sf.path == _TELEMETRY_RELPATH)
+        ):
+            kind = _TELEMETRY_FNS[leaf]
+        else:
+            continue
+        name = str_const(node.args[0]) if node.args else None
+        if name:
+            yield name, kind, node
+
+
+def check_project(files: List[SourceFile], repo_root: str) -> Iterator[Finding]:
+    spec_relpath = METRICSPEC_RELPATH.replace(os.sep, "/")
+    try:
+        metricspec = load_metricspec(repo_root)
+    except Exception as e:  # catalog must at least load
+        yield Finding(
+            rule=CODE,
+            path=spec_relpath,
+            line=1,
+            col=1,
+            message=f"could not load the metric catalog: {e}",
+        )
+        return
+    catalog = metricspec.SPEC
+
+    for sf in files:
+        if sf.path == spec_relpath:
+            continue
+        for name, kind, node in _used_names(sf):
+            declared = catalog.get(name)
+            if declared is None:
+                yield sf.finding(
+                    CODE, node,
+                    f"metric {name!r} is used in code but not declared in "
+                    f"{spec_relpath}",
+                    f"add a MetricSpec({name!r}, ...) entry to the catalog",
+                )
+            elif kind is not None and declared.kind != kind:
+                yield sf.finding(
+                    CODE, node,
+                    f"metric {name!r} is declared as a {declared.kind} in "
+                    f"{spec_relpath} but used here as a {kind}",
+                    "use the matching registry accessor or fix the "
+                    "catalog kind",
+                )
