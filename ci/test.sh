@@ -504,7 +504,7 @@ assert len(traces) == 1, os.listdir(tdir)
 with open(os.path.join(tdir, traces[0])) as f:
     doc = json.load(f)  # Perfetto accepts exactly this JSON object form
 events = doc["traceEvents"]
-assert all(e["ph"] in ("X", "M") for e in events), events[:3]
+assert all(e["ph"] in ("X", "M", "i") for e in events), events[:3]
 names = {e["name"] for e in events if e["ph"] == "X"}
 assert {"KMeans.fit", "stream.ingest", "stream.decode",
         "stream.fold", "kmeans.lloyd_pass"} <= names, sorted(names)
@@ -526,8 +526,19 @@ assert len(logs) == 1, os.listdir(tdir)
 with open(os.path.join(tdir, logs[0])) as f:
     for line in f:
         json.loads(line)
+# roofline attribution: compiled sites must carry measured cost-model
+# numbers (XLA cost_analysis, not hand formulas) with an MFU + verdict
+roofed = {
+    site: st for site, st in stats.items()
+    if "flops_total" in st and "mfu" in st
+}
+assert roofed, sorted(stats)
+for site, st in roofed.items():
+    assert st["flops_total"] > 0 and st["mfu"] > 0, (site, st)
+    assert st["bound"] in ("compute", "memory"), (site, st)
 print(f"telemetry trace smoke OK: {len(names)} span sites, "
-      f"coverage {covered / root_ev['dur']:.3f}")
+      f"coverage {covered / root_ev['dur']:.3f}, "
+      f"{len(roofed)} roofline-attributed sites")
 EOF
 
 # bench artifact with tracing on: every entry carries span provenance
@@ -548,6 +559,10 @@ assert "device_seconds" in entry, entry
 assert entry["spans"] and all(v >= 1 for v in entry["spans"].values()), entry
 assert "suffstats.pass" in entry["spans"], entry
 assert "stream.ingest" in entry["spans"], entry
+# measured roofline MFU: cost-analysis FLOPs replace the hand formula,
+# which survives as the labeled mfu_derived fallback
+assert entry.get("flops_measured", 0) > 0, entry
+assert "mfu_derived" in entry and entry["mfu"] > 0, entry
 files = os.listdir("/tmp/tpuml_trace_bench")
 assert any(f.startswith("metrics-") and f.endswith(".prom") for f in files), files
 assert any(f.startswith("metrics-") and f.endswith(".json") for f in files), files
@@ -592,6 +607,117 @@ finally:
 assert np.asarray(plain.cluster_centers_).tobytes() == \
     np.asarray(traced.cluster_centers_).tobytes()
 print("telemetry defaults-inert smoke OK")
+EOF
+
+echo "== bench-regress gate smoke =="
+# Synthetic trajectory: a fabricated prior run plus a current run with
+# one entry perturbed past the ±15% threshold must exit nonzero naming
+# the offender; the unperturbed pair must pass.
+python - <<'EOF'
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+def wrapper(n, entries):
+    tail = json.dumps(
+        {"metric": "pca_fit_throughput", "value": 1.0, **entries}
+    )
+    return {"n": n, "cmd": "python bench.py", "rc": 0,
+            "tail": "log noise\n" + tail, "parsed": None}
+
+def entry(sec, vs, mfu):
+    return {"samples_per_sec_per_chip": 1e6, "fit_seconds": sec,
+            "vs_baseline": vs, "mfu": mfu}
+
+with tempfile.TemporaryDirectory() as td:
+    base = {"pca": entry(1.0, 2.0, 0.2), "kmeans": entry(2.0, 3.0, 0.3)}
+    with open(os.path.join(td, "BENCH_r01.json"), "w") as f:
+        json.dump(wrapper(1, base), f)
+    ok = {"pca": entry(1.05, 1.95, 0.21), "kmeans": entry(1.9, 3.1, 0.29)}
+    with open(os.path.join(td, "BENCH_r02.json"), "w") as f:
+        json.dump(wrapper(2, ok), f)
+    r = subprocess.run(
+        [sys.executable, "scripts/bench_regress.py",
+         "--trajectory", os.path.join(td, "BENCH_r*.json")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout)
+
+    bad = dict(ok, kmeans=entry(2.5, 3.1, 0.29))  # +31% seconds
+    with open(os.path.join(td, "BENCH_r03.json"), "w") as f:
+        json.dump(wrapper(3, bad), f)
+    r = subprocess.run(
+        [sys.executable, "scripts/bench_regress.py",
+         "--trajectory", os.path.join(td, "BENCH_r*.json")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode != 0, (r.returncode, r.stdout)
+    assert "kmeans.fit_seconds" in r.stdout, r.stdout
+print("bench-regress synthetic gate OK")
+EOF
+# the real recorded trajectory must be clean (newest vs prior run)
+python scripts/bench_regress.py
+
+echo "== multi-host trace merge smoke =="
+# Two simulated ranks (the launcher's TPUML_PROC_ID layout) trace into
+# one shared directory; merge_traces must fold the shards into a single
+# Perfetto file with both host tracks and summed counters.
+rm -rf /tmp/tpuml_merge_smoke
+for RANK in 0 1; do
+    TPUML_TRACE=/tmp/tpuml_merge_smoke TPUML_PROC_ID=$RANK \
+    TPUML_NUM_PROCS=2 JAX_PLATFORMS=cpu python - <<'EOF'
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.runtime import telemetry
+
+@jax.jit
+def f(x):
+    return (x @ x.T).sum()
+
+with telemetry.span("merge.fit"):
+    f(jnp.ones((32, 32), jnp.float32)).block_until_ready()
+telemetry.flush()
+telemetry.write_metrics()
+EOF
+done
+python scripts/merge_traces.py /tmp/tpuml_merge_smoke
+python - <<'EOF'
+import json
+import os
+
+tdir = "/tmp/tpuml_merge_smoke"
+shards = [f for f in os.listdir(tdir)
+          if f.startswith("trace-r") and f.endswith(".json")]
+assert len(shards) == 2, shards
+with open(os.path.join(tdir, "merged.json")) as f:
+    doc = json.load(f)
+assert doc["metadata"]["hosts"] == [0, 1], doc["metadata"]
+tracks = {
+    e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+    if e.get("ph") == "M" and e.get("name") == "process_name"
+}
+assert set(tracks) == {0, 1}, tracks
+assert all(name.startswith("host") for name in tracks.values()), tracks
+spans = [e for e in doc["traceEvents"]
+         if e.get("ph") == "X" and e["name"] == "merge.fit"]
+assert {e["pid"] for e in spans} == {0, 1}, spans
+# aggregated counters stay consistent: merged spans_recorded == the sum
+# over the per-rank snapshots == the span events in the merged trace
+snaps = []
+for fn in os.listdir(tdir):
+    if fn.startswith("metrics-r") and fn.endswith(".json"):
+        with open(os.path.join(tdir, fn)) as f:
+            snaps.append(json.load(f))
+per_rank = sum(s["spans_recorded"]["series"][0]["value"] for s in snaps)
+with open(os.path.join(tdir, "merged-metrics.json")) as f:
+    merged = json.load(f)
+total = merged["spans_recorded"]["series"][0]["value"]
+assert total == per_rank == len(spans) == 2, (total, per_rank, len(spans))
+print(f"merge_traces smoke OK: hosts {sorted(tracks)}, "
+      f"{total} spans across ranks")
 EOF
 
 echo "CI OK"

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""CI gate: fail when the newest bench run regresses against the prior one.
+
+Reads the ``BENCH_r*.json`` trajectory (driver wrapper files holding the
+bench stdout/stderr tail) plus optionally a current raw ``bench.py``
+output line, extracts the per-entry metric dicts, and compares the
+newest run against the most recent prior run that produced entries:
+
+- ``fit_seconds``   — regression when it grows past ``+threshold``
+- ``vs_baseline``   — regression when it shrinks past ``-threshold``
+- ``mfu``           — regression when it shrinks past ``-threshold``
+
+Rules that keep the gate honest on real trajectories:
+
+- ``tunnel_bound`` entries (host->device ingest over the remote tunnel)
+  measure the link, not the chip — their run-to-run swings are network
+  weather, so they are reported but never gate.
+- Zero/missing baselines (mfu 0.0 where no cost model applies,
+  vs_baseline 0.0 from an unreachable-baseline run) cannot express a
+  ratio — skipped, not failed.
+- Entries present only in the current run are new coverage, not a
+  regression.
+
+Exit status: 0 when nothing regressed, 1 with a readable table naming
+every offending entry/field otherwise. Deliberately stdlib-only (runs
+in CI before any jax import).
+
+Usage:
+    python scripts/bench_regress.py                       # newest vs prior
+    python scripts/bench_regress.py --current out.json    # gate a fresh run
+    python scripts/bench_regress.py --threshold 0.20
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# per-entry dicts inside a (possibly truncated) bench stdout tail:
+# '"pca": {...}' — entries never nest, so a flat brace group is enough
+_ENTRY_RE = re.compile(r'"(\w+)":\s*(\{[^{}]*\})')
+
+Entries = Dict[str, Dict[str, Any]]
+
+
+def _entries_from_text(text: str) -> Entries:
+    """Per-entry metric dicts from raw bench output (or a tail of it).
+
+    The full metric line may be truncated at the front by the driver's
+    tail capture, so this scans for every ``"name": {...}`` group and
+    keeps the ones that look like bench entries (fit_seconds +
+    samples_per_sec_per_chip). Later occurrences win, matching "last
+    line is the real emit" semantics.
+    """
+    out: Entries = {}
+    for m in _ENTRY_RE.finditer(text):
+        try:
+            v = json.loads(m.group(2))
+        except ValueError:
+            continue
+        if (
+            isinstance(v, dict)
+            and "fit_seconds" in v
+            and "samples_per_sec_per_chip" in v
+        ):
+            out[m.group(1)] = v
+    return out
+
+
+def parse_bench_file(path: str) -> Entries:
+    """Entries from either a driver wrapper (``{"n", "cmd", "rc",
+    "tail", ...}``) or a raw ``bench.py`` output file; empty dict when
+    the run produced none (crashed before the emit)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return _entries_from_text(text)
+    if isinstance(doc, dict) and "tail" in doc:
+        return _entries_from_text(doc.get("tail") or "")
+    if isinstance(doc, dict):
+        return {
+            k: v
+            for k, v in doc.items()
+            if isinstance(v, dict) and "fit_seconds" in v
+        }
+    return {}
+
+
+def _run_key(path: str) -> Tuple[int, str]:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return (int(m.group(1)) if m else -1, path)
+
+
+def trajectory_files(pattern: str) -> List[str]:
+    return sorted(glob.glob(pattern), key=_run_key)
+
+
+def compare(
+    base: Entries,
+    cur: Entries,
+    threshold: float,
+) -> Tuple[List[Tuple[str, str, float, float, float, str]], bool]:
+    """Per-entry/per-field comparison rows and the overall verdict.
+
+    Rows are ``(entry, field, base, cur, delta_fraction, status)`` with
+    status one of ``ok`` / ``REGRESS`` / ``skip:<reason>``; the bool is
+    True when any row regressed.
+    """
+    fields = (
+        ("fit_seconds", +1),  # +1: larger is worse
+        ("vs_baseline", -1),  # -1: smaller is worse
+        ("mfu", -1),
+    )
+    rows: List[Tuple[str, str, float, float, float, str]] = []
+    failed = False
+    for name in sorted(set(base) | set(cur)):
+        b, c = base.get(name), cur.get(name)
+        if c is None:
+            rows.append((name, "-", 0.0, 0.0, 0.0, "skip:entry-dropped"))
+            continue
+        if b is None:
+            rows.append((name, "-", 0.0, 0.0, 0.0, "skip:new-entry"))
+            continue
+        tunnel = b.get("tunnel_bound") or c.get("tunnel_bound")
+        for field, worse_sign in fields:
+            bv, cv = b.get(field), c.get(field)
+            if bv is None or cv is None:
+                continue
+            bv, cv = float(bv), float(cv)
+            if bv <= 0:
+                rows.append((name, field, bv, cv, 0.0, "skip:zero-baseline"))
+                continue
+            delta = (cv - bv) / bv
+            if tunnel:
+                rows.append((name, field, bv, cv, delta, "skip:tunnel-bound"))
+                continue
+            regress = worse_sign * delta > threshold
+            rows.append(
+                (name, field, bv, cv, delta, "REGRESS" if regress else "ok")
+            )
+            failed = failed or regress
+    return rows, failed
+
+
+def format_table(
+    rows: List[Tuple[str, str, float, float, float, str]],
+) -> str:
+    header = ("entry", "field", "base", "current", "delta", "status")
+    table = [header] + [
+        (name, field, f"{bv:.4g}", f"{cv:.4g}", f"{delta:+.1%}", status)
+        for name, field, bv, cv, delta, status in rows
+    ]
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    lines = []
+    for i, r in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--trajectory",
+        default=os.path.join(REPO_ROOT, "BENCH_r*.json"),
+        help="glob of prior-run files, ordered by _r<N> (default: repo"
+             " BENCH_r*.json)",
+    )
+    ap.add_argument(
+        "--current",
+        default=None,
+        help="current-run file (wrapper or raw bench output); default:"
+             " the newest trajectory file gates against the one before it",
+    )
+    ap.add_argument(
+        "--threshold", type=float, default=0.15,
+        help="noise threshold as a fraction (default 0.15 = ±15%%)",
+    )
+    args = ap.parse_args(argv)
+
+    runs: List[Tuple[str, Entries]] = []
+    for path in trajectory_files(args.trajectory):
+        entries = parse_bench_file(path)
+        if entries:
+            runs.append((path, entries))
+        else:
+            print(f"bench_regress: {path}: no entries (skipped)")
+    if args.current is not None:
+        cur_path, cur = args.current, parse_bench_file(args.current)
+        if not cur:
+            print(f"bench_regress: {cur_path}: no entries in current run")
+            return 1
+    else:
+        if len(runs) < 2:
+            print(
+                "bench_regress: need >= 2 parseable runs in the trajectory "
+                f"(have {len(runs)}) — nothing to gate"
+            )
+            return 0
+        cur_path, cur = runs.pop()
+    if not runs:
+        print("bench_regress: no prior run to gate against — pass")
+        return 0
+    base_path, base = runs[-1]
+
+    rows, failed = compare(base, cur, args.threshold)
+    print(
+        f"bench_regress: {os.path.basename(cur_path)} vs "
+        f"{os.path.basename(base_path)} (threshold ±{args.threshold:.0%})"
+    )
+    print(format_table(rows))
+    if failed:
+        bad = sorted(
+            {f"{name}.{field}" for name, field, *_rest, st in rows
+             if st == "REGRESS"}
+        )
+        print(f"bench_regress: REGRESSION in {', '.join(bad)}")
+        return 1
+    print("bench_regress: no regression")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

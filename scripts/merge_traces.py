@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Merge per-host telemetry shards into one cluster-wide Perfetto trace.
+
+Every process pointed at a shared ``TPUML_TRACE`` directory writes
+rank-tagged shards (``trace-r<rank>-<pid>.json``,
+``metrics-r<rank>-<pid>.json`` — see ``runtime/telemetry.py``). This
+script folds them:
+
+- **Traces** — one Chrome-trace/Perfetto JSON whose events keep their
+  original timestamps but get a per-host ``pid`` remap plus a
+  ``process_name`` metadata row (``host0 (pid 1234)``, ...), so the
+  Perfetto UI shows one track group per host. Clock domains are
+  per-host ``perf_counter`` origins; cross-host alignment is cosmetic
+  (all shards start at ts 0), which is exactly what a per-host track
+  layout wants.
+- **Metrics** — kind-aware fold of the JSON snapshots: counters SUM,
+  gauges MAX, histogram count/sum SUM with min/max merged and per-rank
+  ring quantiles dropped (they cannot be merged exactly). These are the
+  same rules as ``telemetry.merge_metric_snapshots``; the
+  ``dryrun_multichip`` harness parity-checks the two implementations.
+
+Deliberately stdlib-only and importable without jax or the package
+(``dryrun_multichip`` and the tests load it by file path).
+
+Usage:
+    python scripts/merge_traces.py <trace_dir> [-o merged.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_SHARD_RE = re.compile(r"^trace-r(\d+)-(\d+)\.json$")
+_METRICS_RE = re.compile(r"^metrics-r(\d+)-(\d+)\.json$")
+
+
+def find_shards(trace_dir: str) -> List[Tuple[int, str]]:
+    """``[(rank, path), ...]`` for every rank-tagged trace shard, sorted
+    by rank then filename (stable when one rank wrote several pids)."""
+    out: List[Tuple[int, str]] = []
+    for path in sorted(glob.glob(os.path.join(trace_dir, "trace-*.json"))):
+        m = _SHARD_RE.match(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    out.sort(key=lambda rp: (rp[0], rp[1]))
+    return out
+
+
+def find_metric_shards(trace_dir: str) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    for path in sorted(glob.glob(os.path.join(trace_dir, "metrics-*.json"))):
+        m = _METRICS_RE.match(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    out.sort(key=lambda rp: (rp[0], rp[1]))
+    return out
+
+
+def merge_trace_docs(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold trace documents (each a ``{"traceEvents": [...], "metadata":
+    {"process_index": r}}`` shard) into one, remapping every event's
+    ``pid`` to the shard's process index so hosts render as separate
+    track groups. Shard-local ``process_name`` metadata is replaced by
+    a per-host row naming the rank and original pid."""
+    events: List[Dict[str, Any]] = []
+    hosts: List[int] = []
+    for doc in docs:
+        rank = int(doc.get("metadata", {}).get("process_index", len(hosts)))
+        hosts.append(rank)
+        orig_pid: Optional[int] = None
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                orig_pid = ev.get("pid")
+                continue  # replaced by the per-host row below
+            ev = dict(ev)
+            if orig_pid is None:
+                orig_pid = ev.get("pid")
+            ev["pid"] = rank
+            events.append(ev)
+        events.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": rank,
+                "tid": 0,
+                "args": {"name": f"host{rank} (pid {orig_pid})"},
+            }
+        )
+    # metadata rows first, then events in timestamp order — Perfetto
+    # accepts any order but deterministic output diffs cleanly
+    events.sort(
+        key=lambda e: (e.get("ph") != "M", e.get("pid", 0), e.get("ts", 0))
+    )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {"hosts": sorted(set(hosts)), "merged": True},
+    }
+
+
+def merge_metric_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Kind-aware fold of ``telemetry.metrics_snapshot`` dicts: counters
+    SUM, gauges MAX, histogram count/sum SUM + min/max merged, ring
+    quantiles dropped. Must stay rule-for-rule identical to
+    ``telemetry.merge_metric_snapshots`` (parity-checked in
+    ``dryrun_multichip``)."""
+    merged: Dict[str, Any] = {}
+    for snap in snaps:
+        for name, entry in snap.items():
+            kind = entry.get("kind", "counter")
+            slot = merged.setdefault(name, {"kind": kind, "series": {}})
+            for series in entry.get("series", []):
+                labels = series.get("labels", {})
+                key = tuple(sorted(labels.items()))
+                have = slot["series"].get(key)
+                if kind == "histogram":
+                    if have is None:
+                        slot["series"][key] = {
+                            "labels": labels,
+                            "count": series.get("count", 0),
+                            "sum": series.get("sum", 0.0),
+                            "min": series.get("min"),
+                            "max": series.get("max"),
+                        }
+                    else:
+                        have["count"] += series.get("count", 0)
+                        have["sum"] += series.get("sum", 0.0)
+                        for fld, pick in (("min", min), ("max", max)):
+                            v = series.get(fld)
+                            if v is not None:
+                                have[fld] = (
+                                    v if have[fld] is None
+                                    else pick(have[fld], v)
+                                )
+                else:
+                    value = series.get("value", 0)
+                    if have is None:
+                        slot["series"][key] = {
+                            "labels": labels, "value": value,
+                        }
+                    elif kind == "gauge":
+                        have["value"] = max(have["value"], value)
+                    else:
+                        have["value"] += value
+    return {
+        name: {
+            "kind": entry["kind"],
+            "series": [entry["series"][k] for k in sorted(entry["series"])],
+        }
+        for name, entry in sorted(merged.items())
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_dir", help="TPUML_TRACE directory holding shards")
+    ap.add_argument(
+        "-o", "--out", default=None,
+        help="merged trace path (default: <trace_dir>/merged.json)",
+    )
+    ap.add_argument(
+        "--metrics-out", default=None,
+        help="merged metrics path (default: <trace_dir>/merged-metrics.json"
+             " when metric shards exist)",
+    )
+    args = ap.parse_args(argv)
+
+    shards = find_shards(args.trace_dir)
+    if not shards:
+        print(
+            f"merge_traces: no trace-r*-*.json shards in {args.trace_dir}",
+            file=sys.stderr,
+        )
+        return 1
+    docs = []
+    for _rank, path in shards:
+        with open(path) as f:
+            docs.append(json.load(f))
+    merged = merge_trace_docs(docs)
+    out = args.out or os.path.join(args.trace_dir, "merged.json")
+    with open(out, "w") as f:
+        json.dump(merged, f)
+    n_ev = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
+    print(
+        f"merge_traces: {len(shards)} shard(s), hosts "
+        f"{merged['metadata']['hosts']}, {n_ev} events -> {out}"
+    )
+
+    msnaps = find_metric_shards(args.trace_dir)
+    if msnaps:
+        snaps = []
+        for _rank, path in msnaps:
+            with open(path) as f:
+                snaps.append(json.load(f))
+        mout = args.metrics_out or os.path.join(
+            args.trace_dir, "merged-metrics.json"
+        )
+        with open(mout, "w") as f:
+            json.dump(merge_metric_snapshots(snaps), f, indent=2, sort_keys=True)
+        print(f"merge_traces: {len(msnaps)} metric shard(s) -> {mout}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

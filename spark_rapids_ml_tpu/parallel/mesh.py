@@ -384,6 +384,28 @@ def allreduce_sum_host(*arrays: Any) -> Tuple[np.ndarray, ...]:
     return tuple(out)
 
 
+def allgather_host_blobs(blob: bytes) -> List[bytes]:
+    """Gather one opaque byte blob per process, rank-ordered.
+
+    The metadata-exchange primitive behind
+    ``telemetry.aggregate_metrics``: each rank JSON-encodes its metric
+    snapshot, the blobs ride a padded uint8 allgather (counts first, so
+    uneven payloads trim exactly), and every rank gets the full list to
+    merge locally. Single-process: ``[blob]``.
+    """
+    a = np.frombuffer(blob, np.uint8)
+    if jax.process_count() <= 1:
+        return [blob]
+    counts = allgather_host(np.asarray([a.shape[0]])).ravel().astype(int)
+    maxc = max(int(counts.max()), 1)
+    padded = np.zeros((maxc,), np.uint8)
+    padded[: a.shape[0]] = a
+    gathered = allgather_host(padded)
+    return [
+        gathered[p][: counts[p]].tobytes() for p in range(len(counts))
+    ]
+
+
 def allgather_ragged_rows(a: np.ndarray) -> np.ndarray:
     """Concatenate every process's rows in rank order (uneven partitions
     padded through a host allgather, then trimmed) — the multi-host analog

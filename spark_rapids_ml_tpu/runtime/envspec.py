@@ -445,10 +445,13 @@ SPEC: Dict[str, EnvVar] = _registry(
     EnvVar(
         "TPUML_TRACE", "path", None,
         "Directory for structured telemetry output: a Chrome-trace/"
-        "Perfetto JSON (`trace-<pid>.json`), a JSONL span event log "
-        "(`events-<pid>.jsonl`), and Prometheus/JSON metric dumps on "
-        "request. Unset (the default) keeps the whole telemetry path "
-        "inert: no files, no span allocation, outputs bit-identical.",
+        "Perfetto JSON shard (`trace-r<rank>-<pid>.json`), a JSONL span "
+        "event log (`events-r<rank>-<pid>.jsonl`), and Prometheus/JSON "
+        "metric dumps on request — process-index-tagged so multi-host "
+        "runs sharing one directory stay disjoint "
+        "(`scripts/merge_traces.py` merges the shards). Unset (the "
+        "default) keeps the whole telemetry path inert: no files, no "
+        "span allocation, outputs bit-identical.",
         category="observability",
         also_documented_in=("docs/observability.md",),
     ),
@@ -478,6 +481,25 @@ SPEC: Dict[str, EnvVar] = _registry(
         "deterministic last-N window feeding the exported quantiles); "
         "running count/sum/min/max are exact regardless of the bound.",
         minimum=1, category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
+    EnvVar(
+        "TPUML_PEAK_FLOPS", "float", None,
+        "Per-chip peak FLOP/s used as the roofline MFU denominator "
+        "(`runtime/roofline.py`). Unset = the built-in per-device-kind "
+        "bf16 table (same figures as bench.py). Set it when the "
+        "workload runs a different dtype mix or the device kind is "
+        "missing from the table. Only read when `TPUML_TRACE` is set.",
+        exclusive_minimum=0, category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
+    EnvVar(
+        "TPUML_PEAK_HBM_GBPS", "float", None,
+        "Per-chip peak HBM bandwidth in GB/s for the roofline "
+        "memory-bound verdict (`runtime/roofline.py`). Unset = the "
+        "built-in per-device-kind table. Only read when `TPUML_TRACE` "
+        "is set.",
+        exclusive_minimum=0, category="observability",
         also_documented_in=("docs/observability.md",),
     ),
 )

@@ -127,6 +127,15 @@ class FaultInjector:
             action = self._pending.get(site, {}).pop(n, None)
         if action is None:
             return
+        try:
+            from . import telemetry
+
+            telemetry.counter("fault_injections").inc(kind=action)
+            telemetry.add_span_event(
+                "fault_injected", site=site, index=n, action=action
+            )
+        except Exception:  # pragma: no cover - tracing must not mask the fault
+            pass
         if action == "preempt":
             raise SimulatedPreemption(f"injected preemption at {site}[{n}]")
         if action == "oom":

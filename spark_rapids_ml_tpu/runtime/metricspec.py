@@ -149,6 +149,39 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "as reported by `Device.memory_stats()` (absent on backends "
         "that report none).",
     ),
+    # --- roofline attribution (PR 10) -------------------------------------
+    MetricSpec(
+        "span_flops_total", "counter",
+        "XLA cost-model FLOPs attributed to each span site, labeled by "
+        "span name: the sum over distinct programs compiled while the "
+        "site was innermost, times the site's call count "
+        "(`runtime/roofline.py`).",
+    ),
+    MetricSpec(
+        "span_bytes_total", "counter",
+        "XLA cost-model bytes accessed attributed to each span site, "
+        "labeled like `span_flops_total`.",
+    ),
+    MetricSpec(
+        "span_mfu", "histogram",
+        "Model FLOP/s utilization of each roofline-attributed span "
+        "call: cost-model FLOPs over fenced device seconds times the "
+        "per-chip peak (`TPUML_PEAK_FLOPS` or the built-in device-kind "
+        "table) times device count.",
+    ),
+    MetricSpec(
+        "span_achieved_gbps", "histogram",
+        "Achieved HBM GB/s of each roofline-attributed span call "
+        "(cost-model bytes over fenced device seconds), compared "
+        "against `TPUML_PEAK_HBM_GBPS` for the compute/memory-bound "
+        "verdict.",
+    ),
+    MetricSpec(
+        "fault_injections", "counter",
+        "Faults raised by the `runtime/faults.py` injection hooks "
+        "(`TPUML_FAULT_*`), labeled by fault kind; paired with a "
+        "span event so postmortem traces show the injection inline.",
+    ),
 )
 
 

@@ -104,6 +104,17 @@ def with_retries(
             if attempt >= budget:
                 break
             bump("retries")
+            try:
+                from . import telemetry
+
+                telemetry.add_span_event(
+                    "retry",
+                    what=what,
+                    attempt=attempt + 1,
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
+            except Exception:  # pragma: no cover - tracing must not break retry
+                pass
             delay = delays[attempt]
             logger.warning(
                 "%s failed (attempt %d/%d): %s — retrying in %.0f ms",

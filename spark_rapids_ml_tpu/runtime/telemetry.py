@@ -20,9 +20,19 @@ threads, streaming stages, and device dispatches:
   active span (``jax.monitoring`` events) and warns once per site past
   ``TPUML_TELEMETRY_RETRACE_LIMIT`` — the runtime enforcement of lint
   rule TPU003.
+- **Roofline attribution** (:mod:`runtime.roofline`) — the same compile
+  listener hands each program's XLA ``cost_analysis()`` to the
+  innermost span site, so closing spans carry measured ``flops_total``
+  / ``bytes_total`` / ``mfu`` attributes and :func:`span_stats` answers
+  compute-bound vs memory-bound per stage.
 - **HBM accounting** — :func:`record_hbm_estimate` files each budget
   resolver's peak estimate (gang fit, tree batch, stream staging) as a
   labeled gauge next to the backend's live memory stats.
+- **Multi-host** — every output file is tagged with the process index
+  (``trace-r00-<pid>.json``), :func:`aggregate_metrics` merges metric
+  snapshots across hosts through the ``parallel/mesh.py`` collectives,
+  and ``scripts/merge_traces.py`` folds per-host shards into one
+  Perfetto trace with per-host tracks.
 
 Defaults are inert: with ``TPUML_TRACE`` unset, :func:`span` returns a
 shared no-op, nothing is recorded or written, and outputs are
@@ -56,10 +66,13 @@ __all__ = [
     "gauge",
     "histogram",
     "metric_kind",
+    "add_span_event",
     "span_stats",
     "flush",
     "prometheus_dump",
     "metrics_snapshot",
+    "merge_metric_snapshots",
+    "aggregate_metrics",
     "write_metrics",
     "record_hbm_estimate",
     "install_retrace_watchdog",
@@ -85,11 +98,27 @@ def _device_time() -> bool:
     return bool(envspec.get("TPUML_TELEMETRY_DEVICE_TIME"))
 
 
+def _process_index() -> int:
+    """This process's rank for the multi-host trace-shard layout.
+
+    Read from the launcher-provided ``TPUML_PROC_ID`` (the same source
+    ``parallel/context.py`` initializes the jax world from) rather than
+    ``jax.process_index()`` — resolving a filename must never initialize
+    a backend (flush runs from atexit and crash paths).
+    """
+    try:
+        return int(envspec.get("TPUML_PROC_ID"))
+    except Exception:
+        return 0
+
+
 # --------------------------------------------------------------------------
 # typed metrics registry
 # --------------------------------------------------------------------------
 
-_MLOCK = threading.Lock()
+# RLock: _Hist.quantile locks its ring copy, and the exporters call it
+# while already holding the registry lock
+_MLOCK = threading.RLock()
 _METRICS: Dict[str, "_Metric"] = {}
 
 
@@ -116,10 +145,18 @@ class _Hist:
         self.ring.append(value)
 
     def quantile(self, q: float) -> Optional[float]:
-        if not self.ring:
+        """Deterministic ring quantile: None on an empty reservoir, the
+        lone observation for a single sample (any ``q``), exact min/max
+        at q=0/1, and out-of-range ``q`` clamped — never an IndexError
+        or interpolated garbage. The ring copy happens under the metric
+        lock: a sort racing a concurrent ``observe`` would otherwise
+        raise "deque mutated during iteration"."""
+        with _MLOCK:
+            ordered = sorted(self.ring)
+        if not ordered:
             return None
-        ordered = sorted(self.ring)
-        return ordered[int(q * (len(ordered) - 1))]
+        q = min(1.0, max(0.0, q))
+        return ordered[min(len(ordered) - 1, int(q * (len(ordered) - 1)))]
 
 
 class _Metric:
@@ -356,7 +393,7 @@ def span(name: str, **attrs: Any) -> Any:
     """
     if not enabled():
         return _NULL
-    _ensure_watchdog()
+    _ensure_hooks()
     return _Span(name, attrs)
 
 
@@ -401,6 +438,14 @@ def bind_context(fn: Any) -> Any:
 def _record(s: _Span, dur: float) -> None:
     global _EPOCH, _ATEXIT_REGISTERED
     root_closed = s.parent_id is None
+    roofline = _ROOFLINE
+    if roofline is not None:
+        try:  # roofline attribution must never fail a span close
+            extra = roofline.annotate(s.name, s.device_s, dur)
+            if extra:
+                s.attrs.update(extra)
+        except Exception:
+            pass
     with _RLOCK:
         if _EPOCH is None:
             _EPOCH = s._t0
@@ -448,19 +493,88 @@ def _record(s: _Span, dur: float) -> None:
         st[2] += s.device_s
         if not _ATEXIT_REGISTERED:
             _ATEXIT_REGISTERED = True
-            atexit.register(flush)
+            atexit.register(_atexit_flush)
     counter("spans_recorded").inc()
     histogram("span_seconds").observe(dur, name=s.name)
     if root_closed:
         flush()
 
 
+def _atexit_flush() -> None:
+    """Crash-path persistence: at interpreter exit (including an
+    unhandled exception unwinding mid-fit) write whatever the buffers
+    hold — the trace shard, pending JSONL lines, AND a metric snapshot,
+    so a postmortem has both the timeline and the counters."""
+    try:
+        flush()
+    except Exception:
+        pass
+    try:
+        write_metrics()
+    except Exception:
+        pass
+
+
+def add_span_event(name: str, **attrs: Any) -> None:
+    """Record an instant event (a point in time, not an interval) under
+    the innermost active span — retries, injected faults, and similar
+    occurrences show up inline on the trace timeline for postmortems.
+    No-op while tracing is disabled."""
+    if not enabled():
+        return
+    global _EPOCH, _ATEXIT_REGISTERED
+    cur = _CURRENT.get()
+    t = threading.current_thread()
+    tid = t.ident or 0
+    now = time.perf_counter()
+    with _RLOCK:
+        if _EPOCH is None:
+            _EPOCH = now
+        ts_us = (now - _EPOCH) * 1e6
+        args: Dict[str, Any] = dict(attrs)
+        if cur is not None:
+            args["span_id"] = cur.span_id
+        _EVENTS.append(
+            {
+                "name": name,
+                "ph": "i",
+                "s": "t",  # thread-scoped instant marker
+                "ts": round(ts_us, 3),
+                "pid": os.getpid(),
+                "tid": tid,
+                "args": args,
+            }
+        )
+        _THREADS.setdefault(tid, t.name)
+        _PENDING_LINES.append(
+            json.dumps(
+                {
+                    "event": "point",
+                    "name": name,
+                    "span": cur.name if cur is not None else None,
+                    "thread": t.name,
+                    "ts_us": round(ts_us, 3),
+                    "attrs": attrs,
+                },
+                sort_keys=True,
+                default=str,
+            )
+        )
+        if not _ATEXIT_REGISTERED:
+            _ATEXIT_REGISTERED = True
+            atexit.register(_atexit_flush)
+
+
 def span_stats() -> Dict[str, Dict[str, float]]:
     """Per-span-name running aggregates:
     ``{name: {count, wall_seconds, device_seconds}}`` (empty while
-    tracing never enabled — the inertness sentinel)."""
+    tracing never enabled — the inertness sentinel). Sites with
+    cost-model attribution additionally carry ``flops_total`` /
+    ``bytes_total`` / ``mfu`` / ``achieved_gbps`` / ``bound`` —
+    measured roofline position, absent (never zero/NaN) where the
+    backend reported no cost analysis."""
     with _RLOCK:
-        return {
+        stats: Dict[str, Dict[str, float]] = {
             name: {
                 "count": int(st[0]),
                 "wall_seconds": st[1],
@@ -468,6 +582,13 @@ def span_stats() -> Dict[str, Dict[str, float]]:
             }
             for name, st in _STATS.items()
         }
+    roofline = _ROOFLINE
+    if roofline is not None and stats:
+        try:
+            return roofline.aggregate(stats)
+        except Exception:
+            pass
+    return stats
 
 
 def flush() -> Optional[str]:
@@ -475,11 +596,20 @@ def flush() -> Optional[str]:
     JSONL span events under ``TPUML_TRACE``. Called automatically at
     every root-span close and at interpreter exit; safe to call any
     time. Returns the trace file path, or None when there is nothing to
-    write or the env was unset meanwhile."""
+    write or the env was unset meanwhile.
+
+    Rank-aware layout: every filename carries the process index
+    (``trace-r00-<pid>.json``), so N hosts pointed at one shared
+    ``TPUML_TRACE`` directory write N disjoint shards that
+    ``scripts/merge_traces.py`` folds into a single cluster-wide
+    Perfetto trace. The shard's own ``process_index`` rides along as
+    trace-document metadata for the merger.
+    """
     out_dir = _trace_dir()
     with _RLOCK:
         if out_dir is None or not _EVENTS:
             return None
+        rank = _process_index()
         meta = [
             {
                 "ph": "M",
@@ -499,25 +629,29 @@ def flush() -> Optional[str]:
                     "args": {"name": tname},
                 }
             )
-        doc = {"traceEvents": meta + _EVENTS, "displayTimeUnit": "ms"}
+        doc = {
+            "traceEvents": meta + _EVENTS,
+            "displayTimeUnit": "ms",
+            "metadata": {"process_index": rank},
+        }
         pending, _PENDING_LINES[:] = _PENDING_LINES[:], []
         os.makedirs(out_dir, exist_ok=True)
-        trace_path = os.path.join(out_dir, f"trace-{os.getpid()}.json")
+        tag = f"r{rank:02d}-{os.getpid()}"
+        trace_path = os.path.join(out_dir, f"trace-{tag}.json")
         tmp = trace_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)
         os.replace(tmp, trace_path)
         if pending:
-            events_path = os.path.join(
-                out_dir, f"events-{os.getpid()}.jsonl"
-            )
+            events_path = os.path.join(out_dir, f"events-{tag}.jsonl")
             with open(events_path, "a") as f:
                 f.write("\n".join(pending) + "\n")
         return trace_path
 
 
 def reset_telemetry() -> None:
-    """Clear spans, metrics, and watchdog state (test isolation)."""
+    """Clear spans, metrics, watchdog, and roofline state (test
+    isolation)."""
     global _EPOCH
     with _RLOCK:
         _EPOCH = None
@@ -529,6 +663,8 @@ def reset_telemetry() -> None:
     with _WD_LOCK:
         _WD_COUNTS.clear()
         _WD_WARNED.clear()
+    if _ROOFLINE is not None:
+        _ROOFLINE.reset_roofline()
 
 
 # --------------------------------------------------------------------------
@@ -611,21 +747,108 @@ def metrics_snapshot() -> Dict[str, Any]:
 
 
 def write_metrics(out_dir: Optional[str] = None) -> Optional[Tuple[str, str]]:
-    """Write ``metrics-<pid>.prom`` (text format) and
-    ``metrics-<pid>.json`` (snapshot) into ``out_dir`` (default: the
-    ``TPUML_TRACE`` directory). Returns the two paths, or None when no
-    directory is configured."""
+    """Write ``metrics-r00-<pid>.prom`` (text format) and
+    ``metrics-r00-<pid>.json`` (snapshot) into ``out_dir`` (default:
+    the ``TPUML_TRACE`` directory), process-index-tagged like the trace
+    shards. Returns the two paths, or None when no directory is
+    configured."""
     out_dir = out_dir or _trace_dir()
     if out_dir is None:
         return None
     os.makedirs(out_dir, exist_ok=True)
-    prom = os.path.join(out_dir, f"metrics-{os.getpid()}.prom")
-    js = os.path.join(out_dir, f"metrics-{os.getpid()}.json")
+    tag = f"r{_process_index():02d}-{os.getpid()}"
+    prom = os.path.join(out_dir, f"metrics-{tag}.prom")
+    js = os.path.join(out_dir, f"metrics-{tag}.json")
     with open(prom, "w") as f:
         f.write(prometheus_dump())
     with open(js, "w") as f:
         json.dump(metrics_snapshot(), f, indent=2, sort_keys=True)
     return prom, js
+
+
+# --------------------------------------------------------------------------
+# cross-host aggregation
+# --------------------------------------------------------------------------
+
+
+def merge_metric_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-process :func:`metrics_snapshot` dicts into one
+    cluster-wide view, kind-aware per labeled series: counters SUM,
+    gauges MAX (each rank's last-write is a local reading; the peak is
+    the conservative cluster answer), histogram count/sum SUM with
+    min/max merged (ring quantiles are per-rank windows and cannot be
+    merged exactly, so they are dropped rather than faked).
+
+    ``scripts/merge_traces.py`` implements these same rules over the
+    on-disk ``metrics-r*-*.json`` shards; ``dryrun_multichip`` parity-
+    checks the two implementations against each other.
+    """
+    merged: Dict[str, Any] = {}
+    for snap in snaps:
+        for name, entry in snap.items():
+            kind = entry.get("kind", "counter")
+            slot = merged.setdefault(name, {"kind": kind, "series": {}})
+            for series in entry.get("series", []):
+                labels = series.get("labels", {})
+                key = tuple(sorted(labels.items()))
+                have = slot["series"].get(key)
+                if kind == "histogram":
+                    if have is None:
+                        slot["series"][key] = {
+                            "labels": labels,
+                            "count": series.get("count", 0),
+                            "sum": series.get("sum", 0.0),
+                            "min": series.get("min"),
+                            "max": series.get("max"),
+                        }
+                    else:
+                        have["count"] += series.get("count", 0)
+                        have["sum"] += series.get("sum", 0.0)
+                        for fld, pick in (("min", min), ("max", max)):
+                            v = series.get(fld)
+                            if v is not None:
+                                have[fld] = (
+                                    v if have[fld] is None
+                                    else pick(have[fld], v)
+                                )
+                else:
+                    value = series.get("value", 0)
+                    if have is None:
+                        slot["series"][key] = {
+                            "labels": labels, "value": value,
+                        }
+                    elif kind == "gauge":
+                        have["value"] = max(have["value"], value)
+                    else:
+                        have["value"] += value
+    return {
+        name: {
+            "kind": entry["kind"],
+            "series": [entry["series"][k] for k in sorted(entry["series"])],
+        }
+        for name, entry in sorted(merged.items())
+    }
+
+
+def aggregate_metrics() -> Dict[str, Any]:
+    """The cluster-wide merged metric snapshot: allgather every
+    process's :func:`metrics_snapshot` through the ``parallel/mesh.py``
+    host collectives and fold with :func:`merge_metric_snapshots`.
+    Single-process (and any collective failure) degrades to the merge
+    of the local snapshot alone — same shape, local values."""
+    local = metrics_snapshot()
+    snaps = [local]
+    try:
+        from ..parallel.mesh import allgather_host_blobs
+
+        blobs = allgather_host_blobs(
+            json.dumps(local, sort_keys=True, default=str).encode()
+        )
+        if len(blobs) > 1:
+            snaps = [json.loads(b.decode()) for b in blobs]
+    except Exception:
+        _LOGGER.debug("aggregate_metrics: host allgather unavailable")
+    return merge_metric_snapshots(snaps)
 
 
 # --------------------------------------------------------------------------
@@ -638,6 +861,11 @@ _WD_INSTALLED = False
 _WD_CHECKED = False
 _WD_COUNTS: Dict[str, int] = {}
 _WD_WARNED: set = set()
+# the roofline module once installed (span-close annotation), and its
+# compile-event consumer (cost attribution) — both None until the first
+# enabled span installs the hooks, keeping import and defaults inert
+_ROOFLINE: Any = None
+_ROOFLINE_CONSUME: Any = None
 
 
 def _retrace_limit() -> int:
@@ -652,6 +880,12 @@ def _on_event_duration(event: str, duration: float, **kw: Any) -> None:
         site = cur.name if cur is not None else "<untraced>"
         counter("xla_compiles").inc(1, site=site)
         histogram("xla_compile_seconds").observe(duration, site=site)
+        consume = _ROOFLINE_CONSUME
+        if consume is not None:
+            # hand the just-compiled program's cost analysis (stashed by
+            # the roofline compile hook on this same thread) to the
+            # innermost span site — the attribution moment
+            consume(site)
         storm = False
         with _WD_LOCK:
             count = _WD_COUNTS[site] = _WD_COUNTS.get(site, 0) + 1
@@ -695,14 +929,28 @@ def install_retrace_watchdog() -> bool:
         return True
 
 
-def _ensure_watchdog() -> None:
-    """Install on the first enabled span; cheap after the first call."""
-    global _WD_CHECKED
+def _ensure_hooks() -> None:
+    """Install the compile-event hooks (retrace watchdog + roofline
+    cost capture) and the crash-path atexit flush on the first enabled
+    span; cheap after the first call."""
+    global _WD_CHECKED, _ROOFLINE, _ROOFLINE_CONSUME, _ATEXIT_REGISTERED
     if _WD_CHECKED:
         return
     _WD_CHECKED = True
     if _retrace_limit() > 0:
         install_retrace_watchdog()
+    try:
+        from . import roofline
+
+        if roofline.install():
+            _ROOFLINE_CONSUME = roofline._consume_pending
+            _ROOFLINE = roofline
+    except Exception:  # roofline degrades to absent, never breaks spans
+        pass
+    with _RLOCK:
+        if not _ATEXIT_REGISTERED:
+            _ATEXIT_REGISTERED = True
+            atexit.register(_atexit_flush)
 
 
 # --------------------------------------------------------------------------

@@ -102,7 +102,7 @@ def test_trace_file_and_event_log_valid(traced):
     doc = _load_trace(traced)
     assert isinstance(doc["traceEvents"], list)
     for e in doc["traceEvents"]:
-        assert e["ph"] in ("X", "M")
+        assert e["ph"] in ("X", "M", "i")
         if e["ph"] == "X":
             assert isinstance(e["ts"], (int, float))
             assert isinstance(e["dur"], (int, float))
