@@ -1671,6 +1671,212 @@ def bench_pca_stream(mesh, n_chips):
     }
 
 
+SERVE_TRAIN_ROWS = int(os.environ.get("BENCH_SERVE_ROWS", 4096))
+SERVE_COLS = int(os.environ.get("BENCH_SERVE_COLS", 32))
+SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", 60))
+
+
+def bench_serving(mesh, n_chips):
+    """Online-serving latency bench: small rf/pca/umap models resident
+    in a ServingRuntime, driven with a mixed-shape request stream.
+
+    Reports (a) served micro-batched throughput vs the direct
+    per-request ``model.transform`` loop — the A/B the registry +
+    memoized closures exist to win (per-call closure rebuilds are what
+    sank rf/umap transform in round 5); (b) client-observed p50/p99
+    latency under an open-loop QPS sweep; (c) a batch-window sweep.
+    Every phase runs inside telemetry spans so roofline attribution
+    lands on the serving sites, and the retrace contract is enforced:
+    ``retrace_storms`` must read 0 after the full load, else this entry
+    raises (the bench-regression gate then sees the entry missing)."""
+    from spark_rapids_ml_tpu.data import DataFrame
+    from spark_rapids_ml_tpu.models.feature import PCA
+    from spark_rapids_ml_tpu.models.tree import RandomForestClassifier
+    from spark_rapids_ml_tpu.models.umap import UMAP
+    from spark_rapids_ml_tpu.runtime import telemetry as tele
+    from spark_rapids_ml_tpu.serving import ServingRuntime
+
+    rng = np.random.default_rng(41)
+    n, d = SERVE_TRAIN_ROWS, SERVE_COLS
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X[:, 0] + 0.25 * rng.standard_normal(n) > 0).astype(np.float32)
+    df = DataFrame({"features": X, "label": y})
+    umap_rows = min(n, 2048)
+
+    t0 = time.perf_counter()
+    models = {
+        "rf": RandomForestClassifier(
+            numTrees=8, maxDepth=6, seed=3, num_workers=1
+        ).fit(df),
+        "pca": PCA(k=8).fit(df),
+        "umap": UMAP(
+            n_neighbors=8, n_epochs=30, random_state=3, num_workers=1
+        ).fit(DataFrame({"features": X[:umap_rows]})),
+    }
+    fit_seconds = time.perf_counter() - t0
+
+    # mixed-shape request stream: sizes that pad, share buckets, and
+    # dispatch exact; umap stays small (never coalesced, each distinct
+    # shape compiles once)
+    sizes = {"rf": (1, 3, 8, 17, 33, 64), "pca": (2, 5, 16, 27), "umap": (3, 8)}
+    stream = []
+    for fam, szs in sizes.items():
+        for i in range(SERVE_REQUESTS // (3 * len(szs)) or 1):
+            for s in szs:
+                q = rng.standard_normal((s, d)).astype(np.float32)
+                stream.append((fam, q))
+    rows_total = sum(q.shape[0] for _, q in stream)
+
+    # A: direct per-request loop — one model.transform per request, the
+    # path a naive deployment runs (and what BENCH_r05 measured)
+    per_family_direct = {}
+    t0 = time.perf_counter()
+    for fam, model in models.items():
+        reqs = [q for f, q in stream if f == fam]
+        tf = time.perf_counter()
+        for q in reqs:
+            model.transform(DataFrame({"features": q}))
+        per_family_direct[fam] = time.perf_counter() - tf
+    direct_seconds = time.perf_counter() - t0
+
+    # B: served — same requests through the micro-batched runtime
+    t0 = time.perf_counter()
+    with ServingRuntime(batch_window_us=2000, max_bucket_rows=64) as rt:
+        for fam, model in models.items():
+            rt.register(fam, model)
+        warm_seconds = time.perf_counter() - t0
+
+        per_family_served = {}
+        t0 = time.perf_counter()
+        for fam in models:
+            reqs = [q for f, q in stream if f == fam]
+            tf = time.perf_counter()
+            futs = [rt.predict_async(fam, q) for q in reqs]
+            for f in futs:
+                f.result(600)
+            per_family_served[fam] = time.perf_counter() - tf
+        served_seconds = time.perf_counter() - t0
+
+        # open-loop QPS sweep on the rf stream (bounded: 40 requests per
+        # rate), client-observed latency
+        qps_sweep = {}
+        q8 = rng.standard_normal((8, d)).astype(np.float32)
+        for qps in (64, 256, 1024):
+            # latency recorded AT RESOLUTION (done-callback fires on the
+            # dispatcher thread) — collecting after the submit loop would
+            # charge early requests the remaining open-loop sleep time
+            lat = []
+            with tele.span("serve.bench.qps", qps=qps):
+                futs = []
+                for _i in range(40):
+                    t_req = time.perf_counter()
+                    f = rt.predict_async("rf", q8)
+                    f.add_done_callback(
+                        lambda _f, t=t_req: lat.append(
+                            (time.perf_counter() - t) * 1e3
+                        )
+                    )
+                    futs.append(f)
+                    time.sleep(1.0 / qps)
+                for f in futs:
+                    f.result(600)
+            qps_sweep[str(qps)] = {
+                "p50_ms": round(float(np.percentile(lat, 50)), 3),
+                "p99_ms": round(float(np.percentile(lat, 99)), 3),
+            }
+
+    # batch-window sweep: burst of 48 rf requests per window setting
+    window_sweep = {}
+    for window_us in (0, 500, 2000, 8000):
+        with ServingRuntime(
+            batch_window_us=window_us, max_bucket_rows=64
+        ) as rt:
+            rt.register("rf", models["rf"])
+            lat = []
+            with tele.span("serve.bench.window", window_us=window_us):
+                t_burst = time.perf_counter()
+                futs = []
+                for s in (3, 5, 8, 17) * 12:
+                    f = rt.predict_async(
+                        "rf",
+                        rng.standard_normal((s, d)).astype(np.float32),
+                    )
+                    f.add_done_callback(
+                        lambda _f: lat.append(
+                            (time.perf_counter() - t_burst) * 1e3
+                        )
+                    )
+                    futs.append(f)
+                for f in futs:
+                    f.result(600)
+        window_sweep[str(window_us)] = {
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        }
+
+    # the hard serving gate: the whole mixed load must not have scored a
+    # single retrace storm (warmup sites absorb declared compiles)
+    snap = tele.metrics_snapshot()
+    storms = snap.get("retrace_storms")
+    n_storms = sum(s["value"] for s in storms["series"]) if storms else 0
+    if n_storms:
+        raise RuntimeError(
+            f"serving load swept {n_storms} retrace storm(s): "
+            f"{storms['series']}"
+        )
+    p99_series = [
+        s for s in snap.get("serve_p99_ms", {}).get("series", [])
+    ]
+    lat_all = qps_sweep["256"]
+
+    # FLOP model: pca projection + rf traversal compares + umap knn
+    # against the resident training table (dominant term)
+    n_trees, depth = 8, 6
+    per_row = {
+        "pca": 2.0 * d * 8,
+        "rf": float(n_trees * depth),
+        "umap": 2.0 * d * umap_rows,
+    }
+    flops = sum(
+        per_row[fam] * sum(q.shape[0] for f, q in stream if f == fam)
+        for fam in models
+    )
+    served_rps = rows_total / served_seconds
+    direct_rps = rows_total / direct_seconds
+    return {
+        "samples_per_sec_per_chip": served_rps / n_chips,
+        "fit_seconds": served_seconds,
+        "setup_fit_seconds": round(fit_seconds, 4),
+        "warm_seconds": round(warm_seconds, 4),
+        "rows": rows_total,
+        "requests": len(stream),
+        "p50_ms": lat_all["p50_ms"],
+        "p99_ms": lat_all["p99_ms"],
+        "qps_sweep": qps_sweep,
+        "window_sweep": window_sweep,
+        "retrace_storms": n_storms,
+        "serve_vs_direct": {
+            fam: round(
+                per_family_direct[fam] / max(per_family_served[fam], 1e-9), 3
+            )
+            for fam in models
+        },
+        "flops_model": flops,
+        "baseline_samples_per_sec": direct_rps / n_chips,
+        "baseline_kind": "direct_transform_per_request",
+        "baseline_inputs": {
+            "formula": "same_process_per_request_transform_loop_v1",
+            "requests": len(stream),
+            "rows": rows_total,
+            "direct_seconds": round(direct_seconds, 4),
+            "d": d,
+        },
+        "p99_series_models": sorted(
+            {s["labels"].get("model") for s in p99_series}
+        ),
+    }
+
+
 def _probe_backend(
     attempts: int | None = None,
     probe_timeout: int | None = None,
@@ -1841,6 +2047,7 @@ def main() -> None:
         "umap": lambda: bench_umap(mesh, n_chips),
         "ann": lambda: bench_ann(mesh, n_chips),
         "pca_stream": lambda: bench_pca_stream(mesh, n_chips),
+        "serving": lambda: bench_serving(mesh, n_chips),
         "pca": lambda: bench_pca(*_X()[:2], mesh, n_chips),
         "kmeans": lambda: bench_kmeans(*_X()[:2], mesh, n_chips),
         "logreg": lambda: bench_logreg(*_X(), mesh, n_chips),
@@ -2054,6 +2261,9 @@ def _emit_line(results, meta, watchdog_tripped):
         "hist_strategy", "tree_batch", "seconds_per_level",
         "level_seconds", "rounds", "depth", "seconds_per_round",
         "gang_lanes", "solves_per_sec", "vs_sequential", "seq_fit_seconds",
+        "p50_ms", "p99_ms", "qps_sweep", "window_sweep", "retrace_storms",
+        "serve_vs_direct", "setup_fit_seconds", "warm_seconds", "requests",
+        "p99_series_models",
     )
     for name, r in results.items():
         line[name] = {

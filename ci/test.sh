@@ -720,4 +720,74 @@ print(f"merge_traces smoke OK: hosts {sorted(tracks)}, "
       f"{total} spans across ranks")
 EOF
 
+echo "== serving runtime smoke =="
+# In-process serving tier under trace: three co-resident families, a
+# mixed-shape request sweep, and the hard gates — zero retrace storms,
+# zero compiles attributed to the steady-state dispatch site, served
+# outputs bit-identical to direct transforms, and a sane p99.
+rm -rf /tmp/tpuml_trace_serve
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import time
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.models.tree import RandomForestClassifier
+from spark_rapids_ml_tpu.models.umap import UMAP
+from spark_rapids_ml_tpu.runtime import telemetry
+from spark_rapids_ml_tpu.serving import ServingRuntime
+
+rng = np.random.default_rng(19)
+X = rng.normal(size=(512, 12)).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+df = DataFrame({"features": X, "label": y})
+models = {
+    "pca": PCA(k=3).fit(df),
+    "rf": RandomForestClassifier(
+        numTrees=4, maxDepth=4, seed=3, num_workers=1
+    ).fit(df),
+    "umap": UMAP(
+        n_neighbors=5, n_epochs=15, random_state=3, num_workers=1
+    ).fit(DataFrame({"features": X})),
+}
+queries = [rng.normal(size=(s, 12)).astype(np.float32)
+           for s in (1, 2, 5, 13, 17, 33)]
+# trace ONLY the serving tier: the storm gate is a serving contract,
+# and a traced fit legitimately compiles many programs per site
+os.environ["TPUML_TRACE"] = "/tmp/tpuml_trace_serve"
+telemetry.reset_telemetry()
+t0 = time.perf_counter()
+with ServingRuntime(batch_window_us=1000, max_bucket_rows=64) as rt:
+    for name, m in models.items():
+        rt.register(name, m)
+    for _rep in range(3):
+        futs = [(name, q, rt.predict_async(name, q))
+                for name in models for q in queries]
+        for name, q, f in futs:
+            out = f.result(300)
+            direct = models[name].transform(DataFrame({"features": q}))
+            for col, served in out.items():
+                assert np.array_equal(served, np.asarray(direct[col])), (
+                    name, col, q.shape)
+elapsed = time.perf_counter() - t0
+
+snap = telemetry.metrics_snapshot()
+storms = snap.get("retrace_storms")
+assert not storms or all(s["value"] == 0 for s in storms["series"]), storms
+batch_compiles = [
+    s for s in snap.get("xla_compiles", {}).get("series", [])
+    if s["labels"].get("site") == "serve.batch"
+]
+assert batch_compiles == [], batch_compiles
+stats = telemetry.span_stats()
+assert stats["serve.batch"]["count"] > 0, sorted(stats)
+p99 = snap["serve_p99_ms"]["series"]
+assert {s["labels"]["model"] for s in p99} == set(models), p99
+assert elapsed < 120, elapsed
+print(f"serving smoke OK: {3 * len(models) * len(queries)} requests, "
+      f"0 retrace storms, dispatch site compile-free")
+EOF
+
 echo "CI OK"

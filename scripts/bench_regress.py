@@ -9,6 +9,8 @@ newest run against the most recent prior run that produced entries:
 - ``fit_seconds``   — regression when it grows past ``+threshold``
 - ``vs_baseline``   — regression when it shrinks past ``-threshold``
 - ``mfu``           — regression when it shrinks past ``-threshold``
+- ``p99_ms``        — regression when it grows past ``+threshold``
+  (serving tail latency; only entries that report it gate on it)
 
 Rules that keep the gate honest on real trajectories:
 
@@ -53,13 +55,35 @@ Entries = Dict[str, Dict[str, Any]]
 def _entries_from_text(text: str) -> Entries:
     """Per-entry metric dicts from raw bench output (or a tail of it).
 
-    The full metric line may be truncated at the front by the driver's
-    tail capture, so this scans for every ``"name": {...}`` group and
-    keeps the ones that look like bench entries (fit_seconds +
-    samples_per_sec_per_chip). Later occurrences win, matching "last
-    line is the real emit" semantics.
+    Complete metric lines parse as whole-line JSON first — entries with
+    nested sub-dicts (the serving entry's qps/window sweeps) are invisible
+    to the flat-brace scan. The full metric line may also be truncated at
+    the front by the driver's tail capture, so the fallback scans for
+    every ``"name": {...}`` group and keeps the ones that look like bench
+    entries (fit_seconds + samples_per_sec_per_chip). Later occurrences
+    win, matching "last line is the real emit" semantics.
     """
     out: Entries = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            out.update(
+                {
+                    k: v
+                    for k, v in doc.items()
+                    if isinstance(v, dict)
+                    and "fit_seconds" in v
+                    and "samples_per_sec_per_chip" in v
+                }
+            )
+    if out:
+        return out
     for m in _ENTRY_RE.finditer(text):
         try:
             v = json.loads(m.group(2))
@@ -119,6 +143,7 @@ def compare(
         ("fit_seconds", +1),  # +1: larger is worse
         ("vs_baseline", -1),  # -1: smaller is worse
         ("mfu", -1),
+        ("p99_ms", +1),       # serving tail latency: growth is a failure
     )
     rows: List[Tuple[str, str, float, float, float, str]] = []
     failed = False

@@ -957,6 +957,25 @@ class _TpuModel(Params, _TpuParams):
             cols.append(self.getOrDefault("predictionCol"))
         return cols
 
+    def _memoized_transform_fn(
+        self,
+        key: Tuple[Any, ...],
+        build: Callable[[], Callable[[np.ndarray], Dict[str, np.ndarray]]],
+    ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+        """Cache a transform closure on the model, keyed by everything it
+        hoisted (output columns, engine knobs, params). A fresh closure
+        per ``transform()`` call means a fresh ``jax.jit`` object — its
+        trace cache starts empty, so every call retraces and re-stages
+        the hoisted operands. Repeated transforms (the serving hot path)
+        must hit the same jitted program, so the closure lives here."""
+        cache = getattr(self, "_transform_fn_cache", None)
+        if cache is None:
+            cache = self._transform_fn_cache = {}
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = build()
+        return fn
+
     def transform(self, dataset: DataFrame) -> DataFrame:
         """Append prediction/output columns (reference ``core.py:1463-1568``).
 

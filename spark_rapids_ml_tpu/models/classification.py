@@ -613,6 +613,14 @@ class LogisticRegressionModel(
         pred_col = self.getOrDefault("predictionCol")
         prob_col = self.getOrDefault("probabilityCol")
         raw_col = self.getOrDefault("rawPredictionCol")
+        return self._memoized_transform_fn(
+            ("logreg", pred_col, prob_col, raw_col),
+            lambda: self._build_transform_fn(pred_col, prob_col, raw_col),
+        )
+
+    def _build_transform_fn(
+        self, pred_col: str, prob_col: str, raw_col: str
+    ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
         coef_np = np.atleast_2d(self.coef_)
         b_np = np.atleast_1d(self.intercept_)
         multinomial = self._multinomial

@@ -217,18 +217,23 @@ class PCAModel(PCAClass, _TpuModel, _PCAParams):
     def _get_tpu_transform_func(
         self, dataset: Optional[DataFrame] = None
     ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
-        components = jnp.asarray(self.components_)  # (k, d)
         out_col = self.getOrDefault("outputCol")
 
-        @jax.jit
-        def _project(Xb: jax.Array) -> jax.Array:
-            # Spark semantics: no mean removal (reference ``feature.py:426-439``)
-            return Xb @ components.T
+        def _build() -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+            components = jnp.asarray(self.components_)  # (k, d)
 
-        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
-            return {out_col: np.asarray(_project(jnp.asarray(Xb)))}
+            @jax.jit
+            def _project(Xb: jax.Array) -> jax.Array:
+                # Spark semantics: no mean removal (reference
+                # ``feature.py:426-439``)
+                return Xb @ components.T
 
-        return _fn
+            def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+                return {out_col: np.asarray(_project(jnp.asarray(Xb)))}
+
+            return _fn
+
+        return self._memoized_transform_fn(("pca", out_col), _build)
 
     def _out_cols(self):
         return [self.getOrDefault("outputCol")]

@@ -410,20 +410,26 @@ class LinearRegressionModel(
         self, dataset: Optional[DataFrame] = None
     ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
         pred_col = self.getOrDefault("predictionCol")
-        coef_np = np.asarray(self.coefficients)
-        b_np = np.asarray(self.intercept)
-        if coef_np.ndim == 1:
-            @jax.jit
-            def _predict(Xb: jax.Array) -> jax.Array:
-                w = jnp.asarray(coef_np, dtype=Xb.dtype)
-                return Xb @ w + jnp.asarray(b_np, dtype=Xb.dtype)
-        else:
-            @jax.jit
-            def _predict(Xb: jax.Array) -> jax.Array:
-                W = jnp.asarray(coef_np, dtype=Xb.dtype)  # (m, d)
-                return Xb @ W.T + jnp.asarray(b_np, dtype=Xb.dtype)[None, :]
 
-        def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
-            return {pred_col: np.asarray(_predict(jnp.asarray(Xb)))}
+        def _build() -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
+            coef_np = np.asarray(self.coefficients)
+            b_np = np.asarray(self.intercept)
+            if coef_np.ndim == 1:
+                @jax.jit
+                def _predict(Xb: jax.Array) -> jax.Array:
+                    w = jnp.asarray(coef_np, dtype=Xb.dtype)
+                    return Xb @ w + jnp.asarray(b_np, dtype=Xb.dtype)
+            else:
+                @jax.jit
+                def _predict(Xb: jax.Array) -> jax.Array:
+                    W = jnp.asarray(coef_np, dtype=Xb.dtype)  # (m, d)
+                    return (
+                        Xb @ W.T + jnp.asarray(b_np, dtype=Xb.dtype)[None, :]
+                    )
 
-        return _fn
+            def _fn(Xb: np.ndarray) -> Dict[str, np.ndarray]:
+                return {pred_col: np.asarray(_predict(jnp.asarray(Xb)))}
+
+            return _fn
+
+        return self._memoized_transform_fn(("linreg", pred_col), _build)

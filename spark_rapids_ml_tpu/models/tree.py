@@ -510,11 +510,12 @@ class _ForestModelBase(_TpuModel):
         lower); auto prefers packed > bins > legacy on TPU."""
         return str(envspec.get("TPUML_RF_APPLY"))
 
-    def _bins_apply_ready(self) -> bool:
+    def _bins_apply_ready(self, mode: Optional[str] = None) -> bool:
         """True when transform can use the bin-space descents: the model
         carries its bin tables (round-5+ fits) and the built depth fits
-        the two-hop split (k1 <= 8)."""
-        mode = self._apply_mode()
+        the two-hop split (k1 <= 8). ``mode`` overrides the env-resolved
+        selector (parity tests pin an explicit engine)."""
+        mode = self._apply_mode() if mode is None else mode
         if mode == "legacy":
             return False
         has = (
@@ -526,12 +527,13 @@ class _ForestModelBase(_TpuModel):
             return ok
         return ok and jax.default_backend() == "tpu"
 
-    def _packed_apply_ready(self) -> bool:
+    def _packed_apply_ready(self, mode: Optional[str] = None) -> bool:
         """True when transform can use the packed-forest engine: bin
         tables present AND the lockstep traversal kernel lowers for this
         forest shape (or the forest is shallow enough that hop-1 alone
         reaches every leaf — no kernel needed)."""
-        if self._apply_mode() == "bins" or not self._bins_apply_ready():
+        mode = self._apply_mode() if mode is None else mode
+        if mode == "bins" or not self._bins_apply_ready(mode):
             return False
         from ..ops.rf_pallas import packed_traverse_ok
 
@@ -623,21 +625,35 @@ class _ForestModelBase(_TpuModel):
             self._transform_stage_timer = st
         return st
 
+    def _resolve_transform_engine(self, mode: Optional[str] = None) -> str:
+        """packed > bins > legacy under ``mode`` (default: the
+        env-resolved `TPUML_RF_APPLY`). The serving registry resolves
+        with the default mode on purpose: serving promises bit-identity
+        with direct transform, and the packed/legacy descents differ by
+        one f32 ulp in vote normalization on some inputs — same engine,
+        same bits."""
+        if self._packed_apply_ready(mode):
+            return "packed"
+        if self._bins_apply_ready(mode):
+            return "bins"
+        return "legacy"
+
     def _get_tpu_transform_func(
-        self, dataset: Optional[DataFrame] = None
+        self,
+        dataset: Optional[DataFrame] = None,
+        engine: Optional[str] = None,
     ) -> Callable[[np.ndarray], Dict[str, np.ndarray]]:
-        if self._packed_apply_ready():
-            engine = "packed"
-        elif self._bins_apply_ready():
-            engine = "bins"
-        else:
-            engine = "legacy"
+        engine = engine or self._resolve_transform_engine()
         key = (engine, tuple(self._out_cols()))
-        cached = getattr(self, "_transform_engine_cache", None)
-        if cached is not None and cached[0] == key:
-            return cached[1]
-        fn = getattr(self, f"_{engine}_transform_fn")()
-        self._transform_engine_cache = (key, fn)
+        cache = getattr(self, "_transform_engine_cache", None)
+        if cache is None:
+            # dict, not a single slot: closures resolved under different
+            # engines (parity tests flip TPUML_RF_APPLY) coexist without
+            # thrashing each other's jitted programs
+            cache = self._transform_engine_cache = {}
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = getattr(self, f"_{engine}_transform_fn")()
         return fn
 
     def _out_cols(self) -> List[str]:

@@ -381,6 +381,52 @@ SPEC: Dict[str, EnvVar] = _registry(
         "`docs/umap_performance.md`).",
         choices=("auto", "pallas", "xla"), category="umap",
     ),
+    # --- serving (docs/serving.md) ----------------------------------------
+    EnvVar(
+        "TPUML_SERVE_BATCH_WINDOW_US", "int", 2000,
+        "Micro-batching coalesce window in microseconds: after the first "
+        "request of a batch arrives, the dispatcher keeps draining the "
+        "queue for this long before padding and launching, trading p50 "
+        "latency for batch fill. `0` dispatches every drain immediately "
+        "(still coalescing whatever is already queued). Only read by an "
+        "explicitly constructed `serving.ServingRuntime` — no serving "
+        "thread or file exists otherwise.",
+        minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_SERVE_MAX_BUCKET_ROWS", "int", 2048,
+        "Largest padded request-batch bucket, in rows. Coalesced rows "
+        "are padded up to the next power of two and capped here, so the "
+        "compiled-shape set per model is at most "
+        "`log2(max_bucket_rows) - 2` programs; larger coalesced batches "
+        "split across buckets. Rounded down to a power of two (>= 8).",
+        minimum=8, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_SERVE_HBM_BUDGET", "float", None,
+        "Device-memory budget in bytes for the serving model registry's "
+        "resident buffers (packed forests, projection/coefficient "
+        "matrices, UMAP tables + IVF indexes). Loading past the budget "
+        "evicts least-recently-used models first; a single model larger "
+        "than the budget is rejected. Unset = no eviction. The running "
+        "total is filed under the `serve_registry` site of the "
+        "`hbm_budget_bytes`/`hbm_live_bytes` gauges when tracing is on.",
+        exclusive_minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_SERVE_WARMUP", "bool", True,
+        "Eager per-bucket warmup at registry load: compile every padded "
+        "bucket shape of a model's transform program before the first "
+        "request, so steady-state serving never pays a compile (the "
+        "`retrace_storms == 0` contract). `0` warms lazily instead — "
+        "the first request at each bucket runs under a per-bucket "
+        "warmup span and eats the compile.",
+        category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
     # --- CI / notebooks ---------------------------------------------------
     EnvVar(
         "TPUML_NB_CPU", "bool", False,

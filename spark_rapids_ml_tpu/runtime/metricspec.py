@@ -176,6 +176,32 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "against `TPUML_PEAK_HBM_GBPS` for the compute/memory-bound "
         "verdict.",
     ),
+    # --- online serving (PR 11) -------------------------------------------
+    MetricSpec(
+        "serve_requests_total", "counter",
+        "Requests accepted by `serving.ServingRuntime.predict`, labeled "
+        "by registered model name; incremented at enqueue, so the gap "
+        "against completed futures is the in-flight count.",
+    ),
+    MetricSpec(
+        "serve_queue_depth", "gauge",
+        "Requests waiting in the serving queue when the dispatcher "
+        "last drained it (sampled per drain, not per enqueue).",
+    ),
+    MetricSpec(
+        "serve_batch_fill", "histogram",
+        "Valid-row fraction of each dispatched padded bucket "
+        "(`n_valid / bucket_rows`), labeled by model name; low fill "
+        "means the batch window is too short or buckets too coarse "
+        "for the offered load.",
+    ),
+    MetricSpec(
+        "serve_p99_ms", "histogram",
+        "End-to-end per-request serving latency in milliseconds "
+        "(enqueue to result materialized), labeled by model name; the "
+        "exported ring quantiles carry the p50/p99 the bench and CI "
+        "smoke assert on.",
+    ),
     MetricSpec(
         "fault_injections", "counter",
         "Faults raised by the `runtime/faults.py` injection hooks "

@@ -350,6 +350,14 @@ class _Span:
     def __enter__(self) -> "_Span":
         parent = _CURRENT.get()
         self.parent_id = parent.span_id if parent is not None else None
+        if parent is not None and parent.attrs.get("warmup"):
+            # warmup is a property of the whole subtree: a declared-
+            # compilation site (serving warmup/probe) calls into closures
+            # that open their own dispatch spans, and the retrace
+            # watchdog reads the INNERMOST span — without inheritance
+            # those inner sites would score the absorbed compiles as
+            # storms
+            self.attrs.setdefault("warmup", True)
         self.span_id = next(_IDS)
         self._token = _CURRENT.set(self)
         t = threading.current_thread()
@@ -886,6 +894,12 @@ def _on_event_duration(event: str, duration: float, **kw: Any) -> None:
             # the roofline compile hook on this same thread) to the
             # innermost span site — the attribution moment
             consume(site)
+        if cur is not None and cur.attrs.get("warmup"):
+            # declared-compilation sites (`span(..., warmup=True)`): the
+            # serving registry's per-bucket warmup exists precisely to
+            # absorb first-shape compiles, so they are counted in
+            # xla_compiles but never scored as a retrace storm
+            return
         storm = False
         with _WD_LOCK:
             count = _WD_COUNTS[site] = _WD_COUNTS.get(site, 0) + 1

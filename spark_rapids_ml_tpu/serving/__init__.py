@@ -1,0 +1,26 @@
+"""Online inference serving: a device-resident model registry plus a
+micro-batched request queue over the existing transform engines.
+
+Everything here is explicitly constructed — importing the package (or
+the library) starts no thread, opens no file, and reads no
+``TPUML_SERVE_*`` variable; the batch fit/transform paths are untouched
+(see ``docs/serving.md``).
+"""
+
+from .registry import (
+    ModelRegistry,
+    ResidentModel,
+    feature_width,
+    resident_nbytes,
+    serving_family,
+)
+from .runtime import ServingRuntime
+
+__all__ = [
+    "ModelRegistry",
+    "ResidentModel",
+    "ServingRuntime",
+    "feature_width",
+    "resident_nbytes",
+    "serving_family",
+]

@@ -1,0 +1,448 @@
+"""Device-resident model registry for the serving runtime.
+
+Loads persisted models (or adopts already-fitted ones) and pins their
+transform state on the device: the packed forest tables, PCA projection
+and linear/logistic coefficient matrices, and the UMAP training table +
+memoized IVF transform index all get hoisted exactly once, so a request
+never pays a per-call rebuild. Residency is accounted against
+``TPUML_SERVE_HBM_BUDGET`` with least-recently-used eviction, and the
+running total is filed under the ``serve_registry`` site of the
+``hbm_budget_bytes``/``hbm_live_bytes`` gauges.
+
+Warmup: every padded bucket shape of a coalescable model's transform
+program is compiled at load (``TPUML_SERVE_WARMUP``), under a
+per-(model, bucket) span name — so in steady state the dispatch span
+sees zero XLA compiles and the retrace watchdog's ``retrace_storms``
+counter stays at 0 (the serving contract).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..runtime import envspec, telemetry
+
+_LOGGER = logging.getLogger("spark_rapids_ml_tpu.serving")
+
+# floor of the padded bucket ladder; requests below it pad up to 8 rows
+# (except single-row requests, dispatched exact — see docs/serving.md
+# on the XLA n=1 gemv specialization)
+MIN_BUCKET_ROWS = 8
+
+
+# ---------------------------------------------------------------------------
+# per-family serving policy
+# ---------------------------------------------------------------------------
+
+
+def serving_family(model: Any) -> str:
+    """Family tag deciding the fast path: ``rf``/``gbt`` pin their own
+    resolved traversal engine, ``umap`` rides the memoized IVF index but is
+    never coalesced (its refine RNG draws negative-sample offsets from
+    ``[0, n_rows)`` — any row-count change perturbs every row), the
+    dense linear families coalesce freely, and unknown models fall back
+    to ``generic`` (exact-shape dispatch, no padding)."""
+    from ..models.feature import PCAModel
+    from ..models.regression import LinearRegressionModel
+    from ..models.classification import LogisticRegressionModel
+    from ..models.tree import _ForestModelBase, _GBTModel
+    from ..models.umap import UMAPModel
+
+    if isinstance(model, _GBTModel):
+        return "gbt"
+    if isinstance(model, _ForestModelBase):
+        return "rf"
+    if isinstance(model, PCAModel):
+        return "pca"
+    if isinstance(model, LinearRegressionModel):
+        return "linreg"
+    if isinstance(model, LogisticRegressionModel):
+        return "logreg"
+    if isinstance(model, UMAPModel):
+        return "umap"
+    return "generic"
+
+
+# families ELIGIBLE for padded micro-batching (row-independent
+# transforms). Eligibility is necessary, not sufficient: registration
+# runs an empirical pad-invariance probe per model, because whether a
+# backend's kernels are bitwise row-stable is a lowering property, not
+# an algebraic one — e.g. XLA CPU's mat-vec (1-D coefficients, k=1
+# gemm) picks an n-dependent reduction strategy, while its k>=3 gemms
+# and the tree gather engines are exactly row-stable. umap is NEVER
+# eligible: its refine couples every output to the batch row count.
+_COALESCE_FAMILIES = ("rf", "gbt", "pca", "linreg", "logreg")
+
+
+def feature_width(model: Any) -> int:
+    """Input feature dimension, family-agnostically (warmup needs it to
+    synthesize bucket-shaped probe batches)."""
+    for probe in (
+        lambda m: int(m.numFeatures),
+        lambda m: int(np.asarray(m.components_).shape[1]),
+        lambda m: int(np.atleast_2d(np.asarray(m.coefficients)).shape[-1]),
+        lambda m: int(np.atleast_2d(np.asarray(m.coef_)).shape[-1]),
+        lambda m: int(np.asarray(m.raw_data_).shape[1]),
+    ):
+        try:
+            return probe(model)
+        except Exception:
+            continue
+    raise ValueError(
+        f"cannot infer feature width of {type(model).__name__}; "
+        "register with an explicit warmup=False"
+    )
+
+
+def _array_bytes(obj: Any, seen: Optional[Set[int]] = None) -> int:
+    """Recursive nbytes of every array reachable from ``obj`` (dicts,
+    sequences, namedtuples/dataclasses) — the IVF index and packed
+    forest live in small container objects, not bare arrays."""
+    if seen is None:
+        seen = set()
+    if id(obj) in seen:
+        return 0
+    seen.add(id(obj))
+    if hasattr(obj, "nbytes") and hasattr(obj, "dtype"):
+        try:
+            return int(obj.nbytes)
+        except Exception:
+            return 0
+    if isinstance(obj, dict):
+        return sum(_array_bytes(v, seen) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(_array_bytes(v, seen) for v in obj)
+    d = getattr(obj, "__dict__", None)
+    if d:
+        return sum(_array_bytes(v, seen) for v in d.values())
+    f = getattr(obj, "_fields", None)  # namedtuple containers
+    if f:
+        return sum(_array_bytes(getattr(obj, n), seen) for n in f)
+    return 0
+
+
+def resident_nbytes(model: Any) -> int:
+    """Device-resident footprint estimate of a registered model: every
+    persisted array attribute (the transform closures hoist exactly
+    these) plus any memoized transform index already built."""
+    total = 0
+    for v in model._get_model_attributes().values():
+        a = np.asarray(v) if not hasattr(v, "nbytes") else v
+        try:
+            if getattr(a, "dtype", None) is not None and a.dtype != object:
+                total += int(a.nbytes)
+        except Exception:
+            continue
+    total += _array_bytes(getattr(model, "_ivf_index_cache", None) or {})
+    return total
+
+
+# ---------------------------------------------------------------------------
+# resident entries
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ResidentModel:
+    """One registered model with its resolved fast path."""
+
+    name: str
+    model: Any
+    family: str
+    fn: Callable[[np.ndarray], Dict[str, np.ndarray]]
+    engine: str            # resolved transform engine ("packed", "xla", ...)
+    coalesce: bool         # pad-invariance probe passed at registration
+    nbytes: int
+    n_features: int
+    # (bucket_rows) shapes whose programs have compiled — first dispatch
+    # at a cold bucket runs under a warmup span so its compiles never
+    # land on the steady-state dispatch site
+    warmed: Set[int] = field(default_factory=set)
+
+
+# the probe samples (n, bucket) pairs up to this bucket size; kernels
+# whose lowering switches reduction strategy with row count (the only
+# instability class observed) switch well below it
+_PROBE_BUCKET_CAP = 128
+
+
+def _probe_pad_invariance(
+    name: str, fn: Callable, n_features: int, ladder: List[int]
+) -> bool:
+    """Empirically verify the bit-identity contract padding relies on:
+    a row's outputs must not depend on batch row count, pad tail, or
+    row offset.
+
+    Two checks, all comparisons bit-for-bit against a direct exact-shape
+    evaluation of the same rows: (1) offset invariance — two requests
+    concatenated at the ladder floor and padded to the next bucket must
+    reproduce both requests at their offsets; (2) one worst-fill odd
+    size per ladder bucket (``b//2 + 1`` rows padded to ``b``) — kernel
+    strategy switches are row-count-dependent, so a single small shape
+    passing proves nothing about larger buckets. Any mismatch disables
+    coalescing for this model (it still serves, at exact shapes).
+
+    Runs under a warmup span so probe compiles never score as retrace
+    storms. A sampled screen, not a proof — but a strategy-switching
+    kernel fails one of the sampled pairs in practice, and the serving
+    tests sweep sizes inside the probed envelope."""
+    rng = np.random.default_rng(0)
+
+    def run(X: np.ndarray) -> Dict[str, np.ndarray]:
+        with telemetry.span(f"serve.warmup.{name}.probe", warmup=True):
+            return {k: np.asarray(v) for k, v in fn(X).items()}
+
+    a, b = 5, 3
+    A = rng.standard_normal((a, n_features)).astype(np.float32)
+    B = rng.standard_normal((b, n_features)).astype(np.float32)
+    ref_a, ref_b = run(A), run(B)
+    cat = np.concatenate([A, B], axis=0)  # == MIN_BUCKET_ROWS rows
+    pad = np.concatenate(
+        [cat, np.repeat(cat[:1], MIN_BUCKET_ROWS, axis=0)], axis=0
+    )
+    for out in (run(cat), run(pad)):
+        for k, v in ref_a.items():
+            if not np.array_equal(v, out[k][:a]):
+                return False
+        for k, v in ref_b.items():
+            if not np.array_equal(v, out[k][a:a + b]):
+                return False
+    for bucket in ladder:
+        if bucket > _PROBE_BUCKET_CAP:
+            break
+        n = bucket // 2 + 1
+        X = rng.standard_normal((n, n_features)).astype(np.float32)
+        ref = run(X)
+        padded = run(
+            np.concatenate([X, np.repeat(X[:1], bucket - n, axis=0)], axis=0)
+        )
+        for k, v in ref.items():
+            if not np.array_equal(v, padded[k][:n]):
+                return False
+    return True
+
+
+def _resolve_fast_path(model: Any, family: str) -> Tuple[Callable, str]:
+    """The model's transform closure with per-call state pre-resolved.
+
+    rf/GBT: resolve through the model's OWN engine chain (packed > bins
+    > legacy under `TPUML_RF_APPLY`, same gate as a direct
+    `model.transform`). Serving must not pin a different engine than
+    the batch path: the packed and legacy descents disagree by one f32
+    ulp in vote normalization on some inputs, and the serving contract
+    is bit-identity with direct transform — which only reduces to the
+    probe-verified pad-invariance property when both paths run the same
+    compiled closure. On TPU the auto gate already prefers packed, so
+    nothing is lost where the lockstep kernel matters. Everything else:
+    the model's own memoized closure."""
+    if family in ("rf", "gbt"):
+        engine = model._resolve_transform_engine()
+        return model._get_tpu_transform_func(engine=engine), engine
+    return model._get_tpu_transform_func(), "xla"
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+
+class ModelRegistry:
+    """LRU registry of device-resident models, packed against an HBM
+    budget. Thread-safe; the serving dispatcher and concurrent loaders
+    share one instance."""
+
+    def __init__(
+        self,
+        hbm_budget_bytes: Optional[float] = None,
+        warmup: Optional[bool] = None,
+        max_bucket_rows: Optional[int] = None,
+    ) -> None:
+        if hbm_budget_bytes is None:
+            hbm_budget_bytes = envspec.get("TPUML_SERVE_HBM_BUDGET")
+        self._budget = float(hbm_budget_bytes) if hbm_budget_bytes else None
+        self._warmup = (
+            bool(envspec.get("TPUML_SERVE_WARMUP")) if warmup is None
+            else bool(warmup)
+        )
+        raw = (
+            int(envspec.get("TPUML_SERVE_MAX_BUCKET_ROWS"))
+            if max_bucket_rows is None else int(max_bucket_rows)
+        )
+        # round down to a power of two so the ladder is exactly the
+        # pow2 range [MIN_BUCKET_ROWS, max]
+        self._max_bucket = max(MIN_BUCKET_ROWS, 1 << (raw.bit_length() - 1))
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, ResidentModel]" = OrderedDict()
+        self._paths: Dict[str, str] = {}
+        self._evictions = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def max_bucket_rows(self) -> int:
+        return self._max_bucket
+
+    def bucket_ladder(self) -> List[int]:
+        out, b = [], MIN_BUCKET_ROWS
+        while b <= self._max_bucket:
+            out.append(b)
+            b <<= 1
+        return out
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    @property
+    def evictions(self) -> int:
+        return self._evictions
+
+    # -- load / register ---------------------------------------------------
+    def load(self, name: str, path: str) -> ResidentModel:
+        """Load a persisted model directory (any ``_TpuModel`` subclass;
+        the class resolves from its metadata) and make it resident."""
+        from ..core import _TpuModel
+
+        model = _TpuModel.read().load(path)
+        entry = self.register(name, model)
+        with self._lock:
+            self._paths[name] = path
+        return entry
+
+    def register(self, name: str, model: Any) -> ResidentModel:
+        """Adopt an in-memory fitted model: resolve its fast path, admit
+        it against the HBM budget (evicting LRU residents), and warm its
+        bucket ladder."""
+        family = serving_family(model)
+        fn, engine = _resolve_fast_path(model, family)
+        n_features = feature_width(model)
+        coalesce = family in _COALESCE_FAMILIES
+        if coalesce:
+            coalesce = _probe_pad_invariance(
+                name, fn, n_features, self.bucket_ladder()
+            )
+            if not coalesce:
+                _LOGGER.info(
+                    "serving: %s failed the pad-invariance probe on this "
+                    "backend (row-count-dependent kernel lowering); it "
+                    "will serve exact request shapes",
+                    name,
+                )
+        entry = ResidentModel(
+            name=name,
+            model=model,
+            family=family,
+            fn=fn,
+            engine=engine,
+            coalesce=coalesce,
+            nbytes=resident_nbytes(model),
+            n_features=n_features,
+        )
+        with self._lock:
+            if self._budget is not None and entry.nbytes > self._budget:
+                raise ValueError(
+                    f"model {name!r} needs {entry.nbytes} resident bytes, "
+                    f"over the whole TPUML_SERVE_HBM_BUDGET "
+                    f"({self._budget:.0f})"
+                )
+            self._entries.pop(name, None)
+            self._entries[name] = entry
+            self._admit_locked(keep=name)
+            self._file_hbm_locked()
+        if self._warmup and entry.coalesce:
+            self.warm(entry)
+        _LOGGER.info(
+            "serving: registered %s (family=%s engine=%s resident=%dB"
+            " coalesce=%s)",
+            name, family, engine, entry.nbytes, entry.coalesce,
+        )
+        return entry
+
+    def get(self, name: str) -> ResidentModel:
+        """The resident entry for ``name`` (LRU-touched). A previously
+        evicted model whose load path is known transparently reloads."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is not None:
+                self._entries.move_to_end(name)
+                return entry
+            path = self._paths.get(name)
+        if path is not None:
+            return self.load(name, path)
+        raise KeyError(f"model {name!r} is not registered")
+
+    def evict(self, name: str) -> None:
+        with self._lock:
+            entry = self._entries.pop(name, None)
+            if entry is None:
+                return
+            self._release(entry)
+            self._evictions += 1
+            self._file_hbm_locked()
+        _LOGGER.info("serving: evicted %s (%dB)", name, entry.nbytes)
+
+    # -- internals ---------------------------------------------------------
+    def _admit_locked(self, keep: str) -> None:
+        if self._budget is None:
+            return
+        while (
+            sum(e.nbytes for e in self._entries.values()) > self._budget
+            and len(self._entries) > 1
+        ):
+            victim = next(n for n in self._entries if n != keep)
+            entry = self._entries.pop(victim)
+            self._release(entry)
+            self._evictions += 1
+            _LOGGER.info(
+                "serving: LRU-evicted %s (%dB) for %s",
+                victim, entry.nbytes, keep,
+            )
+
+    @staticmethod
+    def _release(entry: ResidentModel) -> None:
+        """Drop every model-side cache holding device buffers; the
+        arrays free when the closures go."""
+        m = entry.model
+        for attr in (
+            "_transform_fn_cache",
+            "_transform_engine_cache",
+            "_ivf_index_cache",
+        ):
+            if getattr(m, attr, None) is not None:
+                setattr(m, attr, {})
+        if getattr(m, "_packed_cache", None) is not None:
+            m._packed_cache = None
+
+    def _file_hbm_locked(self) -> None:
+        telemetry.record_hbm_estimate(
+            "serve_registry",
+            float(sum(e.nbytes for e in self._entries.values())),
+        )
+
+    def warm(self, entry: ResidentModel) -> None:
+        """Compile every padded bucket shape of ``entry`` now, each
+        under its own ``serve.warmup.<name>.b<bucket>`` span site, so no
+        steady-state dispatch ever carries a compile (and no single
+        site accumulates enough to trip the retrace watchdog)."""
+        probe_row = np.zeros((1, entry.n_features), dtype=np.float32)
+        for bucket in self.bucket_ladder():
+            if bucket in entry.warmed:
+                continue
+            Xw = np.broadcast_to(
+                probe_row, (bucket, entry.n_features)
+            ).copy()
+            with telemetry.span(
+                f"serve.warmup.{entry.name}.b{bucket}",
+                bucket=bucket, warmup=True,
+            ):
+                entry.fn(Xw)
+            entry.warmed.add(bucket)

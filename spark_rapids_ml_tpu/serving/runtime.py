@@ -1,0 +1,289 @@
+"""Micro-batched request queue over the serving registry.
+
+Concurrent ``predict()`` calls coalesce inside a bounded batch window
+(``TPUML_SERVE_BATCH_WINDOW_US``) and dispatch as a small fixed set of
+padded power-of-two bucket shapes (``TPUML_SERVE_MAX_BUCKET_ROWS``
+caps the ladder), so the compile cache stays bounded no matter what
+request shapes arrive — the retrace watchdog's ``retrace_storms == 0``
+is the enforced steady-state contract.
+
+Bit-identity contract (tested per family in ``tests/test_serving.py``):
+
+- Padding duplicates a real request row and the pad tail is sliced off
+  before results route back, so a coalesced request's outputs are
+  bit-identical to a direct ``model.transform`` of the same rows —
+  XLA's row-wise kernels are padding- and offset-invariant for >= 2
+  rows.
+- Single-row requests dispatch at their exact shape: XLA lowers an
+  (1, d) matmul to a gemv specialization whose accumulation order
+  differs from the gemm used at any padded width (~1e-5 divergence),
+  so padding a 1-row request would break bitwise parity.
+- UMAP requests never coalesce: the transform refine draws
+  negative-sample offsets from ``[0, n_rows)`` and normalizes edge
+  weights by a batch-global max, so ANY row-count change perturbs
+  every output row. UMAP's fast path is residency (frozen training
+  table + memoized IVF index built once, see ``umap.ivf_build``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..runtime import envspec, telemetry
+from .registry import MIN_BUCKET_ROWS, ModelRegistry, ResidentModel
+
+
+@dataclass
+class _Request:
+    name: str
+    X: np.ndarray
+    future: "Future[Dict[str, np.ndarray]]"
+    t_enqueue: float = field(default_factory=time.perf_counter)
+
+    @property
+    def rows(self) -> int:
+        return int(self.X.shape[0])
+
+
+_SHUTDOWN = object()
+
+
+def _bucket_rows(n: int, max_bucket: int) -> int:
+    """Padded row count for an ``n``-row dispatch: next power of two,
+    floored at MIN_BUCKET_ROWS, capped at the ladder top (grouping
+    never exceeds the cap; an oversized single request runs exact)."""
+    if n >= max_bucket:
+        return n
+    b = MIN_BUCKET_ROWS
+    while b < n:
+        b <<= 1
+    return b
+
+
+class ServingRuntime:
+    """The online serving facade: a registry of device-resident models
+    plus one dispatcher thread micro-batching concurrent requests.
+
+    Explicit-construction only — building this object is the opt-in.
+    ``with ServingRuntime() as rt: rt.register(...); rt.predict(...)``.
+    """
+
+    def __init__(
+        self,
+        registry: Optional[ModelRegistry] = None,
+        batch_window_us: Optional[int] = None,
+        max_bucket_rows: Optional[int] = None,
+        warmup: Optional[bool] = None,
+    ) -> None:
+        self.registry = registry or ModelRegistry(
+            warmup=warmup, max_bucket_rows=max_bucket_rows
+        )
+        self._window_s = (
+            int(envspec.get("TPUML_SERVE_BATCH_WINDOW_US"))
+            if batch_window_us is None else int(batch_window_us)
+        ) / 1e6
+        self._queue: "queue.Queue[Any]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self) -> "ServingRuntime":
+        self.start()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None or self._closed:
+                return
+            # spans opened on the dispatcher inherit the constructor's
+            # context so traces nest under the caller's span, if any
+            self._thread = threading.Thread(
+                target=telemetry.bind_context(self._serve_loop),
+                name="tpuml-serve-dispatch",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            t = self._thread
+        if t is not None:
+            self._queue.put(_SHUTDOWN)
+            t.join()
+
+    # -- registry passthrough ---------------------------------------------
+    def register(self, name: str, model: Any) -> ResidentModel:
+        return self.registry.register(name, model)
+
+    def load(self, name: str, path: str) -> ResidentModel:
+        return self.registry.load(name, path)
+
+    # -- request surface ---------------------------------------------------
+    def predict_async(
+        self, name: str, X: np.ndarray
+    ) -> "Future[Dict[str, np.ndarray]]":
+        """Enqueue one request; the future resolves to the model's
+        output-column dict with exactly ``X.shape[0]`` rows per column."""
+        if self._closed:
+            raise RuntimeError("ServingRuntime is closed")
+        self.start()
+        X = np.asarray(X)
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(
+                f"predict expects a non-empty (n, d) batch, got {X.shape}"
+            )
+        entry = self.registry.get(name)  # KeyError before enqueue
+        if entry.model._float32_inputs:
+            X = np.ascontiguousarray(X, dtype=np.float32)
+        else:
+            X = np.ascontiguousarray(X)
+        fut: "Future[Dict[str, np.ndarray]]" = Future()
+        telemetry.counter("serve_requests_total").inc(1, model=name)
+        self._queue.put(_Request(name=name, X=X, future=fut))
+        return fut
+
+    def predict(
+        self, name: str, X: np.ndarray, timeout: Optional[float] = None
+    ) -> Dict[str, np.ndarray]:
+        return self.predict_async(name, X).result(timeout)
+
+    # -- dispatcher --------------------------------------------------------
+    def _serve_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is _SHUTDOWN:
+                return
+            batch: List[_Request] = [item]
+            deadline = time.perf_counter() + self._window_s
+            stop = False
+            while True:
+                remain = deadline - time.perf_counter()
+                if remain <= 0:
+                    # window closed — still sweep anything already queued
+                    # (coalesces the backlog under sustained load)
+                    try:
+                        while True:
+                            nxt = self._queue.get_nowait()
+                            if nxt is _SHUTDOWN:
+                                stop = True
+                                break
+                            batch.append(nxt)
+                    except queue.Empty:
+                        pass
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remain)
+                except queue.Empty:
+                    break
+                if nxt is _SHUTDOWN:
+                    stop = True
+                    break
+                batch.append(nxt)
+            telemetry.gauge("serve_queue_depth").set(self._queue.qsize())
+            self._dispatch(batch)
+            if stop:
+                return
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        by_model: "Dict[str, List[_Request]]" = {}
+        for r in batch:
+            by_model.setdefault(r.name, []).append(r)
+        for name, reqs in by_model.items():
+            try:
+                entry = self.registry.get(name)
+            except Exception as e:
+                for r in reqs:
+                    r.future.set_exception(e)
+                continue
+            for group in self._group(entry, reqs):
+                self._run_group(entry, group)
+
+    def _group(
+        self, entry: ResidentModel, reqs: List[_Request]
+    ) -> List[List[_Request]]:
+        """Arrival-order greedy packing into bucket-capped groups.
+        Non-coalescable families and single-row requests dispatch alone
+        (the bit-identity contract, see the module docstring)."""
+        max_bucket = self.registry.max_bucket_rows
+        groups: List[List[_Request]] = []
+        cur: List[_Request] = []
+        cur_rows = 0
+        for r in reqs:
+            if not entry.coalesce or r.rows < 2 or r.rows > max_bucket:
+                groups.append([r])
+                continue
+            if cur and cur_rows + r.rows > max_bucket:
+                groups.append(cur)
+                cur, cur_rows = [], 0
+            cur.append(r)
+            cur_rows += r.rows
+        if cur:
+            groups.append(cur)
+        return groups
+
+    def _run_group(
+        self, entry: ResidentModel, group: List[_Request]
+    ) -> None:
+        n = sum(r.rows for r in group)
+        # pad only shapes the contract allows: coalescable family and
+        # >= 2 valid rows (a lone 1-row or oversized request runs exact)
+        pad = entry.coalesce and 2 <= n <= self.registry.max_bucket_rows
+        bucket = _bucket_rows(n, self.registry.max_bucket_rows) if pad else n
+        try:
+            X = (
+                group[0].X if len(group) == 1
+                else np.concatenate([r.X for r in group], axis=0)
+            )
+            if bucket > n:
+                # pad by duplicating a real row: finite values, no
+                # NaN/Inf poisoning, and row-wise kernels ignore rows
+                # they don't emit
+                X = np.concatenate(
+                    [X, np.repeat(X[:1], bucket - n, axis=0)], axis=0
+                )
+            # a cold (model, bucket) pays its XLA compiles under a
+            # dedicated warmup site; the steady-state `serve.batch` site
+            # must attribute ZERO compiles (retrace_storms == 0 gate)
+            attrs = dict(
+                model=entry.name, rows=n, bucket=bucket,
+                fill=round(n / bucket, 4),
+            )
+            if bucket in entry.warmed:
+                span_name = "serve.batch"
+            else:
+                span_name = f"serve.warmup.{entry.name}.b{bucket}"
+                attrs["warmup"] = True
+                entry.warmed.add(bucket)
+            with telemetry.span(span_name, **attrs):
+                out = entry.fn(X)
+            host = {k: np.asarray(v)[:n] for k, v in out.items()}
+        except Exception as e:
+            for r in group:
+                r.future.set_exception(e)
+            return
+        telemetry.histogram("serve_batch_fill").observe(
+            n / bucket, model=entry.name
+        )
+        lo = 0
+        done = time.perf_counter()
+        for r in group:
+            hi = lo + r.rows
+            r.future.set_result({k: v[lo:hi] for k, v in host.items()})
+            telemetry.histogram("serve_p99_ms").observe(
+                (done - r.t_enqueue) * 1e3, model=entry.name
+            )
+            lo = hi

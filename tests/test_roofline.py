@@ -409,6 +409,35 @@ def test_bench_regress_rules():
     assert not failed
 
 
+def test_bench_regress_serving_p99_gate(tmp_path):
+    """Serving tail latency gates: p99_ms growth past threshold fails,
+    and the serving entry's nested sweep dicts survive the tail parse
+    (the flat-brace fallback scan cannot see entries with sub-objects)."""
+    br = _load_by_path("bench_regress")
+    serving = _entry(
+        1.0, 3.0, 0.0, p99_ms=10.0,
+        qps_sweep={"64": {"p50_ms": 4.0, "p99_ms": 12.0}},
+    )
+    rows, failed = br.compare(
+        {"serving": serving},
+        {"serving": _entry(1.0, 3.0, 0.0, p99_ms=11.0)},
+        0.15,
+    )
+    assert not failed, rows
+    rows, failed = br.compare(
+        {"serving": serving},
+        {"serving": _entry(1.0, 3.0, 0.0, p99_ms=20.0)},
+        0.15,
+    )
+    assert failed, rows
+    raw = {"metric": "serving_fit_throughput", "serving": serving}
+    w = tmp_path / "BENCH_r09.json"
+    w.write_text(json.dumps(
+        {"n": 9, "rc": 0, "tail": "noise before\n" + json.dumps(raw)}
+    ))
+    assert br.parse_bench_file(str(w)) == {"serving": serving}
+
+
 def test_bench_regress_parses_wrapper_and_raw(tmp_path):
     br = _load_by_path("bench_regress")
     raw = {

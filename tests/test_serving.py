@@ -1,0 +1,366 @@
+"""Online serving runtime: padded micro-batch bit-identity per model
+family, registry LRU eviction under a tight HBM budget, the
+retrace-free mixed-shape load sweep (`retrace_storms == 0`), correct
+result routing under concurrent clients, the memoized UMAP transform
+index (one build, many queries), and the defaults-inert contract (no
+``TPUML_SERVE_*`` env => no serving threads, bit-identical transforms).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.models.classification import LogisticRegression
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.models.regression import LinearRegression
+from spark_rapids_ml_tpu.models.tree import (
+    GBTRegressor,
+    RandomForestClassifier,
+)
+from spark_rapids_ml_tpu.models.umap import UMAP
+from spark_rapids_ml_tpu.runtime import telemetry
+from spark_rapids_ml_tpu.serving import (
+    ModelRegistry,
+    ServingRuntime,
+    resident_nbytes,
+    serving_family,
+)
+
+N, D = 400, 10
+SEED = 7
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset_telemetry()
+    yield
+    telemetry.reset_telemetry()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(SEED)
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    y = (X[:, 0] + 0.25 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def fitted(data):
+    """One fitted model per serving family (module-scoped: the fits
+    dominate this file's runtime)."""
+    X, y = data
+    df = DataFrame({"features": X, "label": y})
+    return {
+        "pca": PCA(k=4).fit(df),
+        "linreg": LinearRegression(regParam=0.1, maxIter=15).fit(df),
+        "logreg": LogisticRegression(maxIter=15).fit(df),
+        "rf": RandomForestClassifier(
+            numTrees=5, maxDepth=5, seed=3, num_workers=1
+        ).fit(df),
+        "gbt": GBTRegressor(maxIter=3, maxDepth=3, seed=3, num_workers=1).fit(
+            df
+        ),
+        "umap": UMAP(
+            n_neighbors=5, n_epochs=20, random_state=3, num_workers=1
+        ).fit(DataFrame({"features": X})),
+    }
+
+
+def _queries(rng, sizes):
+    return [rng.normal(size=(s, D)).astype(np.float32) for s in sizes]
+
+
+# --- bit-identity ----------------------------------------------------------
+
+
+def test_family_tags(fitted):
+    for family, model in fitted.items():
+        assert serving_family(model) == family
+
+
+@pytest.mark.parametrize("family", ["pca", "linreg", "logreg", "umap"])
+def test_padded_microbatch_bit_identical(fitted, family):
+    """Every request's served output must equal a direct
+    ``model.transform`` of the same rows bit-for-bit — across request
+    sizes that pad, share buckets, and dispatch exact (n=1)."""
+    model = fitted[family]
+    rng = np.random.default_rng(11)
+    sizes = [3, 17, 1, 2, 33] if family != "umap" else [3, 7, 1]
+    qs = _queries(rng, sizes)
+    with ServingRuntime(batch_window_us=20_000, max_bucket_rows=64) as rt:
+        rt.register("m", model)
+        futs = [rt.predict_async("m", q) for q in qs]
+        outs = [f.result(180) for f in futs]
+    for q, out in zip(qs, outs):
+        direct = model.transform(DataFrame({"features": q}))
+        for col, served in out.items():
+            assert np.array_equal(served, np.asarray(direct[col])), (
+                family, col, q.shape,
+            )
+
+
+def test_rf_gbt_served_engine_matches_direct(fitted, monkeypatch):
+    """Serving resolves the SAME engine chain as a direct transform
+    (packed/legacy descents differ by one f32 ulp in vote normalization
+    on some inputs, so pinning a different engine would break the
+    bit-identity contract), and the resolution honors a forced
+    `TPUML_RF_APPLY` at registration."""
+    rng = np.random.default_rng(13)
+    qs = _queries(rng, [3, 17, 2, 33])
+    for family in ("rf", "gbt"):
+        model = fitted[family]
+        with ServingRuntime(batch_window_us=20_000, max_bucket_rows=64) as rt:
+            entry = rt.register("m", model)
+            assert entry.engine == model._resolve_transform_engine()
+            outs = [rt.predict("m", q, timeout=180) for q in qs]
+        for q, out in zip(qs, outs):
+            direct = model.transform(DataFrame({"features": q}))
+            for col, served in out.items():
+                assert np.array_equal(served, np.asarray(direct[col])), (
+                    family, col, q.shape,
+                )
+        # a forced engine applies to serving and direct alike
+        monkeypatch.setenv("TPUML_RF_APPLY", "packed")
+        with ServingRuntime(batch_window_us=20_000, max_bucket_rows=64) as rt:
+            entry = rt.register("m", model)
+            assert entry.engine == "packed"
+            out = rt.predict("m", qs[1], timeout=180)
+        direct = model.transform(DataFrame({"features": qs[1]}))
+        for col, served in out.items():
+            assert np.array_equal(served, np.asarray(direct[col])), (
+                family, col,
+            )
+        monkeypatch.delenv("TPUML_RF_APPLY")
+
+
+def test_transform_closure_memoized(fitted):
+    """Repeated transform-func resolution returns the SAME closure (the
+    per-call rebuild was a fresh jit object per transform => a retrace
+    per call — the serving-killer this PR fixes)."""
+    for family in ("pca", "linreg", "logreg", "umap"):
+        m = fitted[family]
+        assert m._get_tpu_transform_func() is m._get_tpu_transform_func(), (
+            family,
+        )
+
+
+# --- registry --------------------------------------------------------------
+
+
+def test_registry_load_evict_tight_budget(fitted, tmp_path):
+    """Three persisted models through a budget that fits only two:
+    the least-recently-used resident is evicted, a later ``get`` of the
+    evicted name transparently reloads from its path, and a model
+    larger than the whole budget is rejected outright."""
+    paths = {}
+    for name in ("pca", "linreg", "logreg"):
+        p = str(tmp_path / name)
+        fitted[name].write().overwrite().save(p)
+        paths[name] = p
+    sizes = {n: resident_nbytes(fitted[n]) for n in paths}
+    # fits pca plus either linear model, but never all three
+    budget = sizes["pca"] + max(sizes["linreg"], sizes["logreg"])
+
+    reg = ModelRegistry(hbm_budget_bytes=budget, warmup=False)
+    reg.load("pca", paths["pca"])
+    reg.load("linreg", paths["linreg"])
+    assert set(reg.names()) == {"pca", "linreg"}
+    reg.get("pca")  # touch: linreg becomes the LRU victim
+    reg.load("logreg", paths["logreg"])
+    assert "linreg" not in reg.names()
+    assert reg.evictions == 1
+    assert reg.resident_bytes() <= budget
+
+    # transparent reactivation from the recorded load path
+    entry = reg.get("linreg")
+    assert entry.name == "linreg"
+    assert "linreg" in reg.names()
+
+    with pytest.raises(ValueError, match="resident bytes"):
+        ModelRegistry(hbm_budget_bytes=8, warmup=False).register(
+            "pca", fitted["pca"]
+        )
+
+
+def test_registry_load_resolves_class_and_serves(fitted, tmp_path):
+    """`ModelRegistry.load` resolves the persisted class from metadata
+    (no class argument) and the loaded model serves bit-identically to
+    the in-memory original."""
+    p = str(tmp_path / "rf")
+    fitted["rf"].write().overwrite().save(p)
+    rng = np.random.default_rng(17)
+    q = rng.normal(size=(9, D)).astype(np.float32)
+    with ServingRuntime(batch_window_us=0, max_bucket_rows=32) as rt:
+        entry = rt.load("rf", p)
+        assert entry.family == "rf"
+        assert entry.engine == fitted["rf"]._resolve_transform_engine()
+        out = rt.predict("rf", q, timeout=180)
+    with ServingRuntime(batch_window_us=0, max_bucket_rows=32) as rt2:
+        rt2.register("rf", fitted["rf"])
+        out2 = rt2.predict("rf", q, timeout=180)
+    for col in out:
+        np.testing.assert_array_equal(out[col], out2[col])
+
+
+# --- retrace-free load sweep ----------------------------------------------
+
+
+def test_mixed_shape_sweep_retrace_free(fitted, tmp_path, monkeypatch):
+    """The hard serving gate: a mixed-shape sweep over >= 3 co-resident
+    families holds ``retrace_storms == 0``, and the steady-state
+    ``serve.batch`` site attributes ZERO XLA compiles — every compile
+    lands on a declared per-(model, bucket) warmup site."""
+    monkeypatch.setenv("TPUML_TRACE", str(tmp_path))
+    telemetry.reset_telemetry()
+    rng = np.random.default_rng(23)
+    with ServingRuntime(batch_window_us=500, max_bucket_rows=64) as rt:
+        for name in ("pca", "logreg", "rf"):
+            rt.register(name, fitted[name])
+        for _rep in range(3):
+            futs = []
+            for s in (2, 3, 5, 13, 17, 33, 48):
+                q = rng.normal(size=(s, D)).astype(np.float32)
+                futs.extend(
+                    rt.predict_async(name, q)
+                    for name in ("pca", "logreg", "rf")
+                )
+            for f in futs:
+                f.result(180)
+
+    snap = telemetry.metrics_snapshot()
+    storms = snap.get("retrace_storms")
+    assert storms is None or all(
+        s["value"] == 0 for s in storms["series"]
+    ), storms
+    compiles = snap.get("xla_compiles", {}).get("series", [])
+    batch_site = [
+        s for s in compiles if s["labels"].get("site") == "serve.batch"
+    ]
+    assert batch_site == [], batch_site
+    stats = telemetry.span_stats()
+    assert stats["serve.batch"]["count"] > 0
+    # latency + fill surfaces recorded for every family
+    p99 = {
+        s["labels"]["model"] for s in snap["serve_p99_ms"]["series"]
+    }
+    assert p99 == {"pca", "logreg", "rf"}
+
+
+# --- concurrency -----------------------------------------------------------
+
+
+def test_concurrent_clients_route_correctly(fitted):
+    """Many client threads firing interleaved requests at two models:
+    every future resolves to exactly its own rows' outputs."""
+    pca, lin = fitted["pca"], fitted["linreg"]
+    rng = np.random.default_rng(29)
+    payloads = _queries(rng, [2, 3, 5, 9, 17, 4, 7, 33, 2, 11])
+    expect = {}
+    for i, q in enumerate(payloads):
+        name = "pca" if i % 2 == 0 else "lin"
+        model = pca if name == "pca" else lin
+        direct = model.transform(DataFrame({"features": q}))
+        expect[i] = (name, {c: np.asarray(direct[c]) for c in direct.columns
+                            if c != "features"})
+
+    results: dict = {}
+    errors: list = []
+    with ServingRuntime(batch_window_us=5_000, max_bucket_rows=64) as rt:
+        rt.register("pca", pca)
+        rt.register("lin", lin)
+
+        def client(i: int) -> None:
+            try:
+                name = "pca" if i % 2 == 0 else "lin"
+                results[i] = rt.predict(name, payloads[i], timeout=180)
+            except Exception as e:  # pragma: no cover - failure surface
+                errors.append((i, e))
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(len(payloads))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    for i, out in results.items():
+        _name, cols = expect[i]
+        for col, served in out.items():
+            assert np.array_equal(served, cols[col]), (i, col)
+
+
+# --- UMAP one-build-many-queries ------------------------------------------
+
+
+def test_umap_ivf_index_one_build_many_queries(
+    fitted, tmp_path, monkeypatch
+):
+    """The memoized IVF transform index builds ONCE on a cold loaded
+    model and every later query reuses it — witnessed by the
+    `umap.ivf_build` span count across repeated transforms and serves."""
+    monkeypatch.setenv("TPUML_UMAP_GRAPH", "ivf")
+    monkeypatch.setenv("TPUML_TRACE", str(tmp_path / "trace"))
+    telemetry.reset_telemetry()
+    p = str(tmp_path / "umap_model")
+    fitted["umap"].write().overwrite().save(p)
+
+    from spark_rapids_ml_tpu.core import _TpuModel
+
+    model = _TpuModel.read().load(p)  # cold: no index, no closure
+    rng = np.random.default_rng(31)
+    qs = _queries(rng, [5, 9, 5])
+    for q in qs:
+        model.transform(DataFrame({"features": q}))
+    with ServingRuntime(batch_window_us=0) as rt:
+        rt.register("umap", model)
+        for q in qs:
+            rt.predict("umap", q, timeout=180)
+    stats = telemetry.span_stats()
+    assert stats["umap.ivf_build"]["count"] == 1, stats.get("umap.ivf_build")
+
+
+# --- defaults inert --------------------------------------------------------
+
+
+def test_defaults_inert_no_threads_no_drift(fitted):
+    """With no ``TPUML_SERVE_*`` env set: nothing serving-related runs
+    unless explicitly constructed — no dispatcher thread exists before,
+    and none survives after a runtime closes; transform outputs are
+    bit-identical before and after a serving session uses the model."""
+    q = np.random.default_rng(37).normal(size=(19, D)).astype(np.float32)
+    dfq = DataFrame({"features": q})
+    model = fitted["pca"]
+    before = np.asarray(model.transform(dfq)["pca_features"])
+
+    def serve_threads():
+        return [
+            t for t in threading.enumerate()
+            if t.name.startswith("tpuml-serve")
+        ]
+
+    assert serve_threads() == []
+    with ServingRuntime(batch_window_us=0) as rt:
+        rt.register("pca", model)
+        served = rt.predict("pca", q, timeout=180)["pca_features"]
+    assert serve_threads() == []  # close() joins the dispatcher
+    after = np.asarray(model.transform(dfq)["pca_features"])
+    np.testing.assert_array_equal(before, after)
+    np.testing.assert_array_equal(before, served)
+
+
+def test_predict_validates_inputs(fitted):
+    with ServingRuntime(batch_window_us=0) as rt:
+        rt.register("pca", fitted["pca"])
+        with pytest.raises(KeyError, match="not registered"):
+            rt.predict_async("nope", np.zeros((2, D), np.float32))
+        with pytest.raises(ValueError, match="non-empty"):
+            rt.predict_async("pca", np.zeros((0, D), np.float32))
+        with pytest.raises(ValueError, match="non-empty"):
+            rt.predict_async("pca", np.zeros((D,), np.float32))
+    with pytest.raises(RuntimeError, match="closed"):
+        rt.predict_async("pca", np.zeros((2, D), np.float32))
