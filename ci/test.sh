@@ -790,4 +790,174 @@ print(f"serving smoke OK: {3 * len(models) * len(queries)} requests, "
       f"0 retrace storms, dispatch site compile-free")
 EOF
 
+echo "== live ops plane smoke =="
+# Ops-plane contract (docs/observability.md): defaults inert (no env =>
+# no socket, no thread), /metrics + /statusz + /healthz answered
+# mid-streamed-fit with well-formed Prometheus/JSON, and a forced SLO
+# burn producing exactly one flight dump tagged slo_burn.
+rm -rf /tmp/tpuml_ops_smoke
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.runtime import opsplane, telemetry
+
+flight_dir = "/tmp/tpuml_ops_smoke"
+
+# defaults inert: no env => ensure_started refuses, no socket, no thread
+for var in ("TPUML_OPS_PORT", "TPUML_FLIGHT_DIR", "TPUML_TRACE"):
+    os.environ.pop(var, None)
+assert opsplane.ensure_started() is False
+assert opsplane.address() is None and opsplane.flight_recorder() is None
+assert not [t for t in threading.enumerate()
+            if t.name.startswith(("tpuml-ops", "tpuml-slo"))]
+
+# live scrape mid-fit: the streamed ingest loop auto-starts the plane;
+# the scrape fires from a span sink on the first completed stream.fold,
+# so it provably lands while chunks are still folding
+os.environ["TPUML_OPS_PORT"] = "0"
+os.environ["TPUML_FLIGHT_DIR"] = flight_dir
+os.environ["TPUML_SLO_EVAL_MS"] = "60000"  # ticks driven manually below
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(4096, 8)).astype(np.float32)
+df = DataFrame({"features": X})
+
+scrapes = []
+
+def get(path):
+    host, port = opsplane.address()
+    with urllib.request.urlopen(
+        f"http://{host}:{port}{path}", timeout=30
+    ) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+def scrape_on_fold(ev, thread_name):
+    if ev.get("name") == "stream.fold" and not scrapes:
+        t0 = time.perf_counter()
+        m = get("/metrics")
+        dt = time.perf_counter() - t0
+        scrapes.append((m, get("/statusz"), get("/healthz"), dt))
+
+telemetry.add_span_sink(scrape_on_fold)
+try:
+    KMeans(
+        k=4, maxIter=3, seed=0, num_workers=2, streaming=True,
+        stream_chunk_rows=256,
+    ).setFeaturesCol("features").fit(df)
+finally:
+    telemetry.remove_span_sink(scrape_on_fold)
+
+assert opsplane.started(), "streamed fit did not auto-start the plane"
+assert scrapes, "no scrape landed mid-fit"
+(mcode, mctype, mbody), (scode, _, sbody), (hcode, _, hbody), dt = scrapes[0]
+assert mcode == 200 and mctype.startswith("text/plain"), (mcode, mctype)
+lines = mbody.decode().splitlines()
+assert any(l.startswith("# TYPE tpuml_") for l in lines), lines[:5]
+for l in lines:
+    if l and not l.startswith("#"):
+        name = l.split("{", 1)[0].split(" ", 1)[0]
+        assert name.startswith("tpuml_"), l
+        float(l.rsplit(" ", 1)[1])  # every sample parses as a number
+assert hcode == 200 and json.loads(hbody) == {"status": "ok"}
+assert scode == 200
+st = json.loads(sbody)
+assert "stream.ingest" in {s["name"] for s in st["active_spans"]}, st
+assert "stream_ingest" in st["heartbeat_ages_s"], st
+
+# forced SLO burn: two violating ticks alert once and trigger the
+# one-shot flight dump — a third burning tick must not dump again
+ev = opsplane._EVALUATOR
+for _ in range(8):
+    telemetry.histogram("serve_p99_ms").observe(1e4, model="smoke")
+ev.tick(now=1000.0)
+burn = ev.tick(now=1001.0)
+assert burn["serving_p99_ms"]["alerting"], burn
+ev.tick(now=1002.0)
+assert telemetry.counter("slo_burn_alerts").value(slo="serving_p99_ms") == 1
+shards = [f for f in os.listdir(flight_dir) if f.startswith("flight-")]
+assert len(shards) == 1, shards
+with open(os.path.join(flight_dir, shards[0])) as f:
+    doc = json.load(f)
+assert doc["metadata"]["flight"] is True, doc["metadata"]
+assert doc["metadata"]["reason"] == "slo_burn", doc["metadata"]
+assert opsplane.flight_recorder().dumps == {"slo_burn": 1}
+print(f"ops plane smoke OK: {dt * 1e3:.1f} ms mid-fit /metrics scrape, "
+      "one-shot burn dump")
+EOF
+
+# killed-run crash dump: a streamed fit SIGTERMed mid-flight with
+# TPUML_TRACE unset still leaves a loadable rank-tagged flight shard
+# (the handler dumps the ring, then chains to the default disposition
+# so the exit status stays the conventional -SIGTERM).
+rm -rf /tmp/tpuml_flight_smoke
+python - <<'EOF'
+import json
+import os
+import signal
+import subprocess
+import sys
+
+child = r'''
+import numpy as np
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.runtime import telemetry
+
+def announce(ev, thread_name):
+    # announce on the THIRD fold: this sink can run before the flight
+    # recorder's for the same event, so earlier folds being announced
+    # guarantees at least two are already in the ring when the parent
+    # reacts and the SIGTERM lands
+    if ev.get("name") == "stream.fold":
+        announce.folds += 1
+        if announce.folds == 3:
+            print("MIDFIT", flush=True)
+
+announce.folds = 0
+telemetry.add_span_sink(announce)
+rng = np.random.default_rng(0)
+X = rng.normal(size=(2048, 8)).astype(np.float32)
+df = DataFrame({"features": X})
+while True:  # fit until killed
+    KMeans(
+        k=4, maxIter=50, seed=0, num_workers=2, streaming=True,
+        stream_chunk_rows=64,
+    ).setFeaturesCol("features").fit(df)
+'''
+
+env = dict(os.environ)
+for var in ("TPUML_TRACE", "TPUML_OPS_PORT"):
+    env.pop(var, None)
+env["TPUML_FLIGHT_DIR"] = "/tmp/tpuml_flight_smoke"
+env["JAX_PLATFORMS"] = "cpu"
+proc = subprocess.Popen(
+    [sys.executable, "-c", child], env=env,
+    stdout=subprocess.PIPE, text=True,
+)
+line = proc.stdout.readline()
+assert "MIDFIT" in line, line
+proc.send_signal(signal.SIGTERM)
+rc = proc.wait(timeout=120)
+proc.stdout.close()
+assert rc == -signal.SIGTERM, rc
+shards = [f for f in os.listdir("/tmp/tpuml_flight_smoke")
+          if f.startswith("flight-")]
+assert len(shards) == 1, shards
+with open(os.path.join("/tmp/tpuml_flight_smoke", shards[0])) as f:
+    doc = json.load(f)
+assert doc["metadata"]["flight"] is True, doc["metadata"]
+assert doc["metadata"]["reason"] == "signal", doc["metadata"]
+names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+assert "stream.fold" in names, sorted(names)[:20]
+print(f"crash-dump smoke OK: {shards[0]} with {len(names)} span sites")
+EOF
+
 echo "CI OK"

@@ -11,6 +11,11 @@ newest run against the most recent prior run that produced entries:
 - ``mfu``           — regression when it shrinks past ``-threshold``
 - ``p99_ms``        — regression when it grows past ``+threshold``
   (serving tail latency; only entries that report it gate on it)
+- ``serve_batch_fill`` — regression when it shrinks past ``-threshold``
+  (micro-batch fill collapse wastes the padded dispatch)
+- ``qps_sweep[<q>].p99_ms`` — every swept QPS level's tail gates like
+  ``p99_ms``, so a regression visible only at high offered load cannot
+  hide behind the top-level number
 
 Rules that keep the gate honest on real trajectories:
 
@@ -128,6 +133,55 @@ def trajectory_files(pattern: str) -> List[str]:
     return sorted(glob.glob(pattern), key=_run_key)
 
 
+_STATIC_FIELDS = (
+    ("fit_seconds", +1),      # +1: larger is worse
+    ("vs_baseline", -1),      # -1: smaller is worse
+    ("mfu", -1),
+    ("p99_ms", +1),           # serving tail latency: growth is a failure
+    ("serve_batch_fill", -1),  # fill collapse = micro-batching regression
+)
+
+_QPS_FIELD_RE = re.compile(r"^qps_sweep\[(.+)\]\.p99_ms$")
+
+
+def _gate_fields(
+    b: Dict[str, Any], c: Dict[str, Any]
+) -> List[Tuple[str, int]]:
+    """The (field, worse_sign) list for one entry pair: the static
+    fields plus a flattened ``qps_sweep[<q>].p99_ms`` (+1) for every
+    swept QPS level either run reports — a regression that only shows
+    at high offered load must not slip a gate that reads the top-level
+    p99 alone."""
+    fields = list(_STATIC_FIELDS)
+    levels: set = set()
+    for src in (b, c):
+        sweep = src.get("qps_sweep")
+        if isinstance(sweep, dict):
+            for q, sub in sweep.items():
+                if isinstance(sub, dict) and "p99_ms" in sub:
+                    levels.add(str(q))
+    def _qkey(q: str) -> Tuple[int, Any]:
+        try:
+            return (0, int(q))
+        except ValueError:
+            return (1, q)
+    for q in sorted(levels, key=_qkey):
+        fields.append((f"qps_sweep[{q}].p99_ms", +1))
+    return fields
+
+
+def _field_value(entry: Dict[str, Any], field: str) -> Any:
+    m = _QPS_FIELD_RE.match(field)
+    if m is None:
+        return entry.get(field)
+    sweep = entry.get("qps_sweep")
+    if isinstance(sweep, dict):
+        sub = sweep.get(m.group(1))
+        if isinstance(sub, dict):
+            return sub.get("p99_ms")
+    return None
+
+
 def compare(
     base: Entries,
     cur: Entries,
@@ -139,12 +193,6 @@ def compare(
     status one of ``ok`` / ``REGRESS`` / ``skip:<reason>``; the bool is
     True when any row regressed.
     """
-    fields = (
-        ("fit_seconds", +1),  # +1: larger is worse
-        ("vs_baseline", -1),  # -1: smaller is worse
-        ("mfu", -1),
-        ("p99_ms", +1),       # serving tail latency: growth is a failure
-    )
     rows: List[Tuple[str, str, float, float, float, str]] = []
     failed = False
     for name in sorted(set(base) | set(cur)):
@@ -156,8 +204,8 @@ def compare(
             rows.append((name, "-", 0.0, 0.0, 0.0, "skip:new-entry"))
             continue
         tunnel = b.get("tunnel_bound") or c.get("tunnel_bound")
-        for field, worse_sign in fields:
-            bv, cv = b.get(field), c.get(field)
+        for field, worse_sign in _gate_fields(b, c):
+            bv, cv = _field_value(b, field), _field_value(c, field)
             if bv is None or cv is None:
                 continue
             bv, cv = float(bv), float(cv)

@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 _SHARD_RE = re.compile(r"^trace-r(\d+)-(\d+)\.json$")
 _METRICS_RE = re.compile(r"^metrics-r(\d+)-(\d+)\.json$")
+_FLIGHT_RE = re.compile(r"^flight-r(\d+)-(\d+)\.json$")
 
 
 def find_shards(trace_dir: str) -> List[Tuple[int, str]]:
@@ -56,6 +57,19 @@ def find_metric_shards(trace_dir: str) -> List[Tuple[int, str]]:
     out: List[Tuple[int, str]] = []
     for path in sorted(glob.glob(os.path.join(trace_dir, "metrics-*.json"))):
         m = _METRICS_RE.match(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    out.sort(key=lambda rp: (rp[0], rp[1]))
+    return out
+
+
+def find_flight_shards(trace_dir: str) -> List[Tuple[int, str]]:
+    """Flight-recorder dumps (``flight-r<rank>-<pid>.json``, written by
+    ``runtime/opsplane.py`` on SIGTERM/atexit/SLO-burn) — same naming
+    and document shape as trace shards, so they merge the same way."""
+    out: List[Tuple[int, str]] = []
+    for path in sorted(glob.glob(os.path.join(trace_dir, "flight-*.json"))):
+        m = _FLIGHT_RE.match(os.path.basename(path))
         if m:
             out.append((int(m.group(1)), path))
     out.sort(key=lambda rp: (rp[0], rp[1]))
@@ -169,28 +183,54 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="merged metrics path (default: <trace_dir>/merged-metrics.json"
              " when metric shards exist)",
     )
+    ap.add_argument(
+        "--flight-out", default=None,
+        help="merged flight-recorder path (default: "
+             "<trace_dir>/merged-flight.json when flight shards exist)",
+    )
     args = ap.parse_args(argv)
 
     shards = find_shards(args.trace_dir)
-    if not shards:
+    flights = find_flight_shards(args.trace_dir)
+    if not shards and not flights:
         print(
-            f"merge_traces: no trace-r*-*.json shards in {args.trace_dir}",
+            f"merge_traces: no trace-r*-*.json or flight-r*-*.json shards "
+            f"in {args.trace_dir}",
             file=sys.stderr,
         )
         return 1
-    docs = []
-    for _rank, path in shards:
-        with open(path) as f:
-            docs.append(json.load(f))
-    merged = merge_trace_docs(docs)
-    out = args.out or os.path.join(args.trace_dir, "merged.json")
-    with open(out, "w") as f:
-        json.dump(merged, f)
-    n_ev = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
-    print(
-        f"merge_traces: {len(shards)} shard(s), hosts "
-        f"{merged['metadata']['hosts']}, {n_ev} events -> {out}"
-    )
+    if shards:
+        docs = []
+        for _rank, path in shards:
+            with open(path) as f:
+                docs.append(json.load(f))
+        merged = merge_trace_docs(docs)
+        out = args.out or os.path.join(args.trace_dir, "merged.json")
+        with open(out, "w") as f:
+            json.dump(merged, f)
+        n_ev = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
+        print(
+            f"merge_traces: {len(shards)} shard(s), hosts "
+            f"{merged['metadata']['hosts']}, {n_ev} events -> {out}"
+        )
+
+    if flights:
+        docs = []
+        for _rank, path in flights:
+            with open(path) as f:
+                docs.append(json.load(f))
+        fmerged = merge_trace_docs(docs)
+        fmerged["metadata"]["flight"] = True
+        fout = args.flight_out or os.path.join(
+            args.trace_dir, "merged-flight.json"
+        )
+        with open(fout, "w") as f:
+            json.dump(fmerged, f)
+        n_ev = sum(1 for e in fmerged["traceEvents"] if e.get("ph") != "M")
+        print(
+            f"merge_traces: {len(flights)} flight shard(s), hosts "
+            f"{fmerged['metadata']['hosts']}, {n_ev} events -> {fout}"
+        )
 
     msnaps = find_metric_shards(args.trace_dir)
     if msnaps:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -31,7 +32,7 @@ import jax.numpy as jnp
 
 from ..data.chunks import Chunk, ChunkSource
 from ..parallel.mesh import row_sharding
-from ..runtime import counters, envspec, telemetry
+from ..runtime import counters, envspec, opsplane, telemetry
 from ..runtime.faults import SimulatedPreemption, fault_site
 from ..runtime.retry import (
     backoff_schedule,
@@ -656,6 +657,13 @@ def _staged_chunks(chunks, mesh, dtype, *, need_y, need_w, wire, depth):
                         continue
                 if cancel.is_set():
                     return
+                # ops-plane liveness: occupancy right after this put
+                # plus a heartbeat, so /statusz distinguishes a wedged
+                # stage thread from a fold-bound one
+                telemetry.gauge("ingest_ring_occupancy").set(q.qsize())
+                telemetry.gauge("loop_heartbeat_ts").set(
+                    time.monotonic(), loop="stream_stage"
+                )
         except BaseException as e:  # noqa: BLE001 — re-raised on consumer
             err.append(e)
         finally:
@@ -731,6 +739,9 @@ def iter_device_chunks(
     import itertools
 
     np_dtype = np.dtype(jnp.dtype(dtype).name)
+    # a streamed fit is the long-lived loop the ops plane wants to
+    # watch; no-op unless TPUML_OPS_PORT/TPUML_FLIGHT_DIR opted in
+    opsplane.ensure_started()
     it = prefetch_chunks(source.iter_chunks(chunk_rows, np_dtype))
     # manual enter/exit: a `with` around a generator body would not
     # survive the consumer abandoning the iterator mid-pass
@@ -771,6 +782,9 @@ def iter_device_chunks(
             )
         with contextlib.closing(staged) as staged_it:
             for i, (piece, dev) in enumerate(staged_it):
+                telemetry.gauge("loop_heartbeat_ts").set(
+                    time.monotonic(), loop="stream_ingest"
+                )
                 # the fold span brackets the yield: it measures the
                 # CONSUMER's accumulate/dispatch work on this chunk
                 fold_span = telemetry.span("stream.fold", chunk=i)

@@ -548,6 +548,67 @@ SPEC: Dict[str, EnvVar] = _registry(
         exclusive_minimum=0, category="observability",
         also_documented_in=("docs/observability.md",),
     ),
+    # --- live operations plane (runtime/opsplane.py) ----------------------
+    EnvVar(
+        "TPUML_OPS_PORT", "int", None,
+        "Port of the in-process ops HTTP server (`/metrics`, `/healthz`, "
+        "`/readyz`, `/statusz`, `/flight`); `0` binds an ephemeral port. "
+        "Setting it also activates the flight recorder and the SLO "
+        "burn-rate evaluator. Unset (the default) is fully inert: no "
+        "listening socket, no background thread, no files.",
+        minimum=0, category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
+    EnvVar(
+        "TPUML_OPS_HOST", "str", "127.0.0.1",
+        "Bind address of the ops HTTP server. Loopback by default — the "
+        "endpoints expose span names and model names, so widening the "
+        "bind is an explicit decision. Only read when `TPUML_OPS_PORT` "
+        "is set.",
+        category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
+    EnvVar(
+        "TPUML_FLIGHT_DIR", "path", None,
+        "Directory for flight-recorder crash dumps "
+        "(`flight-r<rank>-<pid>.json`, rank-tagged like trace shards): "
+        "written on SIGTERM, at interpreter exit, and on the first SLO "
+        "burn alert. Setting it activates the flight recorder even "
+        "without `TPUML_OPS_PORT`. Unset = dumps fall back to the "
+        "`TPUML_TRACE` directory, or are skipped entirely when neither "
+        "is set (the `/flight` endpoint still serves the in-memory "
+        "ring).",
+        category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
+    EnvVar(
+        "TPUML_FLIGHT_EVENTS", "int", 2048,
+        "Bound of the flight recorder's in-memory ring: the last N "
+        "completed spans and instant events kept for `/flight` and the "
+        "crash-dump paths (a deterministic last-N window, like the "
+        "histogram reservoir). Only read while the recorder is active.",
+        minimum=1, category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
+    EnvVar(
+        "TPUML_SLO_EVAL_MS", "int", 1000,
+        "Tick period of the SLO burn-rate evaluator in milliseconds: "
+        "each tick snapshots the metric registry and scores every "
+        "`runtime/slo.py` catalog entry over its short/long burn "
+        "windows. Only read while the ops plane is active.",
+        minimum=10, category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
+    EnvVar(
+        "TPUML_SLO_BURN_THRESHOLD", "float", 1.0,
+        "Burn-rate multiple at which an SLO alert fires: alert when "
+        "BOTH the short and long windows burn error budget at or above "
+        "this rate (1.0 = exactly exhausting the budget). Raising it "
+        "tolerates faster burns; only read while the ops plane is "
+        "active.",
+        exclusive_minimum=0, category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
 )
 
 

@@ -47,6 +47,12 @@ class MetricSpec:
     # (the _resilience_report contract established before the typed
     # registry existed)
     legacy: bool = False
+    # the CLOSED set of label keys call sites may pass — lint rule
+    # TPU008 rejects undeclared keys and `**dict` splats, so a metric's
+    # label cardinality is bounded by declaration, not by whatever the
+    # hottest code path happened to pass (an unbounded per-request
+    # label set would explode the live /metrics endpoint)
+    labels: Tuple[str, ...] = ()
 
 
 def _registry(*specs: MetricSpec) -> Dict[str, MetricSpec]:
@@ -120,17 +126,20 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "span_seconds", "histogram",
         "Wall-clock duration of every recorded span, labeled by span "
         "name (the distribution behind the Chrome-trace export).",
+        labels=("name",),
     ),
     MetricSpec(
         "xla_compiles", "counter",
         "XLA backend compilations observed by the retrace watchdog, "
         "labeled by the innermost active span at compile time "
         "(`jax.monitoring` backend_compile events).",
+        labels=("site",),
     ),
     MetricSpec(
         "xla_compile_seconds", "histogram",
         "Duration of each observed XLA backend compilation, labeled "
         "like `xla_compiles`.",
+        labels=("site",),
     ),
     MetricSpec(
         "retrace_storms", "counter",
@@ -141,13 +150,16 @@ SPEC: Dict[str, MetricSpec] = _registry(
     MetricSpec(
         "hbm_budget_bytes", "gauge",
         "Most recent HBM peak estimate produced by a budget resolver, "
-        "labeled by site (`gang_fit`, `tree_batch`, `stream_stage`).",
+        "labeled by site (`gang_fit`, `tree_batch`, `stream_stage`, "
+        "`serve_registry`).",
+        labels=("site",),
     ),
     MetricSpec(
         "hbm_live_bytes", "gauge",
         "Live device memory in use when an HBM estimate was recorded, "
         "as reported by `Device.memory_stats()` (absent on backends "
         "that report none).",
+        labels=("site",),
     ),
     # --- roofline attribution (PR 10) -------------------------------------
     MetricSpec(
@@ -156,11 +168,13 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "span name: the sum over distinct programs compiled while the "
         "site was innermost, times the site's call count "
         "(`runtime/roofline.py`).",
+        labels=("name",),
     ),
     MetricSpec(
         "span_bytes_total", "counter",
         "XLA cost-model bytes accessed attributed to each span site, "
         "labeled like `span_flops_total`.",
+        labels=("name",),
     ),
     MetricSpec(
         "span_mfu", "histogram",
@@ -168,6 +182,7 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "call: cost-model FLOPs over fenced device seconds times the "
         "per-chip peak (`TPUML_PEAK_FLOPS` or the built-in device-kind "
         "table) times device count.",
+        labels=("name",),
     ),
     MetricSpec(
         "span_achieved_gbps", "histogram",
@@ -175,6 +190,7 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "(cost-model bytes over fenced device seconds), compared "
         "against `TPUML_PEAK_HBM_GBPS` for the compute/memory-bound "
         "verdict.",
+        labels=("name",),
     ),
     # --- online serving (PR 11) -------------------------------------------
     MetricSpec(
@@ -182,6 +198,7 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "Requests accepted by `serving.ServingRuntime.predict`, labeled "
         "by registered model name; incremented at enqueue, so the gap "
         "against completed futures is the in-flight count.",
+        labels=("model",),
     ),
     MetricSpec(
         "serve_queue_depth", "gauge",
@@ -194,6 +211,7 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "(`n_valid / bucket_rows`), labeled by model name; low fill "
         "means the batch window is too short or buckets too coarse "
         "for the offered load.",
+        labels=("model",),
     ),
     MetricSpec(
         "serve_p99_ms", "histogram",
@@ -201,12 +219,60 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "(enqueue to result materialized), labeled by model name; the "
         "exported ring quantiles carry the p50/p99 the bench and CI "
         "smoke assert on.",
+        labels=("model",),
     ),
     MetricSpec(
         "fault_injections", "counter",
         "Faults raised by the `runtime/faults.py` injection hooks "
         "(`TPUML_FAULT_*`), labeled by fault kind; paired with a "
         "span event so postmortem traces show the injection inline.",
+        labels=("kind",),
+    ),
+    # --- live operations plane (PR 12) ------------------------------------
+    MetricSpec(
+        "ops_requests_total", "counter",
+        "Requests served by the in-process ops HTTP server "
+        "(`TPUML_OPS_PORT`), labeled by endpoint (`metrics`, `healthz`, "
+        "`readyz`, `statusz`, `flight`, `other`).",
+        labels=("endpoint",),
+    ),
+    MetricSpec(
+        "ops_request_seconds", "histogram",
+        "Wall-clock handling time of each ops-server request, labeled "
+        "like `ops_requests_total` — the live-scrape-under-load "
+        "latency the serving bench and CI smoke assert stays in the "
+        "tens of milliseconds.",
+        labels=("endpoint",),
+    ),
+    MetricSpec(
+        "flight_dumps_total", "counter",
+        "Flight-recorder shards written, labeled by trigger (`signal`, "
+        "`atexit`, `slo_burn`); the SLO one-shot contract is exactly "
+        "one `slo_burn` dump per process.",
+        labels=("reason",),
+    ),
+    MetricSpec(
+        "slo_burn_alerts", "counter",
+        "SLO catalog entries whose multi-window burn rate crossed "
+        "`TPUML_SLO_BURN_THRESHOLD` (one increment per alert "
+        "transition, labeled by SLO name — see `runtime/slo.py`).",
+        labels=("slo",),
+    ),
+    MetricSpec(
+        "loop_heartbeat_ts", "gauge",
+        "`time.monotonic()` of the most recent liveness beat of a "
+        "long-running loop, labeled by loop site (`stream_ingest`, "
+        "`stream_stage`, `serve_dispatch`); `/statusz` reports "
+        "`now - value` as the heartbeat age, so a wedged loop shows "
+        "up as a growing age instead of silence.",
+        labels=("loop",),
+    ),
+    MetricSpec(
+        "ingest_ring_occupancy", "gauge",
+        "Staged chunks buffered in the streaming device-staging ring "
+        "when it last accepted one (0..`TPUML_STREAM_STAGE_DEPTH`); "
+        "persistently 0 under load means staging is the bottleneck, "
+        "persistently full means the fold is.",
     ),
 )
 

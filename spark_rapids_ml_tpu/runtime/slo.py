@@ -1,0 +1,239 @@
+"""Declared SLO catalog + multi-window burn-rate math.
+
+The metric analog of :mod:`metricspec` for service-level objectives:
+every SLO the live operations plane (:mod:`runtime.opsplane`) evaluates
+is declared here — name, backing metric, how to measure it from a
+registry snapshot, the objective, and the error budget. ``tpuml_lint``
+loads this file directly (rule TPU007's project pass) and rejects
+catalog entries whose ``metric`` is not in ``metricspec.SPEC``, so the
+SLO catalog and the metric registry cannot drift.
+
+Deliberately stdlib-only (no jax/numpy, no relative imports): the
+linter loads this file via ``importlib`` without importing the package.
+
+Evaluation model (classic multi-window burn rate, scaled to in-process
+ticks rather than Prometheus range queries): the ops-plane evaluator
+samples :func:`telemetry.metrics_snapshot` every ``TPUML_SLO_EVAL_MS``
+and records, per SLO, whether that tick violated the objective. The
+burn rate over a window is::
+
+    burn(window) = violating-tick fraction in window / error_budget
+
+``burn == 1`` means the budget is being spent exactly at the rate that
+exhausts it over the window; an alert fires only when BOTH the short
+and the long window burn at or above ``TPUML_SLO_BURN_THRESHOLD`` —
+the short window gives fast detection, the long window rides out
+one-tick blips.
+
+Measures:
+
+- ``p99``       — worst ring-p99 across the histogram's labeled series
+                  (absolute, per tick).
+- ``window_mean`` — mean of observations ADDED since the previous tick
+                  (sum/count deltas), so an idle metric stops
+                  measuring instead of freezing at its last value.
+- ``window_delta`` — counter increments since the previous tick,
+                  summed across series (for "this should not happen"
+                  budgets like retrace storms).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+MEASURES = ("p99", "window_mean", "window_delta")
+SENSES = ("max", "min")
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """One declared objective over a cataloged metric.
+
+    ``sense="max"`` means the measured value must stay at or below
+    ``objective``; ``"min"`` means at or above. ``error_budget`` is the
+    fraction of evaluation ticks allowed to violate before the burn
+    rate reaches 1. ``short_s``/``long_s`` are the two burn windows in
+    seconds.
+    """
+
+    name: str
+    metric: str
+    measure: str
+    objective: float
+    sense: str
+    error_budget: float
+    doc: str
+    short_s: float = 60.0
+    long_s: float = 300.0
+
+
+def _catalog(*specs: SLOSpec) -> Tuple[SLOSpec, ...]:
+    seen = set()
+    for s in specs:
+        assert s.measure in MEASURES, f"{s.name}: bad measure {s.measure}"
+        assert s.sense in SENSES, f"{s.name}: bad sense {s.sense}"
+        assert 0.0 < s.error_budget <= 1.0, f"{s.name}: bad budget"
+        assert 0.0 < s.short_s < s.long_s, f"{s.name}: bad windows"
+        assert s.name not in seen, f"duplicate SLO {s.name}"
+        seen.add(s.name)
+    return specs
+
+
+CATALOG: Tuple[SLOSpec, ...] = _catalog(
+    SLOSpec(
+        name="serving_p99_ms",
+        metric="serve_p99_ms",
+        measure="p99",
+        objective=250.0,
+        sense="max",
+        error_budget=0.01,
+        doc="End-to-end serving p99 stays under 250 ms (worst labeled "
+            "model) — the PAPERS.md Gemma-serving contract of "
+            "p99-under-swept-QPS, budgeted at 1% of ticks.",
+    ),
+    SLOSpec(
+        name="serving_batch_fill",
+        metric="serve_batch_fill",
+        measure="window_mean",
+        objective=0.25,
+        sense="min",
+        error_budget=0.05,
+        doc="Mean valid-row fraction of dispatched buckets stays above "
+            "0.25 — sustained lower fill means the padding waste "
+            "exceeds 4x and the window/ladder need retuning.",
+    ),
+    SLOSpec(
+        name="fit_retrace_storms",
+        metric="retrace_storms",
+        measure="window_delta",
+        objective=0.0,
+        sense="max",
+        error_budget=0.005,
+        doc="No new retrace storms, ever: any tick where the watchdog "
+            "counted a storm burns 200x budget, so the first storm "
+            "alerts and dumps the flight recorder.",
+    ),
+    SLOSpec(
+        name="fit_fault_injections",
+        metric="fault_injections",
+        measure="window_delta",
+        objective=0.0,
+        sense="max",
+        error_budget=0.10,
+        doc="Injected-fault error budget: faults are expected under "
+            "chaos testing (TPUML_FAULT_*), so a 10% tick budget "
+            "alerts only on a sustained fault storm.",
+    ),
+)
+
+BY_NAME: Dict[str, SLOSpec] = {s.name: s for s in CATALOG}
+
+
+def registered_names() -> Tuple[str, ...]:
+    return tuple(s.name for s in CATALOG)
+
+
+# --------------------------------------------------------------------------
+# pure measurement + burn math (the evaluator thread lives in opsplane)
+# --------------------------------------------------------------------------
+
+
+def _series(snapshot: Dict[str, Any], metric: str) -> List[Dict[str, Any]]:
+    entry = snapshot.get(metric)
+    if not entry:
+        return []
+    return list(entry.get("series") or [])
+
+
+def _totals(snapshot: Dict[str, Any], metric: str) -> Tuple[float, float]:
+    """(count_sum, value_sum) across a metric's labeled series —
+    histogram series contribute count/sum, counter and gauge series
+    contribute (1, value)."""
+    n = 0.0
+    total = 0.0
+    for s in _series(snapshot, metric):
+        if "count" in s:
+            n += float(s.get("count") or 0.0)
+            total += float(s.get("sum") or 0.0)
+        else:
+            n += 1.0
+            total += float(s.get("value") or 0.0)
+    return n, total
+
+
+def measured_value(
+    spec: SLOSpec,
+    snapshot: Dict[str, Any],
+    prev: Optional[Dict[str, Any]],
+) -> Optional[float]:
+    """The SLO's measured value for one evaluation tick, or ``None``
+    when there is nothing to measure (metric never recorded, or no new
+    observations for windowed measures)."""
+    if spec.measure == "p99":
+        vals = [
+            float(s["p99"])
+            for s in _series(snapshot, spec.metric)
+            if s.get("p99") is not None
+        ]
+        return max(vals) if vals else None
+    if prev is None:
+        return None
+    if not _series(snapshot, spec.metric) and not _series(prev, spec.metric):
+        return None  # never recorded: nothing to measure
+    n0, t0 = _totals(prev, spec.metric)
+    n1, t1 = _totals(snapshot, spec.metric)
+    if spec.measure == "window_delta":
+        return max(0.0, t1 - t0)
+    # window_mean
+    dn, dt = n1 - n0, t1 - t0
+    if dn <= 0:
+        return None
+    return dt / dn
+
+
+def violates(spec: SLOSpec, value: float) -> bool:
+    if spec.sense == "max":
+        return value > spec.objective
+    return value < spec.objective
+
+
+def burn_rate(
+    ticks: List[Tuple[float, bool]], window_s: float, now: float,
+    error_budget: float,
+) -> float:
+    """Violating-tick fraction within ``[now - window_s, now]`` over the
+    error budget; 0.0 with no measured ticks in the window."""
+    in_window = [v for (t, v) in ticks if t >= now - window_s]
+    if not in_window:
+        return 0.0
+    frac = sum(1 for v in in_window if v) / len(in_window)
+    return frac / error_budget
+
+
+def evaluate(
+    spec: SLOSpec,
+    ticks: List[Tuple[float, bool]],
+    now: float,
+    threshold: float,
+) -> Dict[str, Any]:
+    """One SLO's burn state: short/long-window burn rates plus whether
+    the alert condition holds (both windows at/over ``threshold``, with
+    at least two measured ticks so a single sample cannot alert)."""
+    short = burn_rate(ticks, spec.short_s, now, spec.error_budget)
+    long_ = burn_rate(ticks, spec.long_s, now, spec.error_budget)
+    measured = [v for (t, v) in ticks if t >= now - spec.long_s]
+    alerting = (
+        len(measured) >= 2
+        and short >= threshold
+        and long_ >= threshold
+    )
+    return {
+        "slo": spec.name,
+        "metric": spec.metric,
+        "objective": spec.objective,
+        "sense": spec.sense,
+        "burn_short": round(short, 4),
+        "burn_long": round(long_, 4),
+        "alerting": alerting,
+    }

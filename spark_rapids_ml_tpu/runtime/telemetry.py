@@ -34,10 +34,17 @@ threads, streaming stages, and device dispatches:
   and ``scripts/merge_traces.py`` folds per-host shards into one
   Perfetto trace with per-host tracks.
 
-Defaults are inert: with ``TPUML_TRACE`` unset, :func:`span` returns a
-shared no-op, nothing is recorded or written, and outputs are
-bit-identical to an uninstrumented run (``tests/test_telemetry.py``
-asserts this bitwise).
+- **Span sinks** — :func:`add_span_sink` attaches a callable fed every
+  completed span/instant event; the live operations plane
+  (:mod:`runtime.opsplane`) uses this to keep a bounded in-memory
+  flight recorder without enabling file export. While a sink is
+  attached, spans are live even with ``TPUML_TRACE`` unset, but the
+  trace buffers, ``span_stats``, and ``spans_recorded`` stay empty.
+
+Defaults are inert: with ``TPUML_TRACE`` unset and no sink attached,
+:func:`span` returns a shared no-op, nothing is recorded or written,
+and outputs are bit-identical to an uninstrumented run
+(``tests/test_telemetry.py`` asserts this bitwise).
 """
 
 from __future__ import annotations
@@ -62,6 +69,9 @@ __all__ = [
     "span",
     "timed_span",
     "bind_context",
+    "add_span_sink",
+    "remove_span_sink",
+    "active_spans",
     "counter",
     "gauge",
     "histogram",
@@ -88,6 +98,15 @@ __all__ = [
 def enabled() -> bool:
     """True when ``TPUML_TRACE`` is set (spans record and export)."""
     return envspec.is_set("TPUML_TRACE")
+
+
+def _recording() -> bool:
+    """True when spans must be live objects: tracing is enabled OR a
+    span sink (the ops-plane flight recorder) is attached. Sinks see
+    every completed span/event but nothing is buffered for file export
+    unless ``TPUML_TRACE`` is also set — the recorder keeps its own
+    bounded ring."""
+    return bool(_SINKS) or enabled()
 
 
 def _trace_dir() -> Optional[str]:
@@ -302,6 +321,48 @@ _PENDING_LINES: List[str] = []  # jsonl lines not yet appended to disk
 _THREADS: Dict[int, str] = {}  # tid -> thread name (trace metadata)
 _STATS: Dict[str, List[float]] = {}  # name -> [count, wall_s, device_s]
 _ATEXIT_REGISTERED = False
+# span sinks: callables fed every completed span/instant event dict
+# (chrome-trace shape) plus the originating thread name — the ops-plane
+# flight recorder attaches here. While any sink is attached, spans are
+# live even with TPUML_TRACE unset (see _recording()).
+_SINKS: List[Any] = []
+# open spans, span_id -> {span_id, parent_id, name, thread, t0} — the
+# /statusz active-span-tree source; empty whenever nothing records
+_ACTIVE: Dict[int, Dict[str, Any]] = {}
+
+
+def add_span_sink(fn: Any) -> None:
+    """Attach ``fn(event_dict, thread_name)`` to every completed span
+    and instant event. Attaching makes spans live (allocated, parented,
+    timed) even when ``TPUML_TRACE`` is unset; file export stays gated
+    on the env. Sink exceptions are swallowed — observability must
+    never fail the fit."""
+    with _RLOCK:
+        if fn not in _SINKS:
+            _SINKS.append(fn)
+
+
+def remove_span_sink(fn: Any) -> None:
+    with _RLOCK:
+        try:
+            _SINKS.remove(fn)
+        except ValueError:
+            pass
+
+
+def active_spans() -> List[Dict[str, Any]]:
+    """Open spans right now: ``[{span_id, parent_id, name, thread,
+    age_seconds}, ...]`` sorted by span_id (creation order), so a
+    client can rebuild the live span tree with wall-clock ages. Empty
+    while nothing records."""
+    now = time.perf_counter()
+    with _RLOCK:
+        snap = [dict(rec) for rec in _ACTIVE.values()]
+    out = []
+    for rec in sorted(snap, key=lambda r: r["span_id"]):
+        rec["age_seconds"] = round(now - rec.pop("t0"), 6)
+        out.append(rec)
+    return out
 
 
 class _NullSpan:
@@ -364,6 +425,14 @@ class _Span:
         self.tid = t.ident or 0
         self.thread_name = t.name
         self._t0 = time.perf_counter()
+        with _RLOCK:
+            _ACTIVE[self.span_id] = {
+                "span_id": self.span_id,
+                "parent_id": self.parent_id,
+                "name": self.name,
+                "thread": self.thread_name,
+                "t0": self._t0,
+            }
         return self
 
     def set_attr(self, **attrs: Any) -> None:
@@ -396,10 +465,11 @@ def span(name: str, **attrs: Any) -> Any:
     """A context manager for one named span.
 
     No-op (a shared singleton, no allocation or recording) while
-    ``TPUML_TRACE`` is unset. The returned object supports
-    ``set_attr(**kw)`` and ``fence(arrays)`` in both modes.
+    ``TPUML_TRACE`` is unset and no span sink is attached. The returned
+    object supports ``set_attr(**kw)`` and ``fence(arrays)`` in both
+    modes.
     """
-    if not enabled():
+    if not _recording():
         return _NULL
     _ensure_hooks()
     return _Span(name, attrs)
@@ -432,8 +502,8 @@ def bind_context(fn: Any) -> Any:
     """Wrap ``fn`` so invocations on another thread inherit the caller's
     span stack. Captures the current ``contextvars`` context once; each
     call runs in a private copy (one Context object cannot be entered
-    concurrently). Identity while tracing is disabled."""
-    if not enabled():
+    concurrently). Identity while nothing records."""
+    if not _recording():
         return fn
     snap = contextvars.copy_context()
 
@@ -454,7 +524,9 @@ def _record(s: _Span, dur: float) -> None:
                 s.attrs.update(extra)
         except Exception:
             pass
+    exporting = enabled()
     with _RLOCK:
+        _ACTIVE.pop(s.span_id, None)
         if _EPOCH is None:
             _EPOCH = s._t0
         ts_us = (s._t0 - _EPOCH) * 1e6
@@ -464,48 +536,59 @@ def _record(s: _Span, dur: float) -> None:
             args["parent_id"] = s.parent_id
         if s.device_s:
             args["device_seconds"] = round(s.device_s, 6)
-        _EVENTS.append(
-            {
-                "name": s.name,
-                "ph": "X",
-                "ts": round(ts_us, 3),
-                "dur": round(dur * 1e6, 3),
-                "pid": os.getpid(),
-                "tid": s.tid,
-                "args": args,
-            }
-        )
-        _THREADS.setdefault(s.tid, s.thread_name)
-        _PENDING_LINES.append(
-            json.dumps(
-                {
-                    "event": "span",
-                    "name": s.name,
-                    "span_id": s.span_id,
-                    "parent_id": s.parent_id,
-                    "thread": s.thread_name,
-                    "ts_us": round(ts_us, 3),
-                    "wall_seconds": round(dur, 6),
-                    "device_seconds": round(s.device_s, 6),
-                    "attrs": s.attrs,
-                },
-                sort_keys=True,
-                default=str,
+        ev = {
+            "name": s.name,
+            "ph": "X",
+            "ts": round(ts_us, 3),
+            "dur": round(dur * 1e6, 3),
+            "pid": os.getpid(),
+            "tid": s.tid,
+            "args": args,
+        }
+        # the file-export buffers (trace JSON, JSONL log, span_stats)
+        # and their metrics stay gated on TPUML_TRACE — the sink-only
+        # path (ops-plane flight recorder) accumulates nothing here,
+        # preserving the inertness sentinel semantics of spans_recorded
+        if exporting:
+            _EVENTS.append(ev)
+            _THREADS.setdefault(s.tid, s.thread_name)
+            _PENDING_LINES.append(
+                json.dumps(
+                    {
+                        "event": "span",
+                        "name": s.name,
+                        "span_id": s.span_id,
+                        "parent_id": s.parent_id,
+                        "thread": s.thread_name,
+                        "ts_us": round(ts_us, 3),
+                        "wall_seconds": round(dur, 6),
+                        "device_seconds": round(s.device_s, 6),
+                        "attrs": s.attrs,
+                    },
+                    sort_keys=True,
+                    default=str,
+                )
             )
-        )
-        st = _STATS.get(s.name)
-        if st is None:
-            st = _STATS[s.name] = [0, 0.0, 0.0]
-        st[0] += 1
-        st[1] += dur
-        st[2] += s.device_s
-        if not _ATEXIT_REGISTERED:
-            _ATEXIT_REGISTERED = True
-            atexit.register(_atexit_flush)
-    counter("spans_recorded").inc()
-    histogram("span_seconds").observe(dur, name=s.name)
-    if root_closed:
-        flush()
+            st = _STATS.get(s.name)
+            if st is None:
+                st = _STATS[s.name] = [0, 0.0, 0.0]
+            st[0] += 1
+            st[1] += dur
+            st[2] += s.device_s
+            if not _ATEXIT_REGISTERED:
+                _ATEXIT_REGISTERED = True
+                atexit.register(_atexit_flush)
+        sinks = list(_SINKS)
+    for sink in sinks:
+        try:
+            sink(ev, s.thread_name)
+        except Exception:  # a broken sink must never fail a span close
+            pass
+    if exporting:
+        counter("spans_recorded").inc()
+        histogram("span_seconds").observe(dur, name=s.name)
+        if root_closed:
+            flush()
 
 
 def _atexit_flush() -> None:
@@ -527,10 +610,11 @@ def add_span_event(name: str, **attrs: Any) -> None:
     """Record an instant event (a point in time, not an interval) under
     the innermost active span — retries, injected faults, and similar
     occurrences show up inline on the trace timeline for postmortems.
-    No-op while tracing is disabled."""
-    if not enabled():
+    No-op while nothing records (tracing disabled, no sink attached)."""
+    if not _recording():
         return
     global _EPOCH, _ATEXIT_REGISTERED
+    exporting = enabled()
     cur = _CURRENT.get()
     t = threading.current_thread()
     tid = t.ident or 0
@@ -542,35 +626,41 @@ def add_span_event(name: str, **attrs: Any) -> None:
         args: Dict[str, Any] = dict(attrs)
         if cur is not None:
             args["span_id"] = cur.span_id
-        _EVENTS.append(
-            {
-                "name": name,
-                "ph": "i",
-                "s": "t",  # thread-scoped instant marker
-                "ts": round(ts_us, 3),
-                "pid": os.getpid(),
-                "tid": tid,
-                "args": args,
-            }
-        )
-        _THREADS.setdefault(tid, t.name)
-        _PENDING_LINES.append(
-            json.dumps(
-                {
-                    "event": "point",
-                    "name": name,
-                    "span": cur.name if cur is not None else None,
-                    "thread": t.name,
-                    "ts_us": round(ts_us, 3),
-                    "attrs": attrs,
-                },
-                sort_keys=True,
-                default=str,
+        ev = {
+            "name": name,
+            "ph": "i",
+            "s": "t",  # thread-scoped instant marker
+            "ts": round(ts_us, 3),
+            "pid": os.getpid(),
+            "tid": tid,
+            "args": args,
+        }
+        if exporting:
+            _EVENTS.append(ev)
+            _THREADS.setdefault(tid, t.name)
+            _PENDING_LINES.append(
+                json.dumps(
+                    {
+                        "event": "point",
+                        "name": name,
+                        "span": cur.name if cur is not None else None,
+                        "thread": t.name,
+                        "ts_us": round(ts_us, 3),
+                        "attrs": attrs,
+                    },
+                    sort_keys=True,
+                    default=str,
+                )
             )
-        )
-        if not _ATEXIT_REGISTERED:
-            _ATEXIT_REGISTERED = True
-            atexit.register(_atexit_flush)
+            if not _ATEXIT_REGISTERED:
+                _ATEXIT_REGISTERED = True
+                atexit.register(_atexit_flush)
+        sinks = list(_SINKS)
+    for sink in sinks:
+        try:
+            sink(ev, t.name)
+        except Exception:
+            pass
 
 
 def span_stats() -> Dict[str, Dict[str, float]]:
@@ -667,6 +757,8 @@ def reset_telemetry() -> None:
         _PENDING_LINES.clear()
         _THREADS.clear()
         _STATS.clear()
+        _ACTIVE.clear()
+        _SINKS.clear()
     _reset_metrics()
     with _WD_LOCK:
         _WD_COUNTS.clear()
@@ -974,10 +1066,11 @@ def _ensure_hooks() -> None:
 
 def record_hbm_estimate(site: str, nbytes: float) -> None:
     """File a budget resolver's peak HBM estimate (``site`` is
-    ``gang_fit`` / ``tree_batch`` / ``stream_stage``) next to the
-    backend's live bytes-in-use where reported. No-op while tracing is
-    disabled, so budget resolution stays allocation-free by default."""
-    if not enabled():
+    ``gang_fit`` / ``tree_batch`` / ``stream_stage`` /
+    ``serve_registry``) next to the backend's live bytes-in-use where
+    reported. No-op while nothing records (tracing disabled, no ops
+    plane), so budget resolution stays allocation-free by default."""
+    if not _recording():
         return
     gauge("hbm_budget_bytes").set(float(nbytes), site=site)
     try:

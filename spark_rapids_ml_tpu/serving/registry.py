@@ -26,7 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ..runtime import envspec, telemetry
+from ..runtime import envspec, opsplane, telemetry
 
 _LOGGER = logging.getLogger("spark_rapids_ml_tpu.serving")
 
@@ -281,6 +281,9 @@ class ModelRegistry:
         self._entries: "OrderedDict[str, ResidentModel]" = OrderedDict()
         self._paths: Dict[str, str] = {}
         self._evictions = 0
+        # weakref-tracked by the ops plane so /readyz and /statusz can
+        # introspect warmup state; pure bookkeeping, starts nothing
+        opsplane.track_registry(self)
 
     # -- introspection -----------------------------------------------------
     @property
@@ -301,6 +304,41 @@ class ModelRegistry:
     def resident_bytes(self) -> int:
         with self._lock:
             return sum(e.nbytes for e in self._entries.values())
+
+    def warmup_state(self) -> Dict[str, Any]:
+        """Readiness introspection for the ops plane (`/readyz` and
+        `/statusz`): per resident model, which ladder buckets are
+        warmed vs pending. ``ready`` is True when every coalescable
+        resident has its full bucket ladder compiled — regardless of
+        whether warmup ran eagerly at register time or lazily on first
+        dispatch, so readiness flips exactly when cold-bucket compiles
+        can no longer stall a request."""
+        ladder = self.bucket_ladder()
+        with self._lock:
+            models: Dict[str, Any] = {}
+            ready = True
+            for name, e in self._entries.items():
+                pending = (
+                    [b for b in ladder if b not in e.warmed]
+                    if e.coalesce else []
+                )
+                if pending:
+                    ready = False
+                models[name] = {
+                    "coalesce": e.coalesce,
+                    "resident_bytes": e.nbytes,
+                    "warmed_buckets": sorted(e.warmed),
+                    "pending_buckets": pending,
+                }
+            return {
+                "ready": ready,
+                "ladder": ladder,
+                "resident_bytes_total": sum(
+                    e.nbytes for e in self._entries.values()
+                ),
+                "evictions": self._evictions,
+                "models": models,
+            }
 
     @property
     def evictions(self) -> int:

@@ -36,7 +36,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..runtime import envspec, telemetry
+from ..runtime import envspec, opsplane, telemetry
 from .registry import MIN_BUCKET_ROWS, ModelRegistry, ResidentModel
 
 
@@ -103,6 +103,11 @@ class ServingRuntime:
         self.close()
 
     def start(self) -> None:
+        # a long-lived serving process is exactly what the ops plane
+        # exists for: make it scrape-able (no-op unless opted in) and
+        # let /statusz read the live queue depth
+        opsplane.ensure_started()
+        opsplane.track_runtime(self)
         with self._lock:
             if self._thread is not None or self._closed:
                 return
@@ -161,9 +166,17 @@ class ServingRuntime:
     ) -> Dict[str, np.ndarray]:
         return self.predict_async(name, X).result(timeout)
 
+    def queue_depth(self) -> int:
+        """Requests waiting right now (the live reading behind
+        `/statusz`, vs the per-drain `serve_queue_depth` gauge)."""
+        return self._queue.qsize()
+
     # -- dispatcher --------------------------------------------------------
     def _serve_loop(self) -> None:
         while True:
+            telemetry.gauge("loop_heartbeat_ts").set(
+                time.monotonic(), loop="serve_dispatch"
+            )
             item = self._queue.get()
             if item is _SHUTDOWN:
                 return

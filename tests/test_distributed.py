@@ -7,11 +7,21 @@ tested by ``python/tests/test_ucx.py:35-99``). The TPU-native analog —
 same treatment: a REAL 2-process world (subprocesses with gloo CPU
 collectives), each process holding its own data partition, asserting the
 distributed fit matches the single-process fit bit-for-bit at f32 tolerance.
+
+The multi-process tests require a jaxlib whose CPU backend implements
+multiprocess computations (some builds raise ``INVALID_ARGUMENT:
+Multiprocess computations aren't implemented on the CPU backend`` from
+the very first ``process_allgather``). That is an environment property,
+not a code property, so the tests gate on an explicit capability probe
+— a 2-process ``jax.distributed.initialize`` + ``process_allgather``
+round-trip in subprocesses — and skip with the probe's failure as the
+reason when the build can't do it.
 """
 
 import os
 import subprocess
 import sys
+import tempfile
 import textwrap
 
 import numpy as np
@@ -26,6 +36,81 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+_DIST_PROBE = textwrap.dedent(
+    """
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    jax.distributed.initialize(
+        coordinator_address=os.environ["PROBE_COORD"],
+        num_processes=2,
+        process_id=int(os.environ["PROBE_ID"]),
+    )
+    from jax.experimental import multihost_utils
+    out = multihost_utils.process_allgather(
+        np.array([1 + int(os.environ["PROBE_ID"])], np.int32)
+    )
+    assert int(out.sum()) == 3, out
+    print("DIST_PROBE_OK", flush=True)
+    """
+)
+
+# None = not probed yet; "" = capable; anything else = the skip reason
+_DIST_PROBE_RESULT = None
+
+
+def _probe_two_process_cpu_world() -> str:
+    """Run the minimal primitive every test here depends on: a real
+    2-process gloo world doing one allgather on the CPU backend."""
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "dist_probe.py")
+        with open(script, "w") as fh:
+            fh.write(_DIST_PROBE)
+        coord = f"127.0.0.1:{_free_port()}"
+        procs = []
+        for pid in range(2):
+            env = dict(os.environ)
+            env.update(
+                PROBE_COORD=coord, PROBE_ID=str(pid), JAX_PLATFORMS="cpu"
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, script], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outs = []
+        for p in procs:
+            try:
+                stdout, _ = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                return "2-process jax.distributed CPU probe timed out"
+            outs.append(stdout)
+    if all(p.returncode == 0 for p in procs):
+        return ""
+    bad = next(o for p, o in zip(procs, outs) if p.returncode != 0)
+    lines = [ln for ln in bad.strip().splitlines() if ln]
+    return (
+        "this jaxlib cannot run a 2-process CPU world: "
+        + (lines[-1] if lines else "probe produced no output")
+    )
+
+
+def _require_two_process_cpu_world() -> None:
+    """Skip (with the probe's diagnosis) unless a real multi-process
+    CPU world works here. Probed once per session, cached."""
+    global _DIST_PROBE_RESULT
+    if _DIST_PROBE_RESULT is None:
+        _DIST_PROBE_RESULT = _probe_two_process_cpu_world()
+    if _DIST_PROBE_RESULT:
+        pytest.skip(_DIST_PROBE_RESULT)
 
 _WORKER = textwrap.dedent(
     """
@@ -104,6 +189,7 @@ _WORKER = textwrap.dedent(
 
 @pytest.mark.slow
 def test_two_process_fit_matches_single_process(tmp_path):
+    _require_two_process_cpu_world()
     out = str(tmp_path / "result.npz")
     script = tmp_path / "worker.py"
     script.write_text(_WORKER.format(repo=REPO))
@@ -297,6 +383,7 @@ def test_two_process_knn_exact(tmp_path):
     """Cross-process kNN: each rank owns item and query partitions; results
     must match a full-dataset brute-force oracle (the reference's UCX
     partition exchange contract, ``knn.py:377-379``)."""
+    _require_two_process_cpu_world()
     script = tmp_path / "knn_worker.py"
     script.write_text(_KNN_WORKER.format(repo=REPO))
     coord = f"127.0.0.1:{_free_port()}"
@@ -373,6 +460,7 @@ def test_two_process_streaming_matches_single_process(tmp_path):
     """Out-of-core fits across processes: each rank streams ITS partition
     through its own chips; sufficient-statistic partials allreduce — the
     reference's per-worker Arrow stream + NCCL allreduce architecture."""
+    _require_two_process_cpu_world()
     out = str(tmp_path / "stream.npz")
     script = tmp_path / "stream_worker.py"
     script.write_text(_STREAM_WORKER.format(repo=REPO))
@@ -436,6 +524,7 @@ def test_two_process_streaming_matches_single_process(tmp_path):
 def test_multihost_benchmark_launcher():
     """The cluster-submission analog (reference databricks/run_benchmark.sh):
     N processes, same command line, joined via the TPUML_* bootstrap."""
+    _require_two_process_cpu_world()
     r = subprocess.run(
         [os.path.join(REPO, "run_benchmark_multihost.sh"), "2", "cpu", "3000", "16"],
         capture_output=True, text=True, timeout=420,

@@ -19,6 +19,7 @@ from tpuml_lint import (
     tpu005_static_args,
     tpu006_lane_align,
     tpu007_metric_catalog,
+    tpu008_label_cardinality,
 )
 from tpuml_lint.core import (
     Finding,
@@ -386,6 +387,87 @@ def test_tpu007_suppression_comment():
     assert "bogus_two" in findings[0].message
 
 
+def test_tpu007_slo_catalog_must_reference_declared_metrics(tmp_path):
+    """An SLO over a nonexistent metric would silently never measure —
+    the project pass rejects it (checked against a scratch repo whose
+    slo.py references a bogus metric; the real catalog is covered by
+    the clean whole-repo run)."""
+    rt = tmp_path / "spark_rapids_ml_tpu" / "runtime"
+    rt.mkdir(parents=True)
+    real = os.path.join(REPO_ROOT, "spark_rapids_ml_tpu", "runtime")
+    for name in ("envspec.py", "metricspec.py"):
+        with open(os.path.join(real, name)) as fh:
+            (rt / name).write_text(fh.read())
+    (rt / "slo.py").write_text(textwrap.dedent("""
+        from dataclasses import dataclass
+
+        @dataclass(frozen=True)
+        class SLOSpec:
+            name: str
+            metric: str
+
+        CATALOG = (SLOSpec("phantom", "metric_nobody_declared"),)
+    """))
+    findings = list(tpu007_metric_catalog.check_project([], str(tmp_path)))
+    assert len(findings) == 1
+    assert findings[0].rule == "TPU007"
+    assert "metric_nobody_declared" in findings[0].message
+    assert findings[0].context == "slo:phantom"
+
+    # a bare scratch repo with no slo.py at all lints clean
+    (rt / "slo.py").unlink()
+    assert list(tpu007_metric_catalog.check_project([], str(tmp_path))) == []
+
+
+# --- TPU008: metric label cardinality ---------------------------------------
+
+
+def test_tpu008_flags_splat_and_undeclared_labels():
+    findings = lint_project_snippet(tpu008_label_cardinality, """
+        from spark_rapids_ml_tpu.runtime import telemetry
+        labels = {"request_id": rid}
+        telemetry.counter("retries").inc(**labels)
+        telemetry.counter("retries").inc(model="x")
+        telemetry.gauge("hbm_live_bytes").set(1.0, shard=3)
+        telemetry.histogram("serve_p99_ms").observe(2.0, user=u)
+    """)
+    assert len(findings) == 4
+    assert all(f.rule == "TPU008" for f in findings)
+    assert "splat" in findings[0].message
+    assert "undeclared label 'model'" in findings[1].message
+    assert "'site'" in findings[2].message  # names the declared set
+    assert "undeclared label 'user'" in findings[3].message
+
+
+def test_tpu008_allows_declared_labels_and_value_params():
+    findings = lint_project_snippet(tpu008_label_cardinality, """
+        from spark_rapids_ml_tpu.runtime import telemetry
+        telemetry.counter("retries").inc()
+        telemetry.counter("retries").inc(by=3)
+        telemetry.counter("xla_compiles").inc(site="serve.batch")
+        telemetry.gauge("hbm_live_bytes").set(1.0, site="gang_fit")
+        telemetry.gauge("resumed_from").set(value=7)
+        telemetry.histogram("serve_p99_ms").observe(2.0, model="pca")
+        telemetry.histogram("span_seconds").observe(value=0.1, name="x")
+        telemetry.counter("undeclared_name").inc(model="x")  # TPU007's job
+        name = "ret" + "ries"
+        telemetry.counter(name).inc(model="x")  # dynamic: out of scope
+        m = telemetry.counter("retries")
+        m.inc(model="x")  # not the chained form: out of scope
+    """)
+    assert findings == []
+
+
+def test_tpu008_suppression_comment():
+    findings = lint_project_snippet(tpu008_label_cardinality, """
+        from spark_rapids_ml_tpu.runtime import telemetry
+        telemetry.counter("retries").inc(model="a")  # tpuml: ignore[TPU008]
+        telemetry.counter("retries").inc(model="b")
+    """)
+    assert len(findings) == 1
+    assert "model" in findings[0].message
+
+
 # --- baseline + suppression mechanics --------------------------------------
 
 
@@ -510,6 +592,10 @@ def test_lint_fails_on_each_rule(tmp_path):
         "TPU007": (
             "from spark_rapids_ml_tpu.runtime import counters\n"
             'counters.bump("not_in_the_catalog")\n'
+        ),
+        "TPU008": (
+            "from spark_rapids_ml_tpu.runtime import telemetry\n"
+            'telemetry.counter("retries").inc(request_id="r1")\n'
         ),
     }
     for code, src in bad.items():

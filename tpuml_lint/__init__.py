@@ -17,6 +17,7 @@ from . import (
     tpu005_static_args,
     tpu006_lane_align,
     tpu007_metric_catalog,
+    tpu008_label_cardinality,
 )
 from .core import (
     Finding,
@@ -40,7 +41,11 @@ FILE_RULES = (
     tpu005_static_args,
     tpu006_lane_align,
 )
-PROJECT_RULES = (tpu002_env_docs, tpu007_metric_catalog)
+PROJECT_RULES = (
+    tpu002_env_docs,
+    tpu007_metric_catalog,
+    tpu008_label_cardinality,
+)
 ALL_RULES = FILE_RULES + PROJECT_RULES
 
 

@@ -19,6 +19,9 @@ ENVSPEC_RELPATH = os.path.join(
 METRICSPEC_RELPATH = os.path.join(
     "spark_rapids_ml_tpu", "runtime", "metricspec.py"
 )
+SLOSPEC_RELPATH = os.path.join(
+    "spark_rapids_ml_tpu", "runtime", "slo.py"
+)
 
 _cache: dict = {}
 
@@ -62,3 +65,13 @@ def load_metricspec(repo_root: str) -> Any:
     return _load_by_path(
         "_tpuml_lint_metricspec", os.path.join(repo_root, METRICSPEC_RELPATH)
     )
+
+
+def load_slospec(repo_root: str) -> Optional[Any]:
+    """The executed SLO catalog (``runtime/slo.py``, stdlib-only like
+    the other registries), or None where the file does not exist (the
+    lint snippet fixtures run against bare temp repos)."""
+    path = os.path.join(repo_root, SLOSPEC_RELPATH)
+    if not os.path.exists(path):
+        return None
+    return _load_by_path("_tpuml_lint_slospec", path)

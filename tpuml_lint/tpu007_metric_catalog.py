@@ -16,6 +16,11 @@ Two checks per call site with a literal first argument:
    ``histogram`` a histogram) — the runtime raises on mismatch, this
    catches it before anything runs.
 
+The project pass also validates the SLO catalog (``runtime/slo.py``):
+every declared ``SLOSpec.metric`` must reference a cataloged metric —
+an SLO over a nonexistent metric would silently never measure, which
+is the worst possible failure mode for an alerting rule.
+
 Dynamic (non-literal) names are out of scope, as with TPU002.
 """
 
@@ -26,7 +31,12 @@ import os
 from typing import Iterator, List, Optional, Tuple
 
 from .core import Finding, SourceFile, dotted_name, str_const
-from .envinfo import METRICSPEC_RELPATH, load_metricspec
+from .envinfo import (
+    METRICSPEC_RELPATH,
+    SLOSPEC_RELPATH,
+    load_metricspec,
+    load_slospec,
+)
 
 CODE = "TPU007"
 NAME = "metric-catalog"
@@ -103,3 +113,33 @@ def check_project(files: List[SourceFile], repo_root: str) -> Iterator[Finding]:
                     "use the matching registry accessor or fix the "
                     "catalog kind",
                 )
+
+    # the SLO catalog must only reference cataloged metrics
+    slo_relpath = SLOSPEC_RELPATH.replace(os.sep, "/")
+    try:
+        slospec = load_slospec(repo_root)
+    except Exception as e:
+        yield Finding(
+            rule=CODE,
+            path=slo_relpath,
+            line=1,
+            col=1,
+            message=f"could not load the SLO catalog: {e}",
+        )
+        return
+    if slospec is None:
+        return
+    for s in getattr(slospec, "CATALOG", ()):
+        if s.metric not in catalog:
+            yield Finding(
+                rule=CODE,
+                path=slo_relpath,
+                line=1,
+                col=1,
+                message=(
+                    f"SLO {s.name!r} references metric {s.metric!r} which "
+                    f"is not declared in {spec_relpath} — it would never "
+                    f"measure anything"
+                ),
+                context=f"slo:{s.name}",
+            )

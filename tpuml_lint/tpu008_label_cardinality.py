@@ -1,0 +1,129 @@
+"""TPU008 — metric label sets must be bounded and declared.
+
+The live ``/metrics`` endpoint (``runtime/opsplane.py``) serializes
+every labeled series on each scrape, and series live forever in the
+in-process registry. A call site that labels a metric with an unbounded
+value set — a per-request id, a user-supplied model name splatted from
+a dict — grows the registry without limit and turns the scrape into an
+O(cardinality) walk. This rule bounds cardinality *by declaration*:
+
+1. every label key passed at a recording call site
+   (``telemetry.counter("x").inc(model=...)`` and the ``gauge``/
+   ``histogram`` analogs) must be in the metric's declared
+   ``labels=(...)`` tuple in ``runtime/metricspec.py``;
+2. ``**dict`` splats at recording call sites are rejected outright —
+   a splatted label set cannot be statically bounded.
+
+Only the direct chained form (``telemetry.<kind>("name").<record>()``)
+is checked; a metric object stored in a variable first is out of scope
+(the repo convention is the chained form, and TPU007 already forces
+the name through the catalog). Label *values* remain free — the
+declared key set is the cardinality contract, matching how the
+Prometheus ecosystem bounds series growth.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, List, Optional, Tuple
+
+from .core import Finding, SourceFile, dotted_name, str_const
+from .envinfo import METRICSPEC_RELPATH, load_metricspec
+
+CODE = "TPU008"
+NAME = "metric-label-cardinality"
+
+_TELEMETRY_FNS = ("counter", "gauge", "histogram")
+_TELEMETRY_RELPATH = "spark_rapids_ml_tpu/runtime/telemetry.py"
+
+# recording method -> keyword params that are values, not labels
+_RECORD_FNS = {
+    "inc": {"by"},
+    "set": {"value"},
+    "observe": {"value"},
+}
+
+
+def _metric_call(
+    node: ast.AST, sf: SourceFile
+) -> Optional[Tuple[str, ast.Call]]:
+    """``(metric_name, registry_call)`` when ``node`` is a
+    ``telemetry.counter/gauge/histogram("literal")`` call."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = dotted_name(node.func)
+    if fn is None:
+        return None
+    leaf = fn.rsplit(".", 1)[-1]
+    if leaf not in _TELEMETRY_FNS:
+        return None
+    if not (
+        "telemetry" in fn
+        or (fn == leaf and sf.path == _TELEMETRY_RELPATH)
+    ):
+        return None
+    name = str_const(node.args[0]) if node.args else None
+    if not name:
+        return None
+    return name, node
+
+
+def _record_sites(
+    sf: SourceFile,
+) -> Iterator[Tuple[str, str, ast.Call]]:
+    """(metric name, record method, call node) for each chained
+    ``telemetry.<kind>("name").<inc|set|observe>(...)`` call."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        if func.attr not in _RECORD_FNS:
+            continue
+        base = _metric_call(func.value, sf)
+        if base is None:
+            continue
+        yield base[0], func.attr, node
+
+
+def check_project(files: List[SourceFile], repo_root: str) -> Iterator[Finding]:
+    spec_relpath = METRICSPEC_RELPATH.replace(os.sep, "/")
+    try:
+        metricspec = load_metricspec(repo_root)
+    except Exception:
+        return  # TPU007 reports the unloadable catalog; don't double up
+    catalog = metricspec.SPEC
+
+    for sf in files:
+        if sf.path == spec_relpath:
+            continue
+        for name, method, call in _record_sites(sf):
+            declared = catalog.get(name)
+            if declared is None:
+                continue  # undeclared name is TPU007's finding
+            allowed = tuple(getattr(declared, "labels", ()) or ())
+            value_params = _RECORD_FNS[method]
+            for kw in call.keywords:
+                if kw.arg is None:
+                    yield sf.finding(
+                        CODE, call,
+                        f"metric {name!r} is recorded with a **splat "
+                        f"label set — label cardinality cannot be "
+                        f"statically bounded",
+                        "pass each label as an explicit keyword from the "
+                        f"declared set {allowed!r}",
+                    )
+                    continue
+                if kw.arg in value_params:
+                    continue
+                if kw.arg not in allowed:
+                    yield sf.finding(
+                        CODE, call,
+                        f"metric {name!r} is recorded with undeclared "
+                        f"label {kw.arg!r} (declared labels: "
+                        f"{allowed!r})",
+                        f"add {kw.arg!r} to the metric's labels=() tuple "
+                        f"in {spec_relpath} or drop the label",
+                    )
