@@ -1839,6 +1839,93 @@ def bench_serving(mesh, n_chips):
                 "p50_ms": round(float(np.percentile(lat, 50)), 3),
                 "p99_ms": round(float(np.percentile(lat, 99)), 3),
             }
+
+        # C: overload sweep — offered load past measured capacity into a
+        # bounded-queue runtime with a per-request deadline: graceful
+        # degradation means goodput PLATEAUS past capacity (admission
+        # sheds absorb the excess, typed errors at submit) instead of
+        # collapsing under unbounded queue growth
+        deadline_ms = 250.0  # the serving_p99_ms SLO objective
+        overload_sweep = {}
+        q8 = rng.standard_normal((8, d)).astype(np.float32)
+        with ServingRuntime(
+            batch_window_us=2000, max_bucket_rows=64, queue_limit=32
+        ) as rt:
+            rt.register("rf", models["rf"])
+            # measured capacity: closed-loop burst (stays under the
+            # queue bound), no deadline — also primes the EWMA service
+            # model the deadline_unmeetable shed decision uses
+            t_c = time.perf_counter()
+            futs = [rt.predict_async("rf", q8) for _ in range(24)]
+            for f in futs:
+                f.result(600)
+            capacity_qps = 24 / max(time.perf_counter() - t_c, 1e-9)
+            for mult in (1, 2, 4):
+                offered = capacity_qps * mult
+                n_req = 96
+                shed = 0
+                rec = []  # (latency_ms, resolved_ok) at resolution
+                futs = []
+                with tele.span("serve.bench.overload", mult=mult):
+                    t_s = time.perf_counter()
+                    for i in range(n_req):
+                        # absolute schedule: sleep granularity must not
+                        # silently lower the offered rate
+                        lag = t_s + i / offered - time.perf_counter()
+                        if lag > 0:
+                            time.sleep(lag)
+                        t_req = time.perf_counter()
+                        try:
+                            f = rt.predict_async(
+                                "rf", q8, deadline_ms=deadline_ms
+                            )
+                        except Exception:
+                            shed += 1  # typed Overloaded at admission
+                            continue
+                        f.add_done_callback(
+                            lambda f_, t=t_req: rec.append((
+                                (time.perf_counter() - t) * 1e3,
+                                f_.exception() is None,
+                            ))
+                        )
+                        futs.append(f)
+                    for f in futs:
+                        try:
+                            f.result(600)
+                        except Exception:
+                            pass  # DeadlineExceeded while queued
+                    elapsed = time.perf_counter() - t_s
+                ok_lat = [l for l, good in rec if good]
+                missed = len(rec) - len(ok_lat)
+                overload_sweep[str(mult)] = {
+                    "offered_qps": round(offered, 1),
+                    "goodput_qps": round(len(ok_lat) / elapsed, 1),
+                    "shed_frac": round(shed / n_req, 4),
+                    "deadline_missed": missed,
+                    "admitted_p99_ms": (
+                        round(float(np.percentile(ok_lat, 99)), 3)
+                        if ok_lat else None
+                    ),
+                }
+
+        # degradation gates: past-capacity goodput must hold (plateau,
+        # not collapse), and what IS served must honor the deadline
+        top = overload_sweep[str(4)]
+        base = overload_sweep[str(1)]
+        if top["goodput_qps"] <= 0 or (
+            base["goodput_qps"] > 0
+            and top["goodput_qps"] < 0.35 * base["goodput_qps"]
+        ):
+            raise RuntimeError(
+                f"overload goodput collapsed past capacity: {overload_sweep}"
+            )
+        for mult, row in overload_sweep.items():
+            p99 = row["admitted_p99_ms"]
+            if p99 is not None and p99 > 1.5 * deadline_ms:
+                raise RuntimeError(
+                    f"admitted-request p99 {p99} ms at {mult}x offered load "
+                    f"is unbounded by the {deadline_ms} ms deadline"
+                )
     finally:
         ops.stop()
         os.environ.pop("TPUML_OPS_PORT", None)
@@ -1933,6 +2020,11 @@ def bench_serving(mesh, n_chips):
         "p99_series_models": sorted(
             {s["labels"].get("model") for s in p99_series}
         ),
+        "capacity_qps": round(capacity_qps, 1),
+        "overload_sweep": overload_sweep,
+        "overload_deadline_ms": deadline_ms,
+        "goodput_qps": overload_sweep[str(4)]["goodput_qps"],
+        "shed_frac": overload_sweep[str(4)]["shed_frac"],
     }
 
 
@@ -2322,7 +2414,8 @@ def _emit_line(results, meta, watchdog_tripped):
         "gang_lanes", "solves_per_sec", "vs_sequential", "seq_fit_seconds",
         "p50_ms", "p99_ms", "qps_sweep", "window_sweep", "retrace_storms",
         "serve_vs_direct", "setup_fit_seconds", "warm_seconds", "requests",
-        "p99_series_models",
+        "p99_series_models", "capacity_qps", "overload_sweep",
+        "overload_deadline_ms", "goodput_qps", "shed_frac",
     )
     for name, r in results.items():
         line[name] = {

@@ -960,4 +960,145 @@ assert "stream.fold" in names, sorted(names)[:20]
 print(f"crash-dump smoke OK: {shards[0]} with {len(names)} span sites")
 EOF
 
+echo "== serving chaos smoke =="
+# Fault-injected serving (docs/serving.md resilience contract): an OOM
+# dispatch splits the group and retries halves bit-identically, repeated
+# dispatch faults trip the per-model breaker (fast-fail at admission,
+# half-open probe closes it again), every future resolves — no hangs —
+# and drain() reports a clean flush.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import concurrent.futures
+import os
+import time
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.runtime import faults, telemetry
+from spark_rapids_ml_tpu.serving import Overloaded, ServingRuntime
+
+rng = np.random.default_rng(23)
+X = rng.normal(size=(256, 10)).astype(np.float32)
+model = PCA(k=3).fit(DataFrame({"features": X}))
+
+# dispatch 0 = the coalesced 4-request group (oom -> halve), 3/4 = the
+# two singleton dispatches after the halves (1/2) -> breaker opens
+os.environ["TPUML_FAULT_SPEC"] = (
+    "serve:dispatch:0:oom,serve:dispatch:3:raise,serve:dispatch:4:raise"
+)
+faults.reset_faults()
+telemetry.reset_telemetry()
+queries = [rng.normal(size=(2, 10)).astype(np.float32) for _ in range(4)]
+with ServingRuntime(
+    batch_window_us=30_000, max_bucket_rows=64,
+    breaker_fails=2, breaker_cooldown_ms=200,
+) as rt:
+    rt.register("pca", model)
+    # one coalesced group; the injected RESOURCE_EXHAUSTED must be
+    # absorbed by halving, outputs bit-identical to direct transforms
+    futs = [rt.predict_async("pca", q) for q in queries]
+    for q, f in zip(queries, futs):
+        out = f.result(120)
+        direct = model.transform(DataFrame({"features": q}))
+        for col, served in out.items():
+            assert np.array_equal(served, np.asarray(direct[col])), col
+    # two injected dispatch faults -> breaker opens -> typed fast-fail
+    for _ in range(2):
+        try:
+            rt.predict("pca", queries[0])
+            raise AssertionError("injected dispatch fault did not surface")
+        except RuntimeError as e:
+            assert "injected" in str(e).lower(), e
+    assert rt.breaker_states() == {"pca": "open"}, rt.breaker_states()
+    try:
+        rt.predict("pca", queries[0])
+        raise AssertionError("open breaker admitted a request")
+    except Overloaded as e:
+        assert e.reason == "breaker_open", e.reason
+    time.sleep(0.3)  # past cooldown: half-open probe succeeds -> closed
+    rt.predict("pca", queries[0])
+    assert rt.breaker_states() == {"pca": "closed"}, rt.breaker_states()
+    report = rt.drain(timeout=30)
+    assert report == {"drained": True, "aborted": 0}, report
+    done, not_done = concurrent.futures.wait(futs, timeout=0)
+    assert not not_done, not_done
+
+snap = telemetry.metrics_snapshot()
+inj = {s["labels"]["kind"]: s["value"]
+       for s in snap["fault_injections"]["series"]}
+assert inj == {"oom": 1, "raise": 2}, inj
+assert "serve_breaker_state" in snap, sorted(snap)
+shed = {(s["labels"]["model"], s["labels"]["reason"]): s["value"]
+        for s in snap["serve_shed_total"]["series"]}
+assert shed == {("pca", "breaker_open"): 1}, shed
+del os.environ["TPUML_FAULT_SPEC"]
+print("serving chaos smoke OK: oom halved bit-identically, breaker "
+      "open->half-open->closed, drain clean, zero hung futures")
+EOF
+
+echo "== serving overload smoke =="
+# Overload contract under trace: offered load past measured capacity
+# into a tiny bounded queue must shed (typed, counted) while goodput
+# stays positive and the retrace-storm gate holds.
+rm -rf /tmp/tpuml_trace_overload
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import time
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.runtime import telemetry
+from spark_rapids_ml_tpu.serving import Overloaded, ServingRuntime
+
+rng = np.random.default_rng(29)
+X = rng.normal(size=(256, 10)).astype(np.float32)
+model = PCA(k=3).fit(DataFrame({"features": X}))
+q = rng.normal(size=(8, 10)).astype(np.float32)
+
+os.environ["TPUML_TRACE"] = "/tmp/tpuml_trace_overload"
+telemetry.reset_telemetry()
+with ServingRuntime(
+    batch_window_us=1000, max_bucket_rows=32, queue_limit=4
+) as rt:
+    rt.register("pca", model)
+    # closed-loop capacity probe (stays under the queue bound)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        for f in [rt.predict_async("pca", q) for _ in range(4)]:
+            f.result(120)
+    capacity_qps = 12 / max(time.perf_counter() - t0, 1e-9)
+    offered = 2 * capacity_qps
+    ok = shed = 0
+    futs = []
+    t0 = time.perf_counter()
+    for i in range(200):
+        lag = t0 + i / offered - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        try:
+            futs.append(rt.predict_async("pca", q))
+        except Overloaded as e:
+            assert e.reason == "queue_full", e.reason
+            shed += 1
+    for f in futs:
+        f.result(120)
+        ok += 1
+    elapsed = time.perf_counter() - t0
+
+snap = telemetry.metrics_snapshot()
+storms = snap.get("retrace_storms")
+assert not storms or all(s["value"] == 0 for s in storms["series"]), storms
+sheds = {s["labels"]["reason"]: s["value"]
+         for s in snap["serve_shed_total"]["series"]}
+assert shed > 0 and sheds.get("queue_full") == shed, (shed, sheds)
+goodput = ok / elapsed
+assert ok > 0 and goodput > 0, (ok, elapsed)
+del os.environ["TPUML_TRACE"]
+print(f"serving overload smoke OK: {shed}/200 shed at 2x capacity, "
+      f"goodput {goodput:.0f} qps, 0 retrace storms")
+EOF
+
 echo "CI OK"

@@ -139,6 +139,8 @@ _STATIC_FIELDS = (
     ("mfu", -1),
     ("p99_ms", +1),           # serving tail latency: growth is a failure
     ("serve_batch_fill", -1),  # fill collapse = micro-batching regression
+    ("goodput_qps", -1),      # overload goodput collapse = shedding broke
+    ("shed_frac", +1),        # shedding more at the same offered load
 )
 
 _QPS_FIELD_RE = re.compile(r"^qps_sweep\[(.+)\]\.p99_ms$")

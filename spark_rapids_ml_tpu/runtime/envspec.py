@@ -427,6 +427,47 @@ SPEC: Dict[str, EnvVar] = _registry(
         category="serving",
         also_documented_in=("docs/serving.md",),
     ),
+    EnvVar(
+        "TPUML_SERVE_DEFAULT_DEADLINE_MS", "float", None,
+        "Default per-request deadline in milliseconds for "
+        "`ServingRuntime.predict(..., deadline_ms=)` callers that pass "
+        "none. A request whose deadline expires while queued is failed "
+        "with a typed `DeadlineExceeded` *before* padding/dispatch, and "
+        "admission sheds (`deadline_unmeetable`) when the estimated "
+        "wait already exceeds the deadline. Unset = no deadline: "
+        "requests wait indefinitely, exactly the pre-deadline behavior.",
+        exclusive_minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_SERVE_QUEUE_LIMIT", "int", None,
+        "Bound on queued (admitted, not yet dispatched) serving "
+        "requests. Enqueues past the bound are rejected with a typed "
+        "`Overloaded` (counted on `serve_shed_total{reason=queue_full}`)"
+        " instead of growing the queue without limit. Unset = unbounded "
+        "queue, the pre-admission behavior.",
+        minimum=1, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_SERVE_BREAKER_FAILS", "int", 0,
+        "Consecutive dispatch failures that trip a model's circuit "
+        "breaker from closed to open; while open, requests for that "
+        "model fast-fail at admission (`serve_shed_total{reason="
+        "breaker_open}`) and `/readyz` reports 503. `0` (default) "
+        "disables the breaker entirely.",
+        minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_SERVE_BREAKER_COOLDOWN_MS", "float", 1000.0,
+        "How long an open circuit breaker blocks before moving to "
+        "half-open and admitting a single probe request; the probe's "
+        "outcome closes (success) or re-opens (failure) the breaker. "
+        "Only read when `TPUML_SERVE_BREAKER_FAILS` > 0.",
+        exclusive_minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
     # --- CI / notebooks ---------------------------------------------------
     EnvVar(
         "TPUML_NB_CPU", "bool", False,
@@ -473,8 +514,9 @@ SPEC: Dict[str, EnvVar] = _registry(
         "TPUML_FAULT_SPEC", "str", "",
         "Deterministic fault injection for resilience testing: comma-"
         "separated `scope:point:index:action` entries (`ingest:chunk` / "
-        "`sgd:epoch` / `init:connect` sites; `raise`/`preempt`/`oom` "
-        "actions; 0-based per-site hit index, each entry fires once).",
+        "`sgd:epoch` / `init:connect` / `serve:admit` / `serve:dispatch` "
+        "/ `serve:transfer` sites; `raise`/`preempt`/`oom` actions; "
+        "0-based per-site hit index, each entry fires once).",
         category="resilience",
         also_documented_in=("docs/fault_tolerance.md",),
     ),

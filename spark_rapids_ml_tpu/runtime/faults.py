@@ -5,8 +5,10 @@
     scope:point:index:action
 
 where ``scope:point`` names an instrumented site (``ingest:chunk``,
-``sgd:epoch``, ``init:connect``), ``index`` is the 0-based hit count at
-that site on which the fault fires, and ``action`` is one of
+``sgd:epoch``, ``init:connect``, and the serving plane's
+``serve:admit`` / ``serve:dispatch`` / ``serve:transfer``), ``index``
+is the 0-based hit count at that site on which the fault fires, and
+``action`` is one of
 
 - ``raise``   — raise :class:`InjectedFault` (a generic hard error),
 - ``preempt`` — raise :class:`SimulatedPreemption` (terminal: the retry
@@ -32,7 +34,12 @@ from typing import Dict, List, Optional, Tuple
 
 from . import envspec
 
-SITES = ("ingest:chunk", "sgd:epoch", "init:connect")
+SITES = (
+    "ingest:chunk", "sgd:epoch", "init:connect",
+    # serving plane (hit per admission attempt / group dispatch /
+    # device->host result fetch — see serving/runtime.py)
+    "serve:admit", "serve:dispatch", "serve:transfer",
+)
 ACTIONS = ("raise", "preempt", "oom")
 
 

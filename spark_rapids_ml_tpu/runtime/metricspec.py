@@ -221,6 +221,38 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "smoke assert on.",
         labels=("model",),
     ),
+    # --- serving resilience (PR 14) ---------------------------------------
+    MetricSpec(
+        "serve_shed_total", "counter",
+        "Requests rejected at admission by `serving.ServingRuntime`, "
+        "labeled by model and shed reason (`queue_full` | "
+        "`deadline_unmeetable` | `breaker_open` | `draining`); the "
+        "typed `Overloaded`/`ShuttingDown` raise is the caller-visible "
+        "side of each increment.",
+        labels=("model", "reason"),
+    ),
+    MetricSpec(
+        "serve_deadline_miss_total", "counter",
+        "Admitted requests whose deadline expired while queued — failed "
+        "with `DeadlineExceeded` before padding/dispatch (device time is "
+        "never spent on a request that already missed), labeled by "
+        "model name.",
+        labels=("model",),
+    ),
+    MetricSpec(
+        "serve_dispatch_errors_total", "counter",
+        "Unexpected exceptions that escaped a serving dispatch batch; "
+        "each one fails that batch's futures and restarts the dispatch "
+        "loop instead of killing the serve thread. Nonzero in steady "
+        "state means a bug (or injected `serve:*` fault), not load.",
+    ),
+    MetricSpec(
+        "serve_breaker_state", "gauge",
+        "Per-model circuit-breaker state (0 closed, 1 half-open, 2 "
+        "open), labeled by model name; exported to `/statusz` and an "
+        "open breaker flips `/readyz` to 503.",
+        labels=("model",),
+    ),
     MetricSpec(
         "fault_injections", "counter",
         "Faults raised by the `runtime/faults.py` injection hooks "

@@ -326,6 +326,16 @@ def _live(refs: List["weakref.ref[Any]"]) -> List[Any]:
 # --------------------------------------------------------------------------
 
 
+# a dispatcher with queued work that has not beaten for this long is
+# reported stalled (the idle beat is ~1 Hz, so this is ~30 missed
+# beats — far past any sane batch window, short of a long cold compile)
+DISPATCHER_STALL_S = 30.0
+
+# how long the SIGTERM handler lets each serving runtime drain before
+# dumping the flight recorder and chaining to the previous disposition
+SIGTERM_DRAIN_TIMEOUT_S = 5.0
+
+
 def _readiness() -> Tuple[bool, List[str]]:
     reasons: List[str] = []
     storms = telemetry.counter("retrace_storms").value()
@@ -343,6 +353,34 @@ def _readiness() -> Tuple[bool, List[str]]:
                 if m.get("pending_buckets")
             }
             reasons.append(f"warmup_pending={json.dumps(pending)}")
+    for rt in _live(_RUNTIMES):
+        try:
+            if rt.is_closed():
+                continue  # a cleanly closed runtime is not a fault
+            if rt.is_draining():
+                reasons.append("serving_draining")
+            elif rt.dispatcher_started() and not rt.dispatcher_alive():
+                reasons.append("serve_dispatcher_dead")
+            else:
+                age = rt.heartbeat_age_s()
+                if (
+                    age is not None
+                    and age > DISPATCHER_STALL_S
+                    and rt.queue_depth() > 0
+                ):
+                    reasons.append(
+                        f"serve_dispatcher_stalled_age_s={age:.1f}"
+                    )
+            open_breakers = sorted(
+                m for m, state in rt.breaker_states().items()
+                if state == "open"
+            )
+            if open_breakers:
+                reasons.append(
+                    f"breaker_open={json.dumps(open_breakers)}"
+                )
+        except Exception:
+            continue
     return (not reasons, reasons)
 
 
@@ -396,6 +434,29 @@ def _statusz() -> Dict[str, Any]:
             }
             for s in _series("serve_p99_ms")
         ],
+        "draining": [rt.is_draining() for rt in _live(_RUNTIMES)],
+        "dispatcher_alive": [
+            rt.dispatcher_alive() for rt in _live(_RUNTIMES)
+        ],
+        "breakers": {
+            model: state
+            for rt in _live(_RUNTIMES)
+            for model, state in rt.breaker_states().items()
+        },
+        "shed_total": {
+            "{}/{}".format(
+                s["labels"].get("model", "?"),
+                s["labels"].get("reason", "?"),
+            ): s.get("value")
+            for s in _series("serve_shed_total")
+        },
+        "deadline_miss_total": {
+            s["labels"].get("model", "?"): s.get("value")
+            for s in _series("serve_deadline_miss_total")
+        },
+        "dispatch_errors": (
+            telemetry.counter("serve_dispatch_errors_total").value() or 0
+        ),
     }
     gang = {
         "dispatches": telemetry.counter("gang_dispatches").value() or 0,
@@ -517,6 +578,15 @@ def _atexit_dump() -> None:
 
 
 def _on_sigterm(signum: int, frame: Any) -> None:
+    # graceful serving drain FIRST (admission stops, /readyz flips 503,
+    # in-flight work flushes, every future resolves typed) so the
+    # flight dump below captures the post-drain state; bounded — a
+    # wedged dispatcher cannot stall process death past the timeout
+    for rt in _live(_RUNTIMES):
+        try:
+            rt.drain(timeout=SIGTERM_DRAIN_TIMEOUT_S)
+        except Exception:
+            pass
     rec = _RECORDER
     if rec is not None:
         try:

@@ -79,10 +79,16 @@ def with_retries(
     retries: Optional[int] = None,
     backoff_ms: Optional[float] = None,
     retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    giveup: Optional[Callable[[BaseException], bool]] = None,
     seed: int = 0,
     sleep: Callable[[float], None] = time.sleep,
 ) -> _T:
     """Call ``fn`` with up to ``retries`` re-attempts on transient failure.
+
+    ``giveup`` classifies errors that retrying at the same shape cannot
+    fix (e.g. ``is_resource_exhausted``) — they re-raise immediately so
+    the caller's degradation path (chunk/group halving) runs instead of
+    burning the backoff budget on a deterministic failure.
 
     With the default env (``TPUML_RETRIES`` unset/0) this is exactly one
     ``fn()`` call — no sleeps, no counter traffic, no behavior change.
@@ -100,6 +106,8 @@ def with_retries(
         except SimulatedPreemption:
             raise  # terminal by contract: survived via checkpoint, not retry
         except retry_on as exc:
+            if giveup is not None and giveup(exc):
+                raise
             last = exc
             if attempt >= budget:
                 break

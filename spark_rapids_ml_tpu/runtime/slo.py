@@ -104,6 +104,32 @@ CATALOG: Tuple[SLOSpec, ...] = _catalog(
             "exceeds 4x and the window/ladder need retuning.",
     ),
     SLOSpec(
+        name="serving_shed_rate",
+        metric="serve_shed_total",
+        measure="window_delta",
+        objective=0.0,
+        sense="max",
+        error_budget=0.10,
+        doc="Load-shed error budget: shedding is the runtime working as "
+            "designed under a transient burst, so single-tick sheds are "
+            "tolerated — sustained shedding (>= 10% of ticks seeing new "
+            "`serve_shed_total` increments across both burn windows) "
+            "means offered load or a stuck breaker has outrun capacity, "
+            "and trips the burn alert + one-shot flight dump.",
+    ),
+    SLOSpec(
+        name="serving_deadline_miss",
+        metric="serve_deadline_miss_total",
+        measure="window_delta",
+        objective=0.0,
+        sense="max",
+        error_budget=0.05,
+        doc="Deadline-miss budget: an admitted request that then missed "
+            "its deadline in queue is worse than a shed one (the caller "
+            "waited for nothing), so the budget is tighter — 5% of "
+            "ticks.",
+    ),
+    SLOSpec(
         name="fit_retrace_storms",
         metric="retrace_storms",
         measure="window_delta",

@@ -5,8 +5,22 @@ Everything here is explicitly constructed — importing the package (or
 the library) starts no thread, opens no file, and reads no
 ``TPUML_SERVE_*`` variable; the batch fit/transform paths are untouched
 (see ``docs/serving.md``).
+
+The typed error surface (``docs/serving.md#resilience``): every way a
+request can fail without a model result is a distinct
+:class:`ServingError` subclass — :class:`DeadlineExceeded` (deadline
+passed while queued), :class:`Overloaded` (shed at admission, with a
+``reason``), :class:`ShuttingDown` (runtime draining or closed).
 """
 
+from .admission import (
+    AdmissionController,
+    CircuitBreaker,
+    DeadlineExceeded,
+    Overloaded,
+    ServingError,
+    ShuttingDown,
+)
 from .registry import (
     ModelRegistry,
     ResidentModel,
@@ -17,9 +31,15 @@ from .registry import (
 from .runtime import ServingRuntime
 
 __all__ = [
+    "AdmissionController",
+    "CircuitBreaker",
+    "DeadlineExceeded",
     "ModelRegistry",
+    "Overloaded",
     "ResidentModel",
+    "ServingError",
     "ServingRuntime",
+    "ShuttingDown",
     "feature_width",
     "resident_nbytes",
     "serving_family",

@@ -1,0 +1,263 @@
+"""Admission control, load shedding, and per-model circuit breakers.
+
+The serving dispatcher is a single thread draining one queue; under
+overload the only two honest options are *bounded wait* or *typed
+rejection*. This module implements the rejection side:
+
+- ``AdmissionController.admit`` runs at enqueue time and raises
+  :class:`Overloaded` when the request cannot be served within its
+  contract — the queue is full (``TPUML_SERVE_QUEUE_LIMIT``), the
+  estimated wait (queue depth x EWMA batch service time, tracked per
+  model) already exceeds the request deadline, or the model's circuit
+  breaker is open. Every rejection is counted on
+  ``serve_shed_total{model,reason}``.
+- ``CircuitBreaker`` isolates a persistently failing model: after
+  ``TPUML_SERVE_BREAKER_FAILS`` *consecutive* dispatch failures the
+  breaker opens and requests fast-fail at admission instead of queueing
+  behind a broken ``fn``; after ``TPUML_SERVE_BREAKER_COOLDOWN_MS`` one
+  probe request is let through (half-open) — success closes the
+  breaker, failure re-opens it. State is exported on the
+  ``serve_breaker_state`` gauge (0 closed / 1 half-open / 2 open).
+
+Everything here is defaults-inert: with no ``TPUML_SERVE_*`` env set
+and no per-request deadline, ``admit`` returns without taking a lock
+beyond its own and no metric is touched — behavior is bit-identical to
+an unbounded queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..runtime import envspec, telemetry
+
+# breaker states (gauge values on serve_breaker_state)
+CLOSED = 0
+HALF_OPEN = 1
+OPEN = 2
+
+_STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
+
+# EWMA smoothing for batch service time / batch size: ~5-batch memory,
+# fast enough to track a load shift within one batch window burst
+_ALPHA = 0.2
+
+
+class ServingError(RuntimeError):
+    """Base of the typed serving error surface. Subclasses RuntimeError
+    so pre-existing callers catching RuntimeError keep working."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline expired before dispatch (never after a
+    result was computed — expiry is checked *before* padding/dispatch)."""
+
+
+class Overloaded(ServingError):
+    """Rejected at admission; ``reason`` is the shed-metric label
+    (``queue_full`` | ``deadline_unmeetable`` | ``breaker_open``)."""
+
+    def __init__(self, message: str, reason: str) -> None:
+        super().__init__(message)
+        self.reason = reason
+
+
+class ShuttingDown(ServingError):
+    """The runtime is closed or draining. The message always contains
+    "closed" — callers matching the pre-typed RuntimeError still match."""
+
+    def __init__(self, message: str = "ServingRuntime is closed") -> None:
+        super().__init__(message)
+
+
+class CircuitBreaker:
+    """Per-model consecutive-failure breaker. Thread-safe; owned by the
+    AdmissionController (admission thread) and poked by the dispatcher
+    (record_success/record_failure), so every transition is locked."""
+
+    def __init__(self, model: str, fails: int, cooldown_s: float) -> None:
+        self.model = model
+        self.fails = int(fails)  # 0 = disabled
+        self.cooldown_s = float(cooldown_s)
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.fails > 0
+
+    def _set_state(self, state: int) -> None:
+        self._state = state
+        telemetry.gauge("serve_breaker_state").set(state, model=self.model)
+
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def state_name(self) -> str:
+        return _STATE_NAMES[self.state()]
+
+    def allow(self) -> bool:
+        """Admission-side check. Open blocks; after the cooldown the
+        breaker moves to half-open and admits exactly one probe."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if time.monotonic() - self._opened_at < self.cooldown_s:
+                    return False
+                self._set_state(HALF_OPEN)
+                return True
+            # HALF_OPEN: one probe is already in flight; block the rest
+            # until the dispatcher reports its outcome
+            return False
+
+    def record_success(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._consecutive = 0
+            if self._state != CLOSED:
+                self._set_state(CLOSED)
+
+    def record_failure(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # the probe failed: straight back to open, fresh cooldown
+                self._opened_at = time.monotonic()
+                self._set_state(OPEN)
+                return
+            self._consecutive += 1
+            if self._state == CLOSED and self._consecutive >= self.fails:
+                self._opened_at = time.monotonic()
+                self._set_state(OPEN)
+
+
+class AdmissionController:
+    """Enqueue-time gatekeeper plus the per-model service-time model
+    the wait estimate and deadline checks are built on."""
+
+    def __init__(
+        self,
+        queue_limit: Optional[int] = None,
+        breaker_fails: Optional[int] = None,
+        breaker_cooldown_ms: Optional[float] = None,
+    ) -> None:
+        self.queue_limit = (
+            envspec.get("TPUML_SERVE_QUEUE_LIMIT")
+            if queue_limit is None else int(queue_limit)
+        )
+        self.breaker_fails = int(
+            envspec.get("TPUML_SERVE_BREAKER_FAILS")
+            if breaker_fails is None else breaker_fails
+        )
+        self.breaker_cooldown_s = float(
+            envspec.get("TPUML_SERVE_BREAKER_COOLDOWN_MS")
+            if breaker_cooldown_ms is None else breaker_cooldown_ms
+        ) / 1e3
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        # per-model EWMA of (batch service seconds, requests per batch):
+        # estimated wait = queued requests / reqs-per-batch * service
+        self._ewma: Dict[str, Tuple[float, float]] = {}
+
+    # -- service-time model ------------------------------------------------
+    def note_batch(self, model: str, service_s: float, n_reqs: int) -> None:
+        """Dispatcher callback after a successful group dispatch."""
+        with self._lock:
+            prev = self._ewma.get(model)
+            if prev is None:
+                self._ewma[model] = (float(service_s), float(n_reqs))
+            else:
+                s, r = prev
+                self._ewma[model] = (
+                    _ALPHA * float(service_s) + (1 - _ALPHA) * s,
+                    _ALPHA * float(n_reqs) + (1 - _ALPHA) * r,
+                )
+
+    def service_estimate_s(self, model: str) -> Optional[float]:
+        """EWMA seconds one dispatched batch of ``model`` takes, or
+        None before any batch has been observed."""
+        with self._lock:
+            ew = self._ewma.get(model)
+        return None if ew is None else ew[0]
+
+    def estimated_wait_s(self, model: str, queue_depth: int) -> Optional[float]:
+        """Expected queueing delay for a request arriving now, behind
+        ``queue_depth`` already-admitted requests. None = no data yet
+        (first batches are never shed on the deadline estimate)."""
+        with self._lock:
+            ew = self._ewma.get(model)
+        if ew is None:
+            return None
+        service_s, reqs_per_batch = ew
+        batches = queue_depth / max(reqs_per_batch, 1.0)
+        return batches * service_s
+
+    # -- breakers ----------------------------------------------------------
+    def breaker(self, model: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(model)
+            if b is None:
+                b = CircuitBreaker(
+                    model, self.breaker_fails, self.breaker_cooldown_s
+                )
+                self._breakers[model] = b
+            return b
+
+    def breaker_states(self) -> Dict[str, str]:
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {m: b.state_name() for m, b in breakers.items()}
+
+    def breakers_open(self) -> bool:
+        with self._lock:
+            breakers = list(self._breakers.values())
+        return any(b.state() == OPEN for b in breakers)
+
+    # -- the gate ----------------------------------------------------------
+    def shed(self, model: str, reason: str, message: str) -> None:
+        telemetry.counter("serve_shed_total").inc(1, model=model, reason=reason)
+        raise Overloaded(message, reason=reason)
+
+    def admit(
+        self,
+        model: str,
+        queue_depth: int,
+        deadline_remaining_s: Optional[float],
+    ) -> None:
+        """Raise :class:`Overloaded` if the request must be shed;
+        return normally to admit. Checked in failure-isolation order:
+        breaker first (a broken model sheds regardless of load), then
+        queue bound, then the deadline feasibility estimate."""
+        if not self.breaker(model).allow():
+            self.shed(
+                model, "breaker_open",
+                f"circuit breaker open for model {model!r} "
+                f"(cooldown {self.breaker_cooldown_s * 1e3:.0f} ms)",
+            )
+        if self.queue_limit is not None and queue_depth >= self.queue_limit:
+            self.shed(
+                model, "queue_full",
+                f"serving queue full ({queue_depth} >= "
+                f"TPUML_SERVE_QUEUE_LIMIT={self.queue_limit})",
+            )
+        if deadline_remaining_s is not None:
+            est = self.estimated_wait_s(model, queue_depth)
+            if deadline_remaining_s <= 0 or (
+                est is not None and est > deadline_remaining_s
+            ):
+                self.shed(
+                    model, "deadline_unmeetable",
+                    f"estimated wait {0.0 if est is None else est * 1e3:.1f} ms"
+                    f" exceeds remaining deadline "
+                    f"{deadline_remaining_s * 1e3:.1f} ms for model {model!r}",
+                )

@@ -470,7 +470,12 @@ class ModelRegistry:
         """Compile every padded bucket shape of ``entry`` now, each
         under its own ``serve.warmup.<name>.b<bucket>`` span site, so no
         steady-state dispatch ever carries a compile (and no single
-        site accumulates enough to trip the retrace watchdog)."""
+        site accumulates enough to trip the retrace watchdog). Warmup
+        compiles retry per ``TPUML_RETRIES`` (default 0 = single
+        attempt) — a transient allocator hiccup at load time should not
+        keep a model out of the registry."""
+        from ..runtime import retry
+
         probe_row = np.zeros((1, entry.n_features), dtype=np.float32)
         for bucket in self.bucket_ladder():
             if bucket in entry.warmed:
@@ -478,9 +483,15 @@ class ModelRegistry:
             Xw = np.broadcast_to(
                 probe_row, (bucket, entry.n_features)
             ).copy()
-            with telemetry.span(
-                f"serve.warmup.{entry.name}.b{bucket}",
-                bucket=bucket, warmup=True,
-            ):
-                entry.fn(Xw)
+
+            def _compile_bucket(bucket: int = bucket, Xw: np.ndarray = Xw) -> None:
+                with telemetry.span(
+                    f"serve.warmup.{entry.name}.b{bucket}",
+                    bucket=bucket, warmup=True,
+                ):
+                    entry.fn(Xw)
+
+            retry.with_retries(
+                _compile_bucket, what=f"serve:warm:{entry.name}:b{bucket}"
+            )
             entry.warmed.add(bucket)

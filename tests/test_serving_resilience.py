@@ -1,0 +1,585 @@
+"""Overload-safe serving: deadlines fail typed before dispatch,
+admission sheds (queue bound / unmeetable deadline / open breaker) with
+`Overloaded`, the per-model circuit breaker cycles
+closed -> open -> half-open -> closed under injected `serve:dispatch`
+faults, RESOURCE_EXHAUSTED group dispatch halves at exact shapes
+bit-identically, drain/close resolve every outstanding future
+(`ShuttingDown`, zero hangs — including a close/predict race storm),
+the dispatcher survives unexpected dispatch exceptions, and the whole
+admission plane is defaults-inert.
+"""
+
+import concurrent.futures
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.runtime import envspec, faults, opsplane, retry, telemetry
+from spark_rapids_ml_tpu.serving import (
+    AdmissionController,
+    CircuitBreaker,
+    DeadlineExceeded,
+    Overloaded,
+    ServingError,
+    ServingRuntime,
+    ShuttingDown,
+)
+from spark_rapids_ml_tpu.serving.runtime import _Request
+
+N, D = 400, 10
+SEED = 7
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset_telemetry()
+    faults.reset_faults()
+    yield
+    telemetry.reset_telemetry()
+    faults.reset_faults()
+
+
+@pytest.fixture(scope="module")
+def pca_model():
+    rng = np.random.default_rng(SEED)
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    return PCA(k=4).fit(DataFrame({"features": X}))
+
+
+def _q(rng, rows):
+    return rng.normal(size=(rows, D)).astype(np.float32)
+
+
+def _slow_entry(rt, name, delay_s):
+    """Wrap a registered entry's transform with a sleep so the
+    dispatcher stays busy long enough to build a queue behind it."""
+    entry = rt.registry.get(name)
+    inner = entry.fn
+
+    def slow(X):
+        time.sleep(delay_s)
+        return inner(X)
+
+    entry.fn = slow
+    return entry
+
+
+def _wait_until(cond, timeout=30.0, interval=0.002):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# --- unit: breaker + admission ---------------------------------------------
+
+
+def test_circuit_breaker_cycle():
+    b = CircuitBreaker("m", fails=2, cooldown_s=0.05)
+    assert b.state_name() == "closed"
+    b.record_failure()
+    assert b.allow() and b.state_name() == "closed"
+    b.record_failure()  # second consecutive: trips
+    assert b.state_name() == "open"
+    assert not b.allow()
+    time.sleep(0.06)
+    assert b.allow()  # cooldown elapsed: half-open probe admitted
+    assert b.state_name() == "half_open"
+    assert not b.allow()  # only ONE probe at a time
+    b.record_failure()  # probe failed: straight back to open
+    assert b.state_name() == "open"
+    time.sleep(0.06)
+    assert b.allow()
+    b.record_success()  # probe succeeded: closed, counter reset
+    assert b.state_name() == "closed"
+    b.record_failure()
+    b.record_success()  # success resets the consecutive count
+    b.record_failure()
+    assert b.state_name() == "closed"
+
+
+def test_circuit_breaker_disabled_is_inert():
+    b = CircuitBreaker("m", fails=0, cooldown_s=0.01)
+    for _ in range(10):
+        b.record_failure()
+        assert b.allow()
+    assert b.state_name() == "closed"
+    assert telemetry.metrics_snapshot().get("serve_breaker_state") is None
+
+
+def test_admission_queue_full_and_deadline_unmeetable():
+    adm = AdmissionController(queue_limit=2, breaker_fails=0)
+    adm.admit("m", 1, None)
+    with pytest.raises(Overloaded) as ei:
+        adm.admit("m", 2, None)
+    assert ei.value.reason == "queue_full"
+    # prime the service-time model: ~100 ms per single-request batch
+    for _ in range(5):
+        adm.note_batch("m", 0.1, 1)
+    assert 0.05 < adm.service_estimate_s("m") < 0.2
+    with pytest.raises(Overloaded) as ei:
+        adm.admit("m", 1, 0.01)  # ~100 ms wait vs 10 ms budget
+    assert ei.value.reason == "deadline_unmeetable"
+    adm.admit("m", 1, 10.0)  # generous deadline passes
+    adm.admit("m", 1, None)  # no deadline: never shed on the estimate
+    shed = telemetry.metrics_snapshot()["serve_shed_total"]["series"]
+    reasons = {s["labels"]["reason"] for s in shed}
+    assert reasons == {"queue_full", "deadline_unmeetable"}
+
+
+def test_admission_defaults_admit_everything():
+    adm = AdmissionController()
+    assert adm.queue_limit is None and adm.breaker_fails == 0
+    for depth in (0, 10, 100_000):
+        adm.admit("m", depth, None)
+    assert telemetry.metrics_snapshot().get("serve_shed_total") is None
+
+
+def test_serve_fault_sites_registered():
+    entries = faults.parse_fault_spec(
+        "serve:admit:0:raise,serve:dispatch:1:oom,serve:transfer:2:preempt"
+    )
+    assert entries == [
+        ("serve:admit", 0, "raise"),
+        ("serve:dispatch", 1, "oom"),
+        ("serve:transfer", 2, "preempt"),
+    ]
+
+
+def test_retry_giveup_skips_backoff():
+    calls = {"n": 0}
+
+    def oom():
+        calls["n"] += 1
+        raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        retry.with_retries(
+            oom, what="t", retries=5, backoff_ms=1,
+            giveup=retry.is_resource_exhausted,
+        )
+    assert calls["n"] == 1  # no re-attempt at a shape that cannot fit
+
+
+def test_new_env_vars_validate():
+    with pytest.raises(envspec.EnvSpecError, match="must be >= 1"):
+        envspec.parse("TPUML_SERVE_QUEUE_LIMIT", "0")
+    with pytest.raises(envspec.EnvSpecError, match="must be >"):
+        envspec.parse("TPUML_SERVE_DEFAULT_DEADLINE_MS", "0")
+    with pytest.raises(envspec.EnvSpecError, match="must be >="):
+        envspec.parse("TPUML_SERVE_BREAKER_FAILS", "-1")
+    assert envspec.parse("TPUML_SERVE_QUEUE_LIMIT", None) is None
+    assert envspec.parse("TPUML_SERVE_DEFAULT_DEADLINE_MS", None) is None
+    assert envspec.parse("TPUML_SERVE_BREAKER_FAILS", None) == 0
+    assert envspec.parse("TPUML_SERVE_BREAKER_COOLDOWN_MS", None) == 1000.0
+
+
+def test_packer_is_edf_within_arrival_order(pca_model):
+    """Tight deadlines sort to the front of the pack; no-deadline
+    requests keep arrival order behind them (stable sort)."""
+    rng = np.random.default_rng(3)
+    now = time.perf_counter()
+
+    def req(rows, dl):
+        return _Request(
+            name="m", X=_q(rng, rows), future=concurrent.futures.Future(),
+            deadline=None if dl is None else now + dl,
+        )
+
+    reqs = [req(2, None), req(2, 5.0), req(2, 1.0), req(2, None)]
+    rt = ServingRuntime(batch_window_us=0, max_bucket_rows=64)
+    try:
+        groups = rt._group(SimpleNamespace(coalesce=True), reqs)
+    finally:
+        rt.close()
+    packed = [r for g in groups for r in g]
+    assert [r.deadline for r in packed[:2]] == [
+        reqs[2].deadline, reqs[1].deadline
+    ]
+    assert packed[2:] == [reqs[0], reqs[3]]
+
+
+# --- deadlines --------------------------------------------------------------
+
+
+def test_deadline_expires_in_queue(pca_model):
+    """A request whose deadline passes while the dispatcher is busy is
+    failed typed BEFORE dispatch and counted as a deadline miss."""
+    rng = np.random.default_rng(5)
+    with ServingRuntime(batch_window_us=0, max_bucket_rows=64) as rt:
+        rt.register("pca", pca_model)
+        _slow_entry(rt, "pca", 0.15)
+        blocker = rt.predict_async("pca", _q(rng, 4))
+        assert _wait_until(lambda: rt.queue_depth() == 0)  # picked up
+        doomed = rt.predict_async("pca", _q(rng, 4), deadline_ms=30)
+        with pytest.raises(DeadlineExceeded, match="expired"):
+            doomed.result(60)
+        blocker.result(60)  # the no-deadline request is untouched
+    snap = telemetry.metrics_snapshot()
+    misses = snap["serve_deadline_miss_total"]["series"]
+    assert [s["value"] for s in misses] == [1]
+    assert snap.get("serve_shed_total") is None  # admitted, not shed
+
+
+def test_deadline_unmeetable_sheds_at_admission(pca_model):
+    """Once the EWMA service model knows a batch takes ~150 ms, a
+    10 ms-deadline request arriving behind a queue is shed at enqueue
+    (`deadline_unmeetable`), not admitted to fail later."""
+    rng = np.random.default_rng(9)
+    with ServingRuntime(batch_window_us=0, max_bucket_rows=64) as rt:
+        rt.register("pca", pca_model)
+        _slow_entry(rt, "pca", 0.15)
+        rt.predict("pca", _q(rng, 4), timeout=60)  # primes the EWMA
+        blocker = rt.predict_async("pca", _q(rng, 4))
+        assert _wait_until(lambda: rt.queue_depth() == 0)
+        queued = rt.predict_async("pca", _q(rng, 4))  # depth -> 1
+        with pytest.raises(Overloaded) as ei:
+            rt.predict_async("pca", _q(rng, 4), deadline_ms=10)
+        assert ei.value.reason == "deadline_unmeetable"
+        blocker.result(60)
+        queued.result(60)
+    shed = telemetry.metrics_snapshot()["serve_shed_total"]["series"]
+    assert [(s["labels"]["reason"], s["value"]) for s in shed] == [
+        ("deadline_unmeetable", 1)
+    ]
+
+
+def test_shed_on_queue_full_with_bounded_admitted_latency(pca_model):
+    """With a 2-deep queue bound and a slow model, overflow sheds typed
+    `Overloaded(queue_full)` while every ADMITTED request resolves with
+    latency bounded by its place in line — overload degrades service
+    for the shed tail, never for the admitted head."""
+    rng = np.random.default_rng(11)
+    delay = 0.1
+    with ServingRuntime(
+        batch_window_us=0, max_bucket_rows=64, queue_limit=2
+    ) as rt:
+        rt.register("pca", pca_model)
+        _slow_entry(rt, "pca", delay)
+        inflight = rt.predict_async("pca", _q(rng, 4))
+        assert _wait_until(lambda: rt.queue_depth() == 0)
+        admitted = [rt.predict_async("pca", _q(rng, 4)) for _ in range(2)]
+        shed = 0
+        for _ in range(5):
+            try:
+                rt.predict_async("pca", _q(rng, 4))
+            except Overloaded as e:
+                assert e.reason == "queue_full"
+                shed += 1
+        assert shed >= 4  # at most one slot could have freed mid-loop
+        t0 = time.perf_counter()
+        for f in [inflight] + admitted:
+            f.result(60)
+        # 3 outstanding requests, <= 3 slow batches: bounded wait
+        assert time.perf_counter() - t0 < 10 * delay
+    snap = telemetry.metrics_snapshot()
+    assert snap["serve_shed_total"]["series"][0]["labels"] == {
+        "model": "pca", "reason": "queue_full"
+    }
+
+
+# --- breaker ----------------------------------------------------------------
+
+
+def test_breaker_cycle_under_injected_faults(pca_model, monkeypatch):
+    """Two consecutive injected dispatch failures open the breaker
+    (fast-fail at admission, gauge=2, /readyz not ready); after the
+    cooldown one probe is admitted — its success closes the breaker."""
+    monkeypatch.setenv(
+        "TPUML_FAULT_SPEC",
+        "serve:dispatch:0:raise,serve:dispatch:1:raise,"
+        "serve:dispatch:2:raise",
+    )
+    faults.reset_faults()
+    rng = np.random.default_rng(13)
+    with ServingRuntime(
+        batch_window_us=0, max_bucket_rows=64,
+        breaker_fails=2, breaker_cooldown_ms=150,
+    ) as rt:
+        rt.register("pca", pca_model)
+        for _ in range(2):  # two consecutive dispatch failures
+            with pytest.raises(faults.InjectedFault):
+                rt.predict("pca", _q(rng, 4), timeout=60)
+        assert rt.breaker_states() == {"pca": "open"}
+        with pytest.raises(Overloaded) as ei:
+            rt.predict_async("pca", _q(rng, 4))
+        assert ei.value.reason == "breaker_open"
+        ready, reasons = opsplane._readiness()
+        assert not ready and any("breaker_open" in r for r in reasons)
+        gauge = telemetry.metrics_snapshot()["serve_breaker_state"]
+        assert gauge["series"][0]["value"] == 2
+
+        time.sleep(0.2)  # past the cooldown: next request is the probe
+        with pytest.raises(faults.InjectedFault):  # probe eats fault #2
+            rt.predict("pca", _q(rng, 4), timeout=60)
+        assert rt.breaker_states() == {"pca": "open"}  # probe failed
+
+        time.sleep(0.2)
+        out = rt.predict("pca", _q(rng, 4), timeout=60)  # probe succeeds
+        assert set(out) and rt.breaker_states() == {"pca": "closed"}
+        rt.predict("pca", _q(rng, 4), timeout=60)  # closed: serves fine
+    shed = telemetry.metrics_snapshot()["serve_shed_total"]["series"]
+    assert [(s["labels"]["reason"], s["value"]) for s in shed] == [
+        ("breaker_open", 1)
+    ]
+
+
+# --- RESOURCE_EXHAUSTED halving --------------------------------------------
+
+
+def test_oom_group_halving_bit_identity(pca_model, monkeypatch):
+    """An injected RESOURCE_EXHAUSTED on the coalesced group splits it
+    and retries halves at exact shapes; every result stays bit-identical
+    to a direct transform of the same rows (the PR-3 halving contract
+    at serving granularity)."""
+    monkeypatch.setenv("TPUML_FAULT_SPEC", "serve:dispatch:0:oom")
+    faults.reset_faults()
+    rng = np.random.default_rng(17)
+    qs = [_q(rng, s) for s in (3, 5, 4, 6)]
+    with ServingRuntime(batch_window_us=30_000, max_bucket_rows=64) as rt:
+        rt.register("pca", pca_model)
+        futs = [rt.predict_async("pca", q) for q in qs]
+        outs = [f.result(120) for f in futs]
+    for q, out in zip(qs, outs):
+        direct = pca_model.transform(DataFrame({"features": q}))
+        for col, served in out.items():
+            assert np.array_equal(served, np.asarray(direct[col])), (
+                col, q.shape,
+            )
+    snap = telemetry.metrics_snapshot()
+    inj = snap["fault_injections"]["series"]
+    assert [(s["labels"]["kind"], s["value"]) for s in inj] == [("oom", 1)]
+    # the OOM was absorbed by halving, not surfaced as a dispatch error
+    assert snap.get("serve_dispatch_errors_total") is None
+
+
+# --- drain / close ----------------------------------------------------------
+
+
+def test_drain_under_load_resolves_every_future(pca_model):
+    """drain(): admission stops (typed ShuttingDown + draining shed
+    metric, /readyz reports draining), admitted work flushes, and every
+    future is resolved — zero hangs."""
+    rng = np.random.default_rng(19)
+    release = threading.Event()
+    with ServingRuntime(batch_window_us=0, max_bucket_rows=64) as rt:
+        rt.register("pca", pca_model)
+        entry = rt.registry.get("pca")
+        inner = entry.fn
+
+        def gated(X):
+            release.wait(60)  # holds the dispatcher mid-batch
+            return inner(X)
+
+        entry.fn = gated
+        futs = [rt.predict_async("pca", _q(rng, 3)) for _ in range(20)]
+        report = {}
+        drainer = threading.Thread(
+            target=lambda: report.update(rt.drain(timeout=120))
+        )
+        drainer.start()
+        assert _wait_until(lambda: rt.is_draining())
+        with pytest.raises(ShuttingDown, match="draining"):
+            rt.predict_async("pca", _q(rng, 3))
+        ready, reasons = opsplane._readiness()
+        assert not ready and "serving_draining" in reasons
+        release.set()  # un-wedge: drain flushes everything admitted
+        drainer.join(120)
+        assert not drainer.is_alive()
+        assert report == {"drained": True, "aborted": 0}
+        done, not_done = concurrent.futures.wait(futs, timeout=60)
+        assert not_done == set()
+        for f in done:
+            assert set(f.result(0))  # all admitted work completed
+        with pytest.raises(ShuttingDown):
+            rt.predict_async("pca", _q(rng, 3))
+    shed = telemetry.metrics_snapshot()["serve_shed_total"]["series"]
+    assert {s["labels"]["reason"] for s in shed} == {"draining"}
+
+
+def test_drain_timeout_aborts_wedged_batch(pca_model):
+    """A dispatcher wedged inside a device call cannot make drain hang:
+    at the timeout the in-flight futures fail typed ShuttingDown."""
+    rng = np.random.default_rng(23)
+    release = threading.Event()
+    rt = ServingRuntime(batch_window_us=0, max_bucket_rows=64)
+    try:
+        rt.register("pca", pca_model)
+        entry = rt.registry.get("pca")
+        inner = entry.fn
+
+        def wedge(X):
+            release.wait(30)
+            return inner(X)
+
+        entry.fn = wedge
+        fut = rt.predict_async("pca", _q(rng, 3))
+        report = rt.drain(timeout=0.5)
+        assert report == {"drained": False, "aborted": 1}
+        with pytest.raises(ShuttingDown):
+            fut.result(0)
+    finally:
+        release.set()
+        rt.close()
+
+
+def test_close_predict_storm_zero_hung_futures(pca_model):
+    """The PR-11 race: a request enqueued after the shutdown sentinel
+    hung forever. Now a concurrent close()+predict storm leaves zero
+    unresolved futures — each is a result or a typed ServingError."""
+    rng = np.random.default_rng(29)
+    rt = ServingRuntime(batch_window_us=0, max_bucket_rows=64)
+    rt.register("pca", pca_model)
+    rt.predict("pca", _q(rng, 3), timeout=60)  # warm before the storm
+    futs = []
+    futs_lock = threading.Lock()
+    stop = threading.Event()
+
+    def storm():
+        while not stop.is_set():
+            try:
+                f = rt.predict_async("pca", _q(rng, 3))
+            except ServingError:
+                continue
+            with futs_lock:
+                futs.append(f)
+
+    threads = [threading.Thread(target=storm) for _ in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    rt.close()
+    stop.set()
+    for t in threads:
+        t.join(60)
+        assert not t.is_alive()
+    assert futs  # the storm actually got requests in
+    done, not_done = concurrent.futures.wait(futs, timeout=60)
+    assert not_done == set(), f"{len(not_done)} futures hung"
+    for f in done:
+        exc = f.exception(0)
+        assert exc is None or isinstance(exc, ServingError), exc
+
+
+# --- crash-proof dispatcher -------------------------------------------------
+
+
+def test_dispatcher_survives_unexpected_exception(pca_model, monkeypatch):
+    """An exception escaping _dispatch fails that batch's futures and
+    bumps serve_dispatch_errors_total — the serve thread itself lives
+    on and keeps serving (the silent-death satellite)."""
+    rng = np.random.default_rng(31)
+    with ServingRuntime(batch_window_us=0, max_bucket_rows=64) as rt:
+        rt.register("pca", pca_model)
+        boom = {"armed": True}
+        orig = rt._group
+
+        def group_once(entry, reqs):
+            if boom.pop("armed", False):
+                raise RuntimeError("telemetry sink exploded")
+            return orig(entry, reqs)
+
+        monkeypatch.setattr(rt, "_group", group_once)
+        f = rt.predict_async("pca", _q(rng, 3))
+        with pytest.raises(RuntimeError, match="sink exploded"):
+            f.result(60)
+        assert (
+            telemetry.counter("serve_dispatch_errors_total").value() == 1
+        )
+        assert rt.dispatcher_alive()
+        out = rt.predict("pca", _q(rng, 3), timeout=60)  # loop survived
+        assert set(out)
+        ready, reasons = opsplane._readiness()
+        assert "serve_dispatcher_dead" not in reasons
+
+
+def test_readiness_reports_dead_and_stalled_dispatcher(
+    pca_model, monkeypatch
+):
+    """/readyz surfaces a dead serve thread, and a stalled one via the
+    loop_heartbeat_ts age once work is queued behind it."""
+    with ServingRuntime(batch_window_us=0, max_bucket_rows=64) as rt:
+        rt.register("pca", pca_model)
+        rt.predict("pca", np.zeros((3, D), np.float32), timeout=60)
+        ready, reasons = opsplane._readiness()
+        assert ready, reasons
+        monkeypatch.setattr(rt, "dispatcher_alive", lambda: False)
+        ready, reasons = opsplane._readiness()
+        assert not ready and "serve_dispatcher_dead" in reasons
+        # stalled: alive but silent past the threshold with queued work
+        monkeypatch.setattr(rt, "dispatcher_alive", lambda: True)
+        monkeypatch.setattr(rt, "queue_depth", lambda: 3)
+        monkeypatch.setattr(
+            rt, "heartbeat_age_s",
+            lambda: 2 * opsplane.DISPATCHER_STALL_S,
+        )
+        ready, reasons = opsplane._readiness()
+        assert not ready
+        assert any("serve_dispatcher_stalled" in r for r in reasons)
+    # a cleanly closed runtime is not a fault
+    ready, reasons = opsplane._readiness()
+    assert ready, reasons
+
+
+# --- SLO catalog ------------------------------------------------------------
+
+
+def test_slo_catalog_has_shed_and_deadline_budgets():
+    from spark_rapids_ml_tpu.runtime import slo
+
+    shed = slo.BY_NAME["serving_shed_rate"]
+    miss = slo.BY_NAME["serving_deadline_miss"]
+    assert shed.metric == "serve_shed_total"
+    assert shed.measure == "window_delta" and shed.sense == "max"
+    assert miss.metric == "serve_deadline_miss_total"
+    assert miss.error_budget < shed.error_budget  # misses are worse
+    # window_delta over the counter: a shed-free tick measures 0 (no
+    # violation), a tick with new sheds violates the 0.0 objective
+    snap0 = {"serve_shed_total": {"series": [
+        {"labels": {"model": "m", "reason": "queue_full"}, "value": 2.0}
+    ]}}
+    snap1 = {"serve_shed_total": {"series": [
+        {"labels": {"model": "m", "reason": "queue_full"}, "value": 5.0}
+    ]}}
+    assert slo.measured_value(shed, snap1, snap0) == 3.0
+    assert slo.violates(shed, 3.0)
+    assert not slo.violates(shed, 0.0)
+
+
+# --- defaults inert ---------------------------------------------------------
+
+
+def test_defaults_inert_unbounded_bit_identical(pca_model):
+    """No TPUML_SERVE_* env, no deadline: admission admits everything,
+    no breaker/shed/deadline metric is ever recorded, the queue is
+    unbounded, and served outputs stay bit-identical to a direct
+    transform — the pre-admission behavior, exactly."""
+    rng = np.random.default_rng(37)
+    qs = [_q(rng, s) for s in (3, 17, 1, 2, 33)]
+    with ServingRuntime(batch_window_us=20_000, max_bucket_rows=64) as rt:
+        assert rt.admission.queue_limit is None
+        assert rt.admission.breaker_fails == 0
+        rt.register("pca", pca_model)
+        futs = [rt.predict_async("pca", q) for q in qs]
+        outs = [f.result(120) for f in futs]
+    for q, out in zip(qs, outs):
+        direct = pca_model.transform(DataFrame({"features": q}))
+        for col, served in out.items():
+            assert np.array_equal(served, np.asarray(direct[col])), (
+                col, q.shape,
+            )
+    snap = telemetry.metrics_snapshot()
+    for metric in (
+        "serve_shed_total",
+        "serve_deadline_miss_total",
+        "serve_breaker_state",
+        "serve_dispatch_errors_total",
+    ):
+        assert snap.get(metric) is None, metric
