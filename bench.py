@@ -2028,6 +2028,175 @@ def bench_serving(mesh, n_chips):
     }
 
 
+def bench_fit_sched(mesh, n_chips):
+    """Multi-tenant fit-scheduler bench: many small same-shape KMeans
+    fits driven through a :class:`FitScheduler`.
+
+    Reports (a) scheduled closed-loop capacity (``fits_per_sec``)
+    against the direct sequential ``.fit()`` loop — pack-compatible
+    jobs gang through one coscheduled preprocess, so the scheduler
+    should at worst break even and win once a backlog forms; (b) an
+    open-loop arrival sweep at 1x/2x/4x measured capacity into a
+    bounded queue with a per-fit deadline — graceful degradation means
+    goodput plateaus past capacity (typed ``Overloaded`` sheds at
+    submit, ``DeadlineExceeded`` in the backlog) while admitted fits
+    keep a bounded client-observed p99. Hard gates: the swept load must
+    score zero new retrace storms (same shapes => one compile), the 4x
+    goodput must hold >= 35% of the 1x goodput, and every future must
+    resolve (drain reports zero aborts)."""
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.data import DataFrame
+    from spark_rapids_ml_tpu.runtime import FitScheduler, telemetry as tele
+
+    rng = np.random.default_rng(47)
+    n, d, k, iters = 1024, 8, 4, 4
+    n_fits = int(os.environ.get("BENCH_SCHED_FITS", 12))
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    df = DataFrame({"features": X})
+
+    def make():
+        return KMeans(k=k, maxIter=iters, seed=3, num_workers=n_chips)
+
+    def _storms():
+        s = tele.metrics_snapshot().get("retrace_storms")
+        return sum(row["value"] for row in s["series"]) if s else 0
+
+    make().fit(df)  # warm the compile cache outside every timed phase
+    storms_base = _storms()
+
+    # baseline: the direct sequential fit loop a naive tenant runs
+    t0 = time.perf_counter()
+    for _ in range(n_fits):
+        make().fit(df)
+    direct_seconds = time.perf_counter() - t0
+    direct_fps = n_fits / direct_seconds
+
+    # capacity: the same fits submitted at once — the backlog gangs
+    # through one coscheduled preprocess; also primes the EWMA the
+    # deadline shed decision uses
+    with tele.span("sched.bench.capacity", fits=n_fits):
+        with FitScheduler() as sched:
+            t0 = time.perf_counter()
+            futs = [
+                sched.submit(make(), df, tenant=f"t{i % 4}")
+                for i in range(n_fits)
+            ]
+            for f in futs:
+                f.result(600)
+            fit_seconds = time.perf_counter() - t0
+            cap_stats = sched.stats()
+    capacity_fps = n_fits / fit_seconds
+
+    # open-loop arrival sweep: offered fit rate past capacity into a
+    # bounded queue with a deadline; latency recorded AT RESOLUTION
+    mean_fit_ms = 1e3 * fit_seconds / n_fits
+    deadline_ms = max(8.0 * mean_fit_ms, 50.0)
+    arrival_sweep = {}
+    for mult in (1, 2, 4):
+        offered = capacity_fps * mult
+        n_req = max(2 * n_fits, 16)
+        shed = 0
+        rec = []  # (latency_ms, resolved_ok) at resolution
+        with tele.span("sched.bench.arrival", mult=mult):
+            with FitScheduler(queue_limit=8) as sched:
+                futs = []
+                t_s = time.perf_counter()
+                for i in range(n_req):
+                    # absolute schedule: sleep granularity must not
+                    # silently lower the offered rate
+                    lag = t_s + i / offered - time.perf_counter()
+                    if lag > 0:
+                        time.sleep(lag)
+                    t_req = time.perf_counter()
+                    try:
+                        f = sched.submit(
+                            make(), df, tenant=f"t{i % 4}",
+                            deadline_ms=deadline_ms,
+                        )
+                    except Exception:
+                        shed += 1  # typed Overloaded at admission
+                        continue
+                    f.add_done_callback(
+                        lambda f_, t=t_req: rec.append((
+                            (time.perf_counter() - t) * 1e3,
+                            f_.exception() is None,
+                        ))
+                    )
+                    futs.append(f)
+                for f in futs:
+                    try:
+                        f.result(600)
+                    except Exception:
+                        pass  # DeadlineExceeded while queued
+                elapsed = time.perf_counter() - t_s
+                report = sched.drain(timeout=60)
+        if report["aborted"]:
+            raise RuntimeError(
+                f"fit_sched drain left {report['aborted']} future(s) "
+                f"unresolved at {mult}x offered load"
+            )
+        ok_lat = [l for l, good in rec if good]
+        arrival_sweep[str(mult)] = {
+            "offered_fps": round(offered, 2),
+            "goodput_fps": round(len(ok_lat) / elapsed, 2),
+            "shed_frac": round(shed / n_req, 4),
+            "deadline_missed": len(rec) - len(ok_lat),
+            "fit_p50_ms": (
+                round(float(np.percentile(ok_lat, 50)), 3) if ok_lat else None
+            ),
+            "fit_p99_ms": (
+                round(float(np.percentile(ok_lat, 99)), 3) if ok_lat else None
+            ),
+        }
+
+    # degradation gate: goodput past capacity must plateau, not collapse
+    top, base = arrival_sweep["4"], arrival_sweep["1"]
+    if top["goodput_fps"] <= 0 or (
+        base["goodput_fps"] > 0
+        and top["goodput_fps"] < 0.35 * base["goodput_fps"]
+    ):
+        raise RuntimeError(
+            f"fit_sched goodput collapsed past capacity: {arrival_sweep}"
+        )
+    # retrace gate: same-shape fits through the scheduler must not have
+    # swept a single NEW storm across the whole load
+    new_storms = _storms() - storms_base
+    if new_storms:
+        raise RuntimeError(
+            f"fit_sched load swept {new_storms} retrace storm(s)"
+        )
+
+    # FLOP model: lloyd assignment distances dominate each fit
+    per_fit = 2.0 * n * d * k * iters
+    rows_total = n * n_fits
+    return {
+        "samples_per_sec_per_chip": rows_total / fit_seconds / n_chips,
+        "fit_seconds": fit_seconds,
+        "rows": rows_total,
+        "fits": n_fits,
+        "fits_per_sec": round(capacity_fps, 3),
+        "fit_p50_ms": arrival_sweep["1"]["fit_p50_ms"],
+        "fit_p99_ms": arrival_sweep["1"]["fit_p99_ms"],
+        "shed_frac": arrival_sweep["4"]["shed_frac"],
+        "goodput_qps": arrival_sweep["4"]["goodput_fps"],
+        "sched_occupancy": cap_stats["occupancy"],
+        "arrival_sweep": arrival_sweep,
+        "arrival_deadline_ms": round(deadline_ms, 1),
+        "retrace_storms": new_storms,
+        "flops_model": per_fit * n_fits,
+        "baseline_samples_per_sec": rows_total / direct_seconds / n_chips,
+        "baseline_kind": "direct_sequential_fit_loop",
+        "baseline_inputs": {
+            "formula": "same_process_sequential_fit_loop_v1",
+            "fits": n_fits,
+            "rows": rows_total,
+            "direct_seconds": round(direct_seconds, 4),
+            "direct_fits_per_sec": round(direct_fps, 3),
+            "n": n, "d": d, "k": k, "iters": iters,
+        },
+    }
+
+
 def _probe_backend(
     attempts: int | None = None,
     probe_timeout: int | None = None,
@@ -2199,6 +2368,7 @@ def main() -> None:
         "ann": lambda: bench_ann(mesh, n_chips),
         "pca_stream": lambda: bench_pca_stream(mesh, n_chips),
         "serving": lambda: bench_serving(mesh, n_chips),
+        "fit_sched": lambda: bench_fit_sched(mesh, n_chips),
         "pca": lambda: bench_pca(*_X()[:2], mesh, n_chips),
         "kmeans": lambda: bench_kmeans(*_X()[:2], mesh, n_chips),
         "logreg": lambda: bench_logreg(*_X(), mesh, n_chips),
@@ -2416,6 +2586,9 @@ def _emit_line(results, meta, watchdog_tripped):
         "serve_vs_direct", "setup_fit_seconds", "warm_seconds", "requests",
         "p99_series_models", "capacity_qps", "overload_sweep",
         "overload_deadline_ms", "goodput_qps", "shed_frac",
+        "fits", "fits_per_sec", "fit_p50_ms", "fit_p99_ms",
+        "sched_occupancy", "arrival_sweep", "arrival_deadline_ms",
+        "ops_scrape_ms", "serve_batch_fill",
     )
     for name, r in results.items():
         line[name] = {

@@ -1101,4 +1101,84 @@ print(f"serving overload smoke OK: {shed}/200 shed at 2x capacity, "
       f"goodput {goodput:.0f} qps, 0 retrace storms")
 EOF
 
+echo "== fit scheduler chaos smoke =="
+# Multi-tenant fit scheduler (docs/scheduler.md contract): an injected
+# sched:dispatch fault fails exactly one tenant while survivors stay
+# bitwise equal to their solo fits, a 1 ms quantum preempts a streamed
+# fit at checkpoint boundaries and the resumed result matches the
+# uninterrupted twin, and drain-under-load resolves every future.
+rm -rf /tmp/tpuml_sched_ckpt
+JAX_PLATFORMS=cpu python - <<'EOF'
+import concurrent.futures
+import os
+
+import numpy as np
+
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.runtime import FitScheduler, faults, telemetry
+from spark_rapids_ml_tpu.runtime.faults import InjectedFault
+
+rng = np.random.default_rng(31)
+dfs = [
+    DataFrame({"features": rng.normal(size=(96 + 16 * i, 3 + i)).astype(np.float32)})
+    for i in range(4)
+]
+make = lambda i: KMeans(k=2 + i % 2, maxIter=5, seed=7 + i, num_workers=4)
+solo = [np.asarray(make(i).fit(df).cluster_centers_) for i, df in enumerate(dfs)]
+
+# dispatch order == submit order (no deadlines, equal priority): the
+# injected fault at hit index 1 lands on tenant t1 and only t1
+os.environ["TPUML_FAULT_SPEC"] = "sched:dispatch:1:raise"
+faults.reset_faults()
+telemetry.reset_telemetry()
+with FitScheduler() as sched:
+    futs = [sched.submit(make(i), df, tenant=f"t{i}") for i, df in enumerate(dfs)]
+    for i, f in enumerate(futs):
+        if i == 1:
+            try:
+                f.result(300)
+                raise AssertionError("injected dispatch fault did not surface")
+            except InjectedFault:
+                pass
+        else:
+            assert np.array_equal(np.asarray(f.result(300).cluster_centers_), solo[i]), i
+    stats = sched.stats()
+assert stats["dispatches"] == 3 and stats["dispatch_errors"] == 1, stats
+del os.environ["TPUML_FAULT_SPEC"]
+faults.reset_faults()
+
+# quantum preemption: streamed kmeans checkpoints + yields every ~1 ms,
+# resumes to the exact uninterrupted result
+X = rng.normal(size=(256, 5)).astype(np.float64)
+X[:64] += 4.0
+stream_df = DataFrame({"features": X})
+mk = lambda: KMeans(k=4, maxIter=6, tol=1e-12, seed=5, num_workers=4,
+                    streaming=True, stream_chunk_rows=64)
+clean = mk().fit(stream_df)
+os.environ["TPUML_CKPT_DIR"] = "/tmp/tpuml_sched_ckpt"
+os.environ["TPUML_CKPT_EVERY"] = "1"
+with FitScheduler(quantum_ms=1.0) as sched:
+    model = sched.fit(mk(), stream_df, timeout=300)
+    stats = sched.stats()
+assert stats["preemptions"] >= 1, stats
+assert stats["resumes"] == stats["preemptions"], stats
+np.testing.assert_allclose(
+    model.cluster_centers_, clean.cluster_centers_, rtol=0, atol=1e-12
+)
+del os.environ["TPUML_CKPT_DIR"], os.environ["TPUML_CKPT_EVERY"]
+
+# drain under load: every admitted future resolves (model or typed
+# ShuttingDown) inside the timeout — zero hangs
+sched = FitScheduler()
+futs = [sched.submit(make(i % 4), dfs[i % 4], tenant=f"t{i}") for i in range(6)]
+report = sched.drain(timeout=120)
+done, not_done = concurrent.futures.wait(futs, timeout=0)
+assert not not_done, not_done
+assert report["aborted"] == sum(1 for f in futs if f.exception() is not None), report
+print(f"fit scheduler chaos smoke OK: 1 injected fault isolated, "
+      f"{stats['preemptions']} preemptions resumed bit-identically, "
+      f"drain {report}")
+EOF
+
 echo "CI OK"

@@ -141,6 +141,8 @@ _STATIC_FIELDS = (
     ("serve_batch_fill", -1),  # fill collapse = micro-batching regression
     ("goodput_qps", -1),      # overload goodput collapse = shedding broke
     ("shed_frac", +1),        # shedding more at the same offered load
+    ("fits_per_sec", -1),     # fit-scheduler capacity regression
+    ("fit_p99_ms", +1),       # scheduled-fit tail latency growth
 )
 
 _QPS_FIELD_RE = re.compile(r"^qps_sweep\[(.+)\]\.p99_ms$")

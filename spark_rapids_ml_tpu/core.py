@@ -766,8 +766,28 @@ class _TpuEstimator(Params, _TpuParams):
         with telemetry.span(f"{type(self).__name__}.fit"):
             return self._fit_lanes_x64scoped(dataset, paramMaps)
 
+    def _fit_coscheduled(
+        self, dataset: DataFrame, estimators: List["_TpuEstimator"]
+    ) -> List["_TpuModel"]:
+        """Gang entry point for the fit scheduler (`runtime/scheduler.py`):
+        fit several ready estimator instances of this class over one shared
+        dataset in a single pass — one preprocess sharding the design
+        matrix once, gang-batched lanes when the kernel supports it (same
+        `TPUML_GANG_FIT` gating as `fitMultiple`), sequential lanes
+        otherwise. Returns models order-aligned with ``estimators``."""
+        with _x64_ctx(np.float64 if not self._float32_inputs else np.float32):
+            with telemetry.span(
+                f"{type(self).__name__}.fit", coscheduled=len(estimators)
+            ):
+                return self._fit_lanes_x64scoped(
+                    dataset, None, coscheduled=estimators
+                )
+
     def _fit_lanes_x64scoped(
-        self, dataset: DataFrame, paramMaps: Optional[List[Dict[Any, Any]]]
+        self,
+        dataset: DataFrame,
+        paramMaps: Optional[List[Dict[Any, Any]]],
+        coscheduled: Optional[List["_TpuEstimator"]] = None,
     ) -> List["_TpuModel"]:
         # phase annotations land as named ranges on the profiler timeline
         # (the reference's NVTX ranges, ``RapidsRowMatrix.scala:62,70``)
@@ -794,9 +814,14 @@ class _TpuEstimator(Params, _TpuParams):
             fit_func = self._get_tpu_fit_func(dataset)
         models: List[_TpuModel] = []
         param_sets: List[Dict[str, Any]]
-        if paramMaps is None:
+        if coscheduled is not None:
+            # scheduler gang: the lanes are ready estimator instances
+            # (each tenant's own object), not paramMaps over self
+            estimators = list(coscheduled)
+            param_sets = [dict(est._tpu_params) for est in estimators]
+        elif paramMaps is None:
             param_sets = [dict(self._tpu_params)]
-            estimators: List[_TpuEstimator] = [self]
+            estimators = [self]
         else:
             estimators = []
             param_sets = []

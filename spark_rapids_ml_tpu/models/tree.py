@@ -56,7 +56,10 @@ from ..ops.tree_kernels import (
     rf_classify,
     rf_regress,
 )
-from ..runtime import envspec, telemetry
+from ..runtime import counters, envspec, telemetry
+from ..runtime.checkpoint import FitCheckpointer, array_digest
+from ..runtime.faults import fault_site
+from ..runtime.scheduler import preempt_point
 
 _MAX_SUPPORTED_DEPTH = 18  # full binary layout: 2^(d+1)-1 nodes per tree
 
@@ -1380,15 +1383,83 @@ class _GBTEstimator(_GBTClass, _TpuEstimatorSupervised, _GBTParams):
             )
             log_every = int(envspec.get("TPUML_GBT_ROUND_LOG_EVERY"))
 
+            def _concat_tables(rounds_out: List[Dict[str, Any]]) -> Dict[str, Any]:
+                """Host forest tables from the per-round outputs (or from
+                a checkpoint prefix entry — the casts are idempotent)."""
+                return {
+                    "feature": np.concatenate(
+                        [np.asarray(o["feature"]) for o in rounds_out], axis=0
+                    ).astype(np.int32),
+                    "threshold_bin": np.concatenate(
+                        [np.asarray(o["threshold_bin"]) for o in rounds_out],
+                        axis=0,
+                    ).astype(np.int32),
+                    "leaf_stats": np.concatenate(
+                        [np.asarray(o["leaf_stats"]) for o in rounds_out],
+                        axis=0,
+                    ).astype(np.float32),
+                    "gain": np.concatenate(
+                        [np.asarray(o["gain"]) for o in rounds_out], axis=0
+                    ).astype(np.float32),
+                    "values": np.concatenate(
+                        [np.asarray(o["values"]) for o in rounds_out], axis=0
+                    ).astype(np.float32),
+                }
+
+            # checkpoint/resume over the boosting loop: per-round RNG is
+            # keys_np[r] — a function of the ABSOLUTE round index — and
+            # the f32 margins round-trip through npz bitwise, so a
+            # resumed fit is same-seed identical to an uninterrupted one
+            ckpt = FitCheckpointer.from_env("gbt", {
+                "loss": loss_kind, "n_rounds": n_rounds, "lr": lr,
+                "max_depth": max_depth, "n_bins": n_bins, "d": d,
+                "n_rows": inputs.n_rows, "seed": seed,
+                "edges": array_digest(edges_np),
+                "init": array_digest(init),
+            })
+
             t_quant = _time.perf_counter()
             outs = []
-            for r in range(n_rounds):
+            r0 = 0
+            resumed = ckpt.load() if ckpt.enabled else None
+            if resumed is not None:
+                r0, saved, _ = resumed
+                margins = jax.make_array_from_callback(
+                    (n_pad_global, n_v),
+                    NamedSharding(inputs.mesh, P(DP_AXIS)),
+                    lambda idx: np.ascontiguousarray(saved["margins"][idx]),
+                )
+                # the committed forest prefix rides as one pseudo-round
+                # entry; _concat_tables flattens it with the new rounds
+                outs.append({
+                    k: saved[k]
+                    for k in (
+                        "feature", "threshold_bin", "leaf_stats", "gain",
+                        "values",
+                    )
+                })
+                counters.bump("resumed_fits")
+                counters.note("resumed_from", r0)
+                self.logger.info(
+                    "GBT resume: restored %d/%d committed rounds", r0, n_rounds
+                )
+            for r in range(r0, n_rounds):
+                fault_site("gbt:round")
                 out = gbt_round(
                     bins, inputs.mask, inputs.y, margins,
                     jnp.asarray(keys_np[r]), mesh=inputs.mesh, cfg=cfg,
                 )
                 margins = out.pop("margins")
                 outs.append(out)
+                if ckpt.enabled:
+                    def _snapshot() -> Dict[str, Any]:
+                        return {
+                            "margins": np.asarray(margins), **_concat_tables(outs)
+                        }
+
+                    if (r + 1) % ckpt.every == 0:
+                        ckpt.save(r + 1, _snapshot())
+                    preempt_point(ckpt, r + 1, _snapshot)
                 if log_every and (r + 1) % log_every == 0:
                     lv = float(
                         np.asarray(
@@ -1405,21 +1476,13 @@ class _GBTEstimator(_GBTClass, _TpuEstimatorSupervised, _GBTParams):
             # one host fetch per table after the loop (rounds are data-
             # dependent through the margins, so growth itself is the
             # serialization point, not these copies)
-            feat = np.concatenate(
-                [np.asarray(o["feature"]) for o in outs], axis=0
-            ).astype(np.int32)
-            thr_bin = np.concatenate(
-                [np.asarray(o["threshold_bin"]) for o in outs], axis=0
-            ).astype(np.int32)
-            leaf_stats = np.concatenate(
-                [np.asarray(o["leaf_stats"]) for o in outs], axis=0
-            ).astype(np.float32)
-            gains = np.concatenate(
-                [np.asarray(o["gain"]) for o in outs], axis=0
-            ).astype(np.float32)
-            values = np.concatenate(
-                [np.asarray(o["values"]) for o in outs], axis=0
-            ).astype(np.float32)
+            tables = _concat_tables(outs)
+            feat = tables["feature"]
+            thr_bin = tables["threshold_bin"]
+            leaf_stats = tables["leaf_stats"]
+            gains = tables["gain"]
+            values = tables["values"]
+            ckpt.clear()
             t_boost = _time.perf_counter()
 
             thr = np.where(

@@ -66,6 +66,7 @@ from ..ops.umap_pallas import (
 from ..runtime import counters, telemetry
 from ..runtime.checkpoint import FitCheckpointer, array_digest
 from ..runtime.faults import fault_site, fault_sites_active
+from ..runtime.scheduler import preempt_point
 from ..utils.profiling import StageTimer
 
 _LOGGER = logging.getLogger("spark_rapids_ml_tpu.umap")
@@ -130,6 +131,7 @@ def _run_sgd_segmented(
         )
         e += span
         ckpt.maybe_save(e, {"embedding": np.asarray(emb)})
+        preempt_point(ckpt, e, lambda: {"embedding": np.asarray(emb)})
     ckpt.clear()
     return emb
 

@@ -378,6 +378,7 @@ def minimize_lbfgs_host(
 
     from ..runtime import counters
     from ..runtime.faults import fault_site
+    from ..runtime.scheduler import preempt_point
 
     w = np.asarray(w0, dtype=np.float64)
     p = w.shape[0]
@@ -471,15 +472,17 @@ def minimize_lbfgs_host(
         w, f, g = w_new, f_t, g_t
         it += 1
         if checkpointer is not None:
+            state = lambda: {
+                "w": w,
+                "g": g,
+                "S": np.stack(S) if S else np.zeros((0, p)),
+                "Y": np.stack(Y) if Y else np.zeros((0, p)),
+            }
             checkpointer.maybe_save(
-                it,
-                {
-                    "w": w,
-                    "g": g,
-                    "S": np.stack(S) if S else np.zeros((0, p)),
-                    "Y": np.stack(Y) if Y else np.zeros((0, p)),
-                },
-                {"f": f, "converged": bool(converged)},
+                it, state(), {"f": f, "converged": bool(converged)}
+            )
+            preempt_point(
+                checkpointer, it, state, {"f": f, "converged": bool(converged)}
             )
 
     if checkpointer is not None:

@@ -34,6 +34,7 @@ from ..data.chunks import Chunk, ChunkSource
 from ..parallel.mesh import row_sharding
 from ..runtime import counters, envspec, opsplane, telemetry
 from ..runtime.faults import SimulatedPreemption, fault_site
+from ..runtime.scheduler import preempt_point
 from ..runtime.retry import (
     backoff_schedule,
     is_resource_exhausted,
@@ -1297,6 +1298,11 @@ def streamed_kmeans_lloyd(
         if checkpointer is not None:
             checkpointer.maybe_save(
                 it, {"centers": np.asarray(centers)}, {"prev_shift": prev_shift}
+            )
+            preempt_point(
+                checkpointer, it,
+                lambda: {"centers": np.asarray(centers)},
+                {"prev_shift": prev_shift},
             )
 
     # final cost pass always f32 (bf16 distance expansion cancels near

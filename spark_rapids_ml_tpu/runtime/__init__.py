@@ -10,9 +10,19 @@ default:
 - ``TPUML_FAULT_SPEC``                      — :func:`fault_site` hooks
 - ``TPUML_TRACE`` / ``TPUML_TELEMETRY_*``   — :mod:`telemetry` spans,
   typed metrics, and the retrace/HBM watchdogs
+- ``TPUML_SCHED_*``                         — :class:`FitScheduler`
+  (explicit construction is the opt-in; see ``docs/scheduler.md``)
 """
 
 from . import counters, metricspec, telemetry
+from .admission import (
+    AdmissionError,
+    CircuitBreaker,
+    DeadlineExceeded,
+    Overloaded,
+    ServiceEwma,
+    ShuttingDown,
+)
 from .checkpoint import CKPT_VERSION, FitCheckpointer, array_digest, params_hash
 from .faults import (
     FaultInjector,
@@ -32,8 +42,18 @@ from .retry import (
     resolve_retries,
     with_retries,
 )
+from .scheduler import FitPreempted, FitScheduler, preempt_point
 
 __all__ = [
+    "AdmissionError",
+    "CircuitBreaker",
+    "DeadlineExceeded",
+    "Overloaded",
+    "ServiceEwma",
+    "ShuttingDown",
+    "FitPreempted",
+    "FitScheduler",
+    "preempt_point",
     "CKPT_VERSION",
     "FitCheckpointer",
     "array_digest",

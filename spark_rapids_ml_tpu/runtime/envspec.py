@@ -468,6 +468,70 @@ SPEC: Dict[str, EnvVar] = _registry(
         exclusive_minimum=0, category="serving",
         also_documented_in=("docs/serving.md",),
     ),
+    # --- fit scheduler (docs/scheduler.md) --------------------------------
+    EnvVar(
+        "TPUML_SCHED_QUEUE_LIMIT", "int", None,
+        "Bound on queued (admitted, not yet dispatched) fit jobs in a "
+        "`runtime.FitScheduler`. Submits past the bound are rejected "
+        "with a typed `Overloaded` (counted on "
+        "`sched_shed_total{reason=queue_full}`) instead of growing the "
+        "queue without limit. Unset = unbounded queue. Only read by an "
+        "explicitly constructed scheduler — no thread or metric series "
+        "exists otherwise.",
+        minimum=1, category="scheduler",
+        also_documented_in=("docs/scheduler.md",),
+    ),
+    EnvVar(
+        "TPUML_SCHED_QUANTUM_MS", "float", None,
+        "Device quantum for scheduled fits, in milliseconds. A fit "
+        "whose quantum expires checkpoints at its next iteration "
+        "boundary (via the `FitCheckpointer`, so `TPUML_CKPT_DIR` must "
+        "be set for preemption to engage), yields the device, and is "
+        "re-queued; the resumed dispatch continues from the committed "
+        "iteration with the same-seed parity the segmented solvers "
+        "guarantee. Unset = fits run to completion once dispatched.",
+        exclusive_minimum=0, category="scheduler",
+        also_documented_in=("docs/scheduler.md",),
+    ),
+    EnvVar(
+        "TPUML_SCHED_BREAKER_FAILS", "int", 0,
+        "Consecutive fit failures that trip a tenant's circuit breaker "
+        "from closed to open; while open, that tenant's submits "
+        "fast-fail at admission (`sched_shed_total{reason="
+        "breaker_open}`). `0` (default) disables the breaker entirely.",
+        minimum=0, category="scheduler",
+        also_documented_in=("docs/scheduler.md",),
+    ),
+    EnvVar(
+        "TPUML_SCHED_BREAKER_COOLDOWN_MS", "float", 1000.0,
+        "How long an open per-tenant breaker blocks before moving to "
+        "half-open and admitting a single probe fit; the probe's "
+        "outcome closes (success) or re-opens (failure) the breaker. "
+        "Only read when `TPUML_SCHED_BREAKER_FAILS` > 0.",
+        exclusive_minimum=0, category="scheduler",
+        also_documented_in=("docs/scheduler.md",),
+    ),
+    EnvVar(
+        "TPUML_SCHED_AGING_MS", "float", 10000.0,
+        "Aging horizon for deadline-free fit jobs: a job with no "
+        "deadline is ordered as if due `aging_ms` after submit, so "
+        "EDF ordering (and gang-bucket packing built on it) can never "
+        "starve it behind a stream of deadline-bearing arrivals.",
+        exclusive_minimum=0, category="scheduler",
+        also_documented_in=("docs/scheduler.md",),
+    ),
+    EnvVar(
+        "TPUML_SCHED_DEFAULT_DEADLINE_MS", "float", None,
+        "Default per-job deadline in milliseconds for "
+        "`FitScheduler.submit(..., deadline_ms=)` callers that pass "
+        "none. A job whose deadline expires while queued is failed "
+        "with a typed `DeadlineExceeded` before dispatch, and "
+        "admission sheds (`deadline_unmeetable`) when the EWMA fit-"
+        "time estimate says the deadline cannot be met. Unset = no "
+        "deadline: jobs wait indefinitely.",
+        exclusive_minimum=0, category="scheduler",
+        also_documented_in=("docs/scheduler.md",),
+    ),
     # --- CI / notebooks ---------------------------------------------------
     EnvVar(
         "TPUML_NB_CPU", "bool", False,
@@ -514,9 +578,11 @@ SPEC: Dict[str, EnvVar] = _registry(
         "TPUML_FAULT_SPEC", "str", "",
         "Deterministic fault injection for resilience testing: comma-"
         "separated `scope:point:index:action` entries (`ingest:chunk` / "
-        "`sgd:epoch` / `init:connect` / `serve:admit` / `serve:dispatch` "
-        "/ `serve:transfer` sites; `raise`/`preempt`/`oom` actions; "
-        "0-based per-site hit index, each entry fires once).",
+        "`sgd:epoch` / `gbt:round` / `init:connect` / `serve:admit` / "
+        "`serve:dispatch` / `serve:transfer` / `sched:admit` / "
+        "`sched:preempt` / `sched:resume` / `sched:dispatch` sites; "
+        "`raise`/`preempt`/`oom` actions; 0-based per-site hit index, "
+        "each entry fires once).",
         category="resilience",
         also_documented_in=("docs/fault_tolerance.md",),
     ),

@@ -5,10 +5,11 @@
     scope:point:index:action
 
 where ``scope:point`` names an instrumented site (``ingest:chunk``,
-``sgd:epoch``, ``init:connect``, and the serving plane's
-``serve:admit`` / ``serve:dispatch`` / ``serve:transfer``), ``index``
-is the 0-based hit count at that site on which the fault fires, and
-``action`` is one of
+``sgd:epoch``, ``gbt:round``, ``init:connect``, the serving plane's
+``serve:admit`` / ``serve:dispatch`` / ``serve:transfer``, and the fit
+scheduler's ``sched:admit`` / ``sched:preempt`` / ``sched:resume`` /
+``sched:dispatch``), ``index`` is the 0-based hit count at that site on
+which the fault fires, and ``action`` is one of
 
 - ``raise``   — raise :class:`InjectedFault` (a generic hard error),
 - ``preempt`` — raise :class:`SimulatedPreemption` (terminal: the retry
@@ -39,6 +40,13 @@ SITES = (
     # serving plane (hit per admission attempt / group dispatch /
     # device->host result fetch — see serving/runtime.py)
     "serve:admit", "serve:dispatch", "serve:transfer",
+    # GBT boosting round boundary (models/tree.py) — the per-round
+    # twin of sgd:epoch, so an interrupted-then-resumed GBT fit is
+    # testable the same way the SGD solvers are
+    "gbt:round",
+    # fit scheduler (hit per job submit / quantum yield / resumed
+    # re-dispatch / job dispatch — see runtime/scheduler.py)
+    "sched:admit", "sched:preempt", "sched:resume", "sched:dispatch",
 )
 ACTIONS = ("raise", "preempt", "oom")
 

@@ -294,10 +294,76 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "loop_heartbeat_ts", "gauge",
         "`time.monotonic()` of the most recent liveness beat of a "
         "long-running loop, labeled by loop site (`stream_ingest`, "
-        "`stream_stage`, `serve_dispatch`); `/statusz` reports "
+        "`stream_stage`, `serve_dispatch`, `fit_sched`); `/statusz` reports "
         "`now - value` as the heartbeat age, so a wedged loop shows "
         "up as a growing age instead of silence.",
         labels=("loop",),
+    ),
+    # --- fit scheduler (PR 15) --------------------------------------------
+    MetricSpec(
+        "sched_queue_depth", "gauge",
+        "Fit jobs admitted to a `runtime.FitScheduler` and not yet "
+        "dispatched, sampled by the scheduler loop each pass; bounded "
+        "by `TPUML_SCHED_QUEUE_LIMIT` when that is set.",
+    ),
+    MetricSpec(
+        "sched_inflight", "gauge",
+        "Fit jobs the scheduler currently has on the device (the "
+        "dispatch in progress, including every lane of a packed gang); "
+        "`0` whenever the loop is idle.",
+    ),
+    MetricSpec(
+        "sched_fit_ms", "histogram",
+        "End-to-end scheduled-fit latency in milliseconds (submit to "
+        "future resolution, spanning queue wait, every preempted "
+        "segment, and requeue gaps), labeled by tenant; the ring "
+        "quantiles carry the admitted p50/p99 the `fit_sched` bench "
+        "and the `sched_fit_p99` SLO assert on.",
+        labels=("tenant",),
+    ),
+    MetricSpec(
+        "sched_shed_total", "counter",
+        "Fit jobs rejected at scheduler admission, labeled by tenant "
+        "and shed reason (`queue_full` | `deadline_unmeetable` | "
+        "`breaker_open` | `draining`); the typed "
+        "`Overloaded`/`ShuttingDown` raise is the caller-visible side "
+        "of each increment.",
+        labels=("tenant", "reason"),
+    ),
+    MetricSpec(
+        "sched_deadline_miss_total", "counter",
+        "Admitted fit jobs whose deadline expired while queued — "
+        "failed with `DeadlineExceeded` before dispatch (device time "
+        "is never spent on a fit that already missed), labeled by "
+        "tenant.",
+        labels=("tenant",),
+    ),
+    MetricSpec(
+        "sched_preemptions_total", "counter",
+        "Scheduled fits checkpointed and re-queued at a quantum "
+        "boundary (`TPUML_SCHED_QUANTUM_MS`); each preemption is "
+        "eventually paired with a `sched_resumes_total` increment "
+        "unless the scheduler drains first.",
+    ),
+    MetricSpec(
+        "sched_resumes_total", "counter",
+        "Re-dispatches of previously preempted fit jobs; the resumed "
+        "segment restores from the quantum-boundary checkpoint via the "
+        "same `FitCheckpointer` path fault recovery uses.",
+    ),
+    MetricSpec(
+        "sched_dispatch_errors_total", "counter",
+        "Fit dispatches that raised (tenant bug or injected `sched:*` "
+        "fault); each one fails only that job's future and leaves the "
+        "scheduler loop running. Nonzero in steady state means a bad "
+        "tenant, not scheduler load.",
+    ),
+    MetricSpec(
+        "sched_breaker_state", "gauge",
+        "Per-tenant scheduler circuit-breaker state (0 closed, 1 "
+        "half-open, 2 open), labeled by tenant; exported to `/statusz` "
+        "and an open breaker flips `/readyz` to 503.",
+        labels=("tenant",),
     ),
     MetricSpec(
         "ingest_ring_occupancy", "gauge",

@@ -88,6 +88,7 @@ _SIGTERM_INSTALLED = False
 # weakrefs so tracking never extends a registry/runtime lifetime
 _REGISTRIES: List["weakref.ref[Any]"] = []
 _RUNTIMES: List["weakref.ref[Any]"] = []
+_SCHEDULERS: List["weakref.ref[Any]"] = []
 
 
 def _active() -> bool:
@@ -311,6 +312,15 @@ def track_runtime(runtime: Any) -> None:
         _RUNTIMES.append(weakref.ref(runtime))
 
 
+def track_scheduler(scheduler: Any) -> None:
+    """Weakly track a FitScheduler (same contract as track_runtime):
+    /statusz reads its stats(), /readyz gates on its loop heartbeat,
+    and the SIGTERM handler drains it before the flight dump."""
+    with _LOCK:
+        _prune(_SCHEDULERS)
+        _SCHEDULERS.append(weakref.ref(scheduler))
+
+
 def _prune(refs: List["weakref.ref[Any]"]) -> None:
     refs[:] = [r for r in refs if r() is not None]
 
@@ -378,6 +388,32 @@ def _readiness() -> Tuple[bool, List[str]]:
             if open_breakers:
                 reasons.append(
                     f"breaker_open={json.dumps(open_breakers)}"
+                )
+        except Exception:
+            continue
+    for sched in _live(_SCHEDULERS):
+        try:
+            if sched.is_closed():
+                continue  # a cleanly closed scheduler is not a fault
+            if sched.is_draining():
+                reasons.append("sched_draining")
+            elif sched.dispatcher_started() and not sched.dispatcher_alive():
+                reasons.append("sched_loop_dead")
+            else:
+                age = sched.heartbeat_age_s()
+                if (
+                    age is not None
+                    and age > DISPATCHER_STALL_S
+                    and sched.queue_depth() > 0
+                ):
+                    reasons.append(f"sched_loop_stalled_age_s={age:.1f}")
+            open_breakers = sorted(
+                t for t, state in sched.breaker_states().items()
+                if state == "open"
+            )
+            if open_breakers:
+                reasons.append(
+                    f"sched_breaker_open={json.dumps(open_breakers)}"
                 )
         except Exception:
             continue
@@ -462,6 +498,39 @@ def _statusz() -> Dict[str, Any]:
         "dispatches": telemetry.counter("gang_dispatches").value() or 0,
         "lanes_total": telemetry.counter("gang_lanes_total").value() or 0,
     }
+    scheduler: Dict[str, Any] = {
+        "instances": [s.stats() for s in _live(_SCHEDULERS)],
+        "draining": [s.is_draining() for s in _live(_SCHEDULERS)],
+        "loop_alive": [s.dispatcher_alive() for s in _live(_SCHEDULERS)],
+        "breakers": {
+            tenant: state
+            for s in _live(_SCHEDULERS)
+            for tenant, state in s.breaker_states().items()
+        },
+        "fit_ms": [
+            {
+                "tenant": s["labels"].get("tenant", "?"),
+                "count": s.get("count"),
+                "p50": s.get("p50"),
+                "p99": s.get("p99"),
+            }
+            for s in _series("sched_fit_ms")
+        ],
+        "shed_total": {
+            "{}/{}".format(
+                s["labels"].get("tenant", "?"),
+                s["labels"].get("reason", "?"),
+            ): s.get("value")
+            for s in _series("sched_shed_total")
+        },
+        "preemptions": (
+            telemetry.counter("sched_preemptions_total").value() or 0
+        ),
+        "resumes": telemetry.counter("sched_resumes_total").value() or 0,
+        "dispatch_errors": (
+            telemetry.counter("sched_dispatch_errors_total").value() or 0
+        ),
+    }
     ready, reasons = _readiness()
     rec = _RECORDER
     return {
@@ -474,6 +543,7 @@ def _statusz() -> Dict[str, Any]:
             reg.warmup_state() for reg in _live(_REGISTRIES)
         ],
         "serving": serving,
+        "scheduler": scheduler,
         "heartbeat_ages_s": heartbeats,
         "ingest_ring_occupancy": _scalar("ingest_ring_occupancy"),
         "gang": gang,
@@ -587,6 +657,11 @@ def _on_sigterm(signum: int, frame: Any) -> None:
             rt.drain(timeout=SIGTERM_DRAIN_TIMEOUT_S)
         except Exception:
             pass
+    for sched in _live(_SCHEDULERS):
+        try:
+            sched.drain(timeout=SIGTERM_DRAIN_TIMEOUT_S)
+        except Exception:
+            pass
     rec = _RECORDER
     if rec is not None:
         try:
@@ -696,6 +771,7 @@ def stop() -> None:
         _STARTED = False
         _REGISTRIES.clear()
         _RUNTIMES.clear()
+        _SCHEDULERS.clear()
     if server is not None:
         try:
             server.shutdown()

@@ -130,6 +130,33 @@ CATALOG: Tuple[SLOSpec, ...] = _catalog(
             "ticks.",
     ),
     SLOSpec(
+        name="sched_fit_p99",
+        metric="sched_fit_ms",
+        measure="p99",
+        objective=30000.0,
+        sense="max",
+        error_budget=0.05,
+        doc="Scheduled-fit p99 (submit to future resolution, worst "
+            "labeled tenant) stays under 30 s — queue wait plus every "
+            "preempted segment; sustained breach means the queue has "
+            "outrun device throughput and admission should be "
+            "tightened, budgeted at 5% of ticks.",
+    ),
+    SLOSpec(
+        name="sched_shed_rate",
+        metric="sched_shed_total",
+        measure="window_delta",
+        objective=0.0,
+        sense="max",
+        error_budget=0.10,
+        doc="Fit-scheduler load-shed budget: the fit-plane twin of "
+            "`serving_shed_rate` — single-tick sheds are the scheduler "
+            "working as designed under a burst, sustained shedding "
+            "(>= 10% of ticks seeing new `sched_shed_total` increments "
+            "across both burn windows) means offered fit load or a "
+            "stuck tenant breaker has outrun capacity.",
+    ),
+    SLOSpec(
         name="fit_retrace_storms",
         metric="retrace_storms",
         measure="window_delta",

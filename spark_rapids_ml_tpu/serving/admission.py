@@ -19,6 +19,11 @@ rejection*. This module implements the rejection side:
   breaker, failure re-opens it. State is exported on the
   ``serve_breaker_state`` gauge (0 closed / 1 half-open / 2 open).
 
+The state machines themselves (EWMA service model, breaker transitions,
+the typed error classes) live in :mod:`runtime.admission` and are
+shared verbatim with the fit scheduler (``runtime/scheduler.py``); this
+module binds them to the serving metric names and env knobs.
+
 Everything here is defaults-inert: with no ``TPUML_SERVE_*`` env set
 and no per-request deadline, ``admit`` returns without taking a lock
 beyond its own and no metric is touched — behavior is bit-identical to
@@ -28,117 +33,55 @@ an unbounded queue.
 from __future__ import annotations
 
 import threading
-import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 from ..runtime import envspec, telemetry
+from ..runtime.admission import (
+    CLOSED,
+    EWMA_ALPHA as _ALPHA,
+    HALF_OPEN,
+    OPEN,
+    STATE_NAMES as _STATE_NAMES,
+    AdmissionError,
+    DeadlineExceeded,
+    Overloaded,
+    ServiceEwma,
+    ShuttingDown,
+)
+from ..runtime.admission import CircuitBreaker as _CircuitBreaker
 
-# breaker states (gauge values on serve_breaker_state)
-CLOSED = 0
-HALF_OPEN = 1
-OPEN = 2
+# The serving error surface: the classes are defined once in
+# runtime/admission.py; ``ServingError`` is the historical name of the
+# shared base (isinstance/except relations are unchanged).
+ServingError = AdmissionError
 
-_STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
-
-# EWMA smoothing for batch service time / batch size: ~5-batch memory,
-# fast enough to track a load shift within one batch window burst
-_ALPHA = 0.2
-
-
-class ServingError(RuntimeError):
-    """Base of the typed serving error surface. Subclasses RuntimeError
-    so pre-existing callers catching RuntimeError keep working."""
-
-
-class DeadlineExceeded(ServingError):
-    """The request's deadline expired before dispatch (never after a
-    result was computed — expiry is checked *before* padding/dispatch)."""
-
-
-class Overloaded(ServingError):
-    """Rejected at admission; ``reason`` is the shed-metric label
-    (``queue_full`` | ``deadline_unmeetable`` | ``breaker_open``)."""
-
-    def __init__(self, message: str, reason: str) -> None:
-        super().__init__(message)
-        self.reason = reason
-
-
-class ShuttingDown(ServingError):
-    """The runtime is closed or draining. The message always contains
-    "closed" — callers matching the pre-typed RuntimeError still match."""
-
-    def __init__(self, message: str = "ServingRuntime is closed") -> None:
-        super().__init__(message)
+__all__ = [
+    "CLOSED",
+    "HALF_OPEN",
+    "OPEN",
+    "ServingError",
+    "DeadlineExceeded",
+    "Overloaded",
+    "ShuttingDown",
+    "CircuitBreaker",
+    "AdmissionController",
+]
 
 
-class CircuitBreaker:
-    """Per-model consecutive-failure breaker. Thread-safe; owned by the
-    AdmissionController (admission thread) and poked by the dispatcher
-    (record_success/record_failure), so every transition is locked."""
+class CircuitBreaker(_CircuitBreaker):
+    """Per-model breaker: the shared state machine wired to the
+    ``serve_breaker_state{model}`` gauge."""
 
     def __init__(self, model: str, fails: int, cooldown_s: float) -> None:
+        super().__init__(
+            model,
+            fails,
+            cooldown_s,
+            on_state=lambda state: telemetry.gauge(
+                "serve_breaker_state"
+            ).set(state, model=model),
+        )
         self.model = model
-        self.fails = int(fails)  # 0 = disabled
-        self.cooldown_s = float(cooldown_s)
-        self._state = CLOSED
-        self._consecutive = 0
-        self._opened_at = 0.0
-        self._lock = threading.Lock()
-
-    @property
-    def enabled(self) -> bool:
-        return self.fails > 0
-
-    def _set_state(self, state: int) -> None:
-        self._state = state
-        telemetry.gauge("serve_breaker_state").set(state, model=self.model)
-
-    def state(self) -> int:
-        with self._lock:
-            return self._state
-
-    def state_name(self) -> str:
-        return _STATE_NAMES[self.state()]
-
-    def allow(self) -> bool:
-        """Admission-side check. Open blocks; after the cooldown the
-        breaker moves to half-open and admits exactly one probe."""
-        if not self.enabled:
-            return True
-        with self._lock:
-            if self._state == CLOSED:
-                return True
-            if self._state == OPEN:
-                if time.monotonic() - self._opened_at < self.cooldown_s:
-                    return False
-                self._set_state(HALF_OPEN)
-                return True
-            # HALF_OPEN: one probe is already in flight; block the rest
-            # until the dispatcher reports its outcome
-            return False
-
-    def record_success(self) -> None:
-        if not self.enabled:
-            return
-        with self._lock:
-            self._consecutive = 0
-            if self._state != CLOSED:
-                self._set_state(CLOSED)
-
-    def record_failure(self) -> None:
-        if not self.enabled:
-            return
-        with self._lock:
-            if self._state == HALF_OPEN:
-                # the probe failed: straight back to open, fresh cooldown
-                self._opened_at = time.monotonic()
-                self._set_state(OPEN)
-                return
-            self._consecutive += 1
-            if self._state == CLOSED and self._consecutive >= self.fails:
-                self._opened_at = time.monotonic()
-                self._set_state(OPEN)
 
 
 class AdmissionController:
@@ -167,40 +110,23 @@ class AdmissionController:
         self._breakers: Dict[str, CircuitBreaker] = {}
         # per-model EWMA of (batch service seconds, requests per batch):
         # estimated wait = queued requests / reqs-per-batch * service
-        self._ewma: Dict[str, Tuple[float, float]] = {}
+        self._service = ServiceEwma(alpha=_ALPHA)
 
     # -- service-time model ------------------------------------------------
     def note_batch(self, model: str, service_s: float, n_reqs: int) -> None:
         """Dispatcher callback after a successful group dispatch."""
-        with self._lock:
-            prev = self._ewma.get(model)
-            if prev is None:
-                self._ewma[model] = (float(service_s), float(n_reqs))
-            else:
-                s, r = prev
-                self._ewma[model] = (
-                    _ALPHA * float(service_s) + (1 - _ALPHA) * s,
-                    _ALPHA * float(n_reqs) + (1 - _ALPHA) * r,
-                )
+        self._service.note(model, service_s, n_reqs)
 
     def service_estimate_s(self, model: str) -> Optional[float]:
         """EWMA seconds one dispatched batch of ``model`` takes, or
         None before any batch has been observed."""
-        with self._lock:
-            ew = self._ewma.get(model)
-        return None if ew is None else ew[0]
+        return self._service.estimate_s(model)
 
     def estimated_wait_s(self, model: str, queue_depth: int) -> Optional[float]:
         """Expected queueing delay for a request arriving now, behind
         ``queue_depth`` already-admitted requests. None = no data yet
         (first batches are never shed on the deadline estimate)."""
-        with self._lock:
-            ew = self._ewma.get(model)
-        if ew is None:
-            return None
-        service_s, reqs_per_batch = ew
-        batches = queue_depth / max(reqs_per_batch, 1.0)
-        return batches * service_s
+        return self._service.estimated_wait_s(model, queue_depth)
 
     # -- breakers ----------------------------------------------------------
     def breaker(self, model: str) -> CircuitBreaker:

@@ -1,0 +1,460 @@
+"""Elastic multi-tenant fit scheduler chaos suite: an injected
+mid-dispatch fault fails exactly one of eight mixed-shape tenants while
+every survivor's model stays bitwise equal to its solo fit, a fit
+preempted at a forced quantum expiry resumes to the same result as its
+uninterrupted twin (including a GBT interrupted across committed
+rounds), drain-under-load resolves every outstanding future with no
+hangs, queue-full / unmeetable-deadline / open-breaker sheds are typed
+``Overloaded`` errors counted on ``sched_shed_total``, pack-compatible
+jobs gang through one ``_fit_coscheduled`` pass, the ops plane reports
+scheduler state on /statusz and gates /readyz on it, and the whole
+module is defaults-inert.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import GBTClassifier, LogisticRegression
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.regression import LinearRegression
+from spark_rapids_ml_tpu.runtime import (
+    DeadlineExceeded,
+    FitScheduler,
+    Overloaded,
+    ShuttingDown,
+    counters,
+    faults,
+    opsplane,
+    telemetry,
+)
+from spark_rapids_ml_tpu.runtime.faults import InjectedFault
+from spark_rapids_ml_tpu.runtime.scheduler import preempt_point
+
+_SCHED_ENVS = (
+    "TPUML_SCHED_QUEUE_LIMIT",
+    "TPUML_SCHED_QUANTUM_MS",
+    "TPUML_SCHED_BREAKER_FAILS",
+    "TPUML_SCHED_BREAKER_COOLDOWN_MS",
+    "TPUML_SCHED_AGING_MS",
+    "TPUML_SCHED_DEFAULT_DEADLINE_MS",
+    "TPUML_CKPT_DIR",
+    "TPUML_CKPT_EVERY",
+    "TPUML_FAULT_SPEC",
+    "TPUML_RETRIES",
+    "TPUML_GANG_FIT",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in _SCHED_ENVS:
+        monkeypatch.delenv(var, raising=False)
+    opsplane.stop()
+    telemetry.reset_telemetry()
+    faults.reset_faults()
+    counters.reset()
+    yield
+    opsplane.stop()
+    telemetry.reset_telemetry()
+    faults.reset_faults()
+    counters.reset()
+
+
+def _wait_until(cond, timeout=30.0, interval=0.002):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class _FakeEstimator:
+    """Minimal duck-typed estimator: enough surface for the admission /
+    pack-key path without touching the device, so shed and drain tests
+    control dispatch timing exactly."""
+
+    num_workers = 1
+
+    def __init__(self, delay_s=0.0, fail=False, result="model"):
+        self.delay_s = delay_s
+        self.fail = fail
+        self.result = result
+
+    def _get_input_columns(self):
+        return "features", None
+
+    def getOrDefault(self, name):  # pragma: no cover - label path unused
+        return None
+
+    def _require_label(self):
+        return False
+
+    def _get_tpu_streaming_fit_func(self, dataset):
+        return None
+
+    def fit(self, dataset):
+        time.sleep(self.delay_s)
+        if self.fail:
+            raise RuntimeError("injected tenant failure")
+        return self.result
+
+
+def _shed_reasons():
+    snap = telemetry.metrics_snapshot()
+    series = (snap.get("sched_shed_total") or {}).get("series") or []
+    return {
+        (s["labels"].get("tenant"), s["labels"].get("reason")): s["value"]
+        for s in series
+    }
+
+
+# ---------------------------------------------------------------------------
+# defaults-inert
+# ---------------------------------------------------------------------------
+
+
+def test_defaults_inert_no_thread_no_metrics_bitwise_fit(rng=None):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(96, 4)).astype(np.float32)
+    df = DataFrame({"features": X})
+
+    before = {t.name for t in threading.enumerate()}
+    a = KMeans(k=3, maxIter=5, seed=1, num_workers=4).fit(df)
+    b = KMeans(k=3, maxIter=5, seed=1, num_workers=4).fit(df)
+    # importing the scheduler module (done at the top of this file)
+    # must not perturb a direct fit: bit-identical across runs, no
+    # dispatcher thread, no sched_* metric series
+    np.testing.assert_array_equal(a.cluster_centers_, b.cluster_centers_)
+    after = {t.name for t in threading.enumerate()}
+    assert "tpuml-fit-sched" not in after - before
+    assert not any(
+        k.startswith("sched_") for k in telemetry.metrics_snapshot()
+    )
+    # outside a scheduler quantum preempt_point is a no-op even with a
+    # live checkpointer-looking object
+    preempt_point(object(), 3, {"w": np.zeros(2)})
+
+
+# ---------------------------------------------------------------------------
+# fault isolation: eight mixed-shape tenants, one injected dispatch fault
+# ---------------------------------------------------------------------------
+
+
+def _tenant_fleet():
+    """Eight tenants with distinct datasets/shapes/algorithms, so every
+    pack key is unique and each fit dispatches solo (bitwise-comparable
+    to its standalone twin)."""
+    fleet = []
+    for i, (n, d, k) in enumerate([(96, 3, 2), (128, 4, 3), (80, 5, 2), (112, 6, 4)]):
+        rng = np.random.default_rng(10 + i)
+        df = DataFrame({"features": rng.normal(size=(n, d)).astype(np.float32)})
+        make = (
+            lambda k=k, i=i: KMeans(k=k, maxIter=6, seed=20 + i, num_workers=4)
+        )
+        fleet.append((f"kmeans-{i}", make, df,
+                      lambda m: np.asarray(m.cluster_centers_)))
+    for i, (n, d) in enumerate([(100, 4), (140, 6)]):
+        rng = np.random.default_rng(30 + i)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = X @ rng.normal(size=d) + 0.1 * rng.normal(size=n)
+        df = DataFrame({"features": X, "label": y.astype(np.float64)})
+        make = lambda: LinearRegression(maxIter=40, num_workers=4)
+        fleet.append((f"linreg-{i}", make, df,
+                      lambda m: np.append(np.asarray(m.coefficients), m.intercept)))
+    for i, (n, d) in enumerate([(120, 3), (90, 5)]):
+        rng = np.random.default_rng(40 + i)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X[:, 0] + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+        df = DataFrame({"features": X, "label": y})
+        make = lambda: LogisticRegression(maxIter=30, num_workers=4)
+        fleet.append((f"logreg-{i}", make, df,
+                      lambda m: np.append(np.asarray(m.coefficients), m.intercept)))
+    return fleet
+
+
+def test_mid_fleet_fault_leaves_survivors_bitwise(monkeypatch):
+    fleet = _tenant_fleet()
+    assert len(fleet) == 8
+    solo = {name: extract(make().fit(df)) for name, make, df, extract in fleet}
+
+    # 4th dispatch (hit index 3) raises InjectedFault inside the
+    # scheduler's dispatch frame; dispatch order == submit order here
+    # (equal priority, no deadlines, aging preserves arrival order)
+    monkeypatch.setenv("TPUML_FAULT_SPEC", "sched:dispatch:3:raise")
+    faults.reset_faults()
+
+    with FitScheduler() as sched:
+        futs = [
+            (name, extract, sched.submit(make(), df, tenant=name))
+            for name, make, df, extract in fleet
+        ]
+        victim = futs[3][0]
+        for name, extract, fut in futs:
+            if name == victim:
+                with pytest.raises(InjectedFault):
+                    fut.result(timeout=120)
+            else:
+                got = extract(fut.result(timeout=120))
+                np.testing.assert_array_equal(got, solo[name])
+        stats = sched.stats()
+    assert stats["dispatches"] == 7
+    assert stats["dispatch_errors"] == 1
+    assert stats["queue_depth"] == 0 and stats["inflight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# preemption / resume parity
+# ---------------------------------------------------------------------------
+
+
+def test_preempted_fit_matches_uninterrupted_twin(monkeypatch, tmp_path):
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(256, 5)).astype(np.float64)
+    X[:64] += 4.0
+    X[64:128] -= 4.0
+    df = DataFrame({"features": X})
+
+    def make():
+        return KMeans(
+            k=4, maxIter=8, tol=1e-12, seed=5, num_workers=4,
+            streaming=True, stream_chunk_rows=64,
+        ).setFeaturesCol("features")
+
+    clean = make().fit(df)  # no checkpoint env: uninterrupted twin
+
+    monkeypatch.setenv("TPUML_CKPT_DIR", str(tmp_path))
+    monkeypatch.setenv("TPUML_CKPT_EVERY", "1")
+    base = counters.snapshot()
+    with FitScheduler(quantum_ms=1.0) as sched:
+        model = sched.fit(make(), df, tenant="preemptee", timeout=300)
+        stats = sched.stats()
+    assert stats["preemptions"] >= 1
+    assert stats["resumes"] == stats["preemptions"]
+    assert stats["dispatches"] == 1
+    delta = counters.delta_since(base)
+    assert delta.get("resumed_fits", 0) == stats["resumes"]
+    np.testing.assert_allclose(
+        model.cluster_centers_, clean.cluster_centers_, rtol=0, atol=1e-12
+    )
+
+
+def test_gbt_interrupted_then_resumed_is_bitwise(monkeypatch, tmp_path):
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(256, 6)).astype(np.float32)
+    y = (1.3 * X[:, 0] - 0.8 * X[:, 2] + 0.2 * rng.normal(size=256) > 0)
+    df = DataFrame({"features": X, "label": y.astype(np.float64)})
+
+    def make():
+        return GBTClassifier(maxIter=6, maxDepth=3, seed=11)
+
+    clean = np.asarray(make().fit(df).transform(df)["prediction"])
+
+    monkeypatch.setenv("TPUML_CKPT_DIR", str(tmp_path))
+    monkeypatch.setenv("TPUML_CKPT_EVERY", "1")
+    monkeypatch.setenv("TPUML_FAULT_SPEC", "gbt:round:3:preempt")
+    faults.reset_faults()
+    with pytest.raises(faults.SimulatedPreemption):
+        make().fit(df)
+
+    monkeypatch.delenv("TPUML_FAULT_SPEC")
+    faults.reset_faults()
+    base = counters.snapshot()
+    resumed = np.asarray(make().fit(df).transform(df)["prediction"])
+    delta = counters.delta_since(base)
+    assert delta.get("resumed_fits", 0) == 1
+    assert delta.get("resumed_from", 0) == 3
+    np.testing.assert_array_equal(resumed, clean)
+
+
+# ---------------------------------------------------------------------------
+# typed sheds
+# ---------------------------------------------------------------------------
+
+
+def test_queue_full_shed_is_typed_and_counted():
+    with FitScheduler(queue_limit=1) as sched:
+        slow = sched.submit(_FakeEstimator(delay_s=0.6), object(), tenant="a")
+        assert _wait_until(lambda: sched.stats()["inflight"] == 1)
+        queued = sched.submit(_FakeEstimator(), object(), tenant="b")
+        with pytest.raises(Overloaded) as ei:
+            sched.submit(_FakeEstimator(), object(), tenant="c")
+        assert ei.value.reason == "queue_full"
+        assert _shed_reasons().get(("c", "queue_full")) == 1
+        assert slow.result(timeout=30) == "model"
+        assert queued.result(timeout=30) == "model"
+
+
+def test_deadline_unmeetable_shed_uses_ewma(monkeypatch):
+    with FitScheduler() as sched:
+        # seed the EWMA with one observed ~0.3 s fit
+        sched.fit(_FakeEstimator(delay_s=0.3), object(), timeout=30)
+        # occupy the dispatcher and stack one queued job behind it
+        busy = sched.submit(_FakeEstimator(delay_s=0.5), object())
+        assert _wait_until(lambda: sched.stats()["inflight"] == 1)
+        queued = sched.submit(_FakeEstimator(delay_s=0.3), object())
+        with pytest.raises(Overloaded) as ei:
+            sched.submit(
+                _FakeEstimator(), object(), tenant="late", deadline_ms=1.0
+            )
+        assert ei.value.reason == "deadline_unmeetable"
+        assert _shed_reasons().get(("late", "deadline_unmeetable")) == 1
+        busy.result(timeout=30)
+        queued.result(timeout=30)
+
+
+def test_breaker_opens_after_consecutive_failures():
+    with FitScheduler(breaker_fails=2, breaker_cooldown_ms=60000) as sched:
+        for _ in range(2):
+            fut = sched.submit(_FakeEstimator(fail=True), object(), tenant="t")
+            with pytest.raises(RuntimeError):
+                fut.result(timeout=30)
+        assert _wait_until(lambda: sched.breaker_states().get("t") == "open")
+        with pytest.raises(Overloaded) as ei:
+            sched.submit(_FakeEstimator(), object(), tenant="t")
+        assert ei.value.reason == "breaker_open"
+        # other tenants are unaffected: per-tenant isolation
+        assert sched.fit(_FakeEstimator(), object(), tenant="u", timeout=30) == "model"
+        assert _shed_reasons().get(("t", "breaker_open")) == 1
+
+
+def test_admitted_job_missing_deadline_fails_typed():
+    with FitScheduler() as sched:
+        busy = sched.submit(_FakeEstimator(delay_s=0.5), object())
+        assert _wait_until(lambda: sched.stats()["inflight"] == 1)
+        # EWMA is empty so admission cannot shed; the deadline then
+        # expires in the backlog and must fail typed, never hang
+        late = sched.submit(_FakeEstimator(), object(), tenant="d", deadline_ms=50)
+        with pytest.raises(DeadlineExceeded):
+            late.result(timeout=30)
+        busy.result(timeout=30)
+        assert sched.stats()["deadline_misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# drain / shutdown
+# ---------------------------------------------------------------------------
+
+
+def test_drain_under_load_resolves_every_future():
+    sched = FitScheduler()
+    futs = [
+        sched.submit(_FakeEstimator(delay_s=0.15), object(), tenant=f"t{i}")
+        for i in range(8)
+    ]
+    report = sched.drain(timeout=0.5)
+    assert report["aborted"] >= 1  # 8 * 150 ms cannot finish in 500 ms
+    done = aborted = 0
+    for fut in futs:
+        try:
+            assert fut.result(timeout=5) == "model"
+            done += 1
+        except ShuttingDown:
+            aborted += 1
+    assert done + aborted == 8
+    assert aborted == report["aborted"]
+    assert report["drained"] == (aborted == 0)
+    with pytest.raises(ShuttingDown):
+        sched.submit(_FakeEstimator(), object())
+
+
+def test_drain_while_idle_completes_cleanly_and_sheds_new_submits():
+    sched = FitScheduler()
+    fut = sched.submit(_FakeEstimator(delay_s=0.4), object())
+    shed_seen = {}
+
+    def _draining_submit():
+        assert _wait_until(sched.is_draining, timeout=5)
+        try:
+            sched.submit(_FakeEstimator(), object(), tenant="late")
+        except ShuttingDown as e:
+            shed_seen["exc"] = e
+
+    t = threading.Thread(target=_draining_submit)
+    t.start()
+    report = sched.drain(timeout=30)
+    t.join()
+    assert report == {"drained": True, "aborted": 0}
+    assert fut.result(timeout=1) == "model"
+    assert isinstance(shed_seen.get("exc"), ShuttingDown)
+    assert _shed_reasons().get(("late", "draining")) == 1
+
+
+# ---------------------------------------------------------------------------
+# elastic gang packing
+# ---------------------------------------------------------------------------
+
+
+def test_pack_compatible_jobs_gang_through_one_coscheduled_pass(monkeypatch):
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(128, 4)).astype(np.float32)
+    df = DataFrame({"features": X})
+
+    gangs = []
+    orig = KMeans._fit_coscheduled
+
+    def spy(self, dataset, estimators):
+        gangs.append(len(estimators))
+        return orig(self, dataset, estimators)
+
+    monkeypatch.setattr(KMeans, "_fit_coscheduled", spy)
+
+    solo3 = KMeans(k=3, maxIter=6, seed=2, num_workers=4).fit(df)
+    solo4 = KMeans(k=4, maxIter=6, seed=2, num_workers=4).fit(df)
+    assert gangs == []  # direct fits never take the coscheduled path
+
+    with FitScheduler() as sched:
+        # hold the dispatcher on a fake job so both KMeans jobs are in
+        # the backlog together and get selected as one gang
+        busy = sched.submit(_FakeEstimator(delay_s=0.4), object())
+        assert _wait_until(lambda: sched.stats()["inflight"] == 1)
+        f3 = sched.submit(KMeans(k=3, maxIter=6, seed=2, num_workers=4), df, tenant="g3")
+        f4 = sched.submit(KMeans(k=4, maxIter=6, seed=2, num_workers=4), df, tenant="g4")
+        busy.result(timeout=30)
+        m3, m4 = f3.result(timeout=120), f4.result(timeout=120)
+        stats = sched.stats()
+    assert gangs == [2]
+    assert stats["dispatches"] == 3
+    # gang lanes share one preprocess; results match solo to fp noise
+    np.testing.assert_allclose(
+        m3.cluster_centers_, solo3.cluster_centers_, rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        m4.cluster_centers_, solo4.cluster_centers_, rtol=1e-5, atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# ops plane integration
+# ---------------------------------------------------------------------------
+
+
+def test_statusz_reports_scheduler_and_readyz_gates_on_it():
+    sched = FitScheduler()
+    try:
+        sched.fit(_FakeEstimator(), object(), tenant="s", timeout=30)
+        status = opsplane._statusz()
+        section = status["scheduler"]
+        assert section["instances"][0]["dispatches"] == 1
+        assert section["loop_alive"] == [True]
+        assert any(s["tenant"] == "s" for s in section["fit_ms"])
+        ok, reasons = opsplane._readiness()
+        assert ok, reasons
+
+        busy = sched.submit(_FakeEstimator(delay_s=0.5), object())
+        t = threading.Thread(target=sched.drain, kwargs={"timeout": 30})
+        t.start()
+        assert _wait_until(
+            lambda: "sched_draining" in opsplane._readiness()[1], timeout=5
+        )
+        t.join()
+        busy.result(timeout=5)
+    finally:
+        sched.close()
+    # a cleanly closed scheduler is not a readiness fault
+    ok, reasons = opsplane._readiness()
+    assert ok, reasons
