@@ -2472,6 +2472,13 @@ def main() -> None:
                         / res["transform_baseline_samples_per_sec"]
                     )
                 results[name] = res
+                if devices[0].platform == "cpu" and "tunnel_bound" not in res:
+                    # CPU-fallback numbers (probe failed, or the backend
+                    # quietly initialized host-only) measure the host, not
+                    # the chip: flag every entry so bench_regress compares
+                    # rounds as skip:tunnel-bound instead of gating on
+                    # host noise
+                    res["tunnel_bound"] = True
                 print(
                     f"[bench] {name}: {res['samples_per_sec_per_chip']:.3e} "
                     f"samples/sec/chip, mfu={res['mfu']:.3f}, "
@@ -2522,6 +2529,10 @@ def main() -> None:
             _hard_exit(1)
         sys.exit(1)
 
+    # model-axis A/B columns for the mp-capable entries (subprocess probe;
+    # skipped for subsets that exclude all four families)
+    _merge_mp_ab(results)
+
     # flag BEFORE emitting: a SIGTERM landing mid-print must not re-enter
     # emission from the handler (interleaved/duplicate JSON lines)
     _PARTIAL["emitted"] = True
@@ -2536,6 +2547,130 @@ def main() -> None:
         # and holding the tunnel grant — the exact wedge the watchdog
         # exists to bound. Flush and leave.
         _hard_exit(0)
+
+
+# model-axis A/B: fit the four mp-capable families (pca/linreg/kmeans/ann)
+# at TPUML_MESH_MP unset vs =2 in a clean subprocess on 8 virtual CPU
+# devices, and attach {mp1,mp2} fit seconds + the measured per-shard HBM
+# bytes from _fit_report/_ann_report to the matching bench entries. A
+# subprocess because the main bench holds the real backend (and its own
+# mesh) — the probe must not flip TPUML_MESH_MP under live entries.
+_MP_AB_CHILD = r"""
+import json, os, time
+import numpy as np
+
+os.environ.setdefault("TPUML_ANN_GATE_ROWS", "1")
+
+from sklearn.datasets import make_blobs
+
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+from spark_rapids_ml_tpu.regression import LinearRegression
+
+rows, d, k = 4096, 64, 8
+rng = np.random.default_rng(0)
+X, _ = make_blobs(n_samples=rows, n_features=d, centers=k, random_state=0)
+X = X.astype(np.float32)
+y = (X @ rng.normal(size=d)).astype(np.float32)
+df = DataFrame({"features": X})
+df_lab = DataFrame({"features": X, "label": y})
+qdf = DataFrame({"features": X[:128]})
+
+
+def one_pass():
+    out = {}
+    t0 = time.perf_counter()
+    m = PCA(k=4).setInputCol("features").fit(df)
+    out["pca"] = (time.perf_counter() - t0, dict(m._fit_report))
+    t0 = time.perf_counter()
+    m = LinearRegression(regParam=1e-3).fit(df_lab)
+    out["linreg"] = (time.perf_counter() - t0, dict(m._fit_report))
+    t0 = time.perf_counter()
+    m = KMeans(k=k, maxIter=10, seed=0).fit(df)
+    out["kmeans"] = (time.perf_counter() - t0, dict(m._fit_report))
+    t0 = time.perf_counter()
+    m = ApproximateNearestNeighbors(k=10, num_workers=1).fit(df)
+    m.kneighbors(qdf)
+    out["ann"] = (time.perf_counter() - t0, dict(m._ann_report))
+    return out
+
+
+os.environ.pop("TPUML_MESH_MP", None)
+base = one_pass()
+os.environ["TPUML_MESH_MP"] = "2"
+sharded = one_pass()
+
+bkeys = {
+    "pca": "gram_shard_bytes",
+    "linreg": "gram_shard_bytes",
+    "kmeans": "centroid_shard_bytes",
+    "ann": "index_shard_bytes",
+}
+# replicated model-axis bytes for the gram/centroid families are exact
+# analytically (f32, d aligned, k % mp == 0); the IVF index has capacity
+# padding so only its measured shard bytes are reported
+full = {"pca": d * d * 4, "linreg": d * d * 4, "kmeans": k * d * 4}
+rep = {}
+for name, bkey in bkeys.items():
+    t1, _ = base[name]
+    t2, r2 = sharded[name]
+    entry = {
+        "mp_degree": int(r2.get("mp_degree", 1)),
+        "mp1_fit_seconds": round(t1, 4),
+        "mp2_fit_seconds": round(t2, 4),
+        "shard_bytes_mp2": int(r2.get(bkey, 0)),
+    }
+    if name in full:
+        entry["replicated_bytes"] = full[name]
+    rep[name] = entry
+print("MPAB " + json.dumps(rep))
+"""
+
+
+def _mp_ab_probe() -> dict:
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("TPUML_MESH_MP", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _MP_AB_CHILD],
+            capture_output=True,
+            text=True,
+            timeout=900,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] mp A/B probe failed to launch: {e!r}", file=sys.stderr)
+        return {}
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("MPAB "):
+            try:
+                return json.loads(ln[5:])
+            except json.JSONDecodeError:
+                break
+    print(
+        f"[bench] mp A/B probe produced no result (rc={proc.returncode}):\n"
+        f"{proc.stderr[-2000:]}",
+        file=sys.stderr,
+    )
+    return {}
+
+
+def _merge_mp_ab(results) -> None:
+    targets = [n for n in ("pca", "linreg", "kmeans", "ann") if n in results]
+    if not targets or os.environ.get("BENCH_MP_AB", "1") == "0":
+        return
+    ab = _mp_ab_probe()
+    for name in targets:
+        if name in ab:
+            results[name]["mp_degree"] = ab[name]["mp_degree"]
+            results[name]["mp_ab"] = ab[name]
 
 
 def _emit_line(results, meta, watchdog_tripped):
@@ -2589,6 +2724,7 @@ def _emit_line(results, meta, watchdog_tripped):
         "fits", "fits_per_sec", "fit_p50_ms", "fit_p99_ms",
         "sched_occupancy", "arrival_sweep", "arrival_deadline_ms",
         "ops_scrape_ms", "serve_batch_fill",
+        "mp_degree", "mp_ab",
     )
     for name, r in results.items():
         line[name] = {

@@ -465,6 +465,74 @@ print(
 )
 EOF
 
+echo "== 2-D mesh (model-axis) smoke =="
+# TPUML_MESH_MP contract: mp=2 fits of PCA/KMeans/ANN on 8 virtual CPU
+# devices match the mp=1 fits within the documented f32 tolerance
+# (docs/mesh.md), every sharded fit reports its mp_degree + per-shard
+# bytes, defaults stay inert (env unset => empty _fit_report), and the
+# sharded kernels compile once per program shape — zero retrace storms.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'EOF'
+import os
+
+import numpy as np
+from sklearn.datasets import make_blobs
+
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+from spark_rapids_ml_tpu.runtime import telemetry
+
+X, _ = make_blobs(n_samples=2048, n_features=16, centers=8, random_state=11)
+X = X.astype(np.float32)
+df = DataFrame({"features": X})
+qdf = DataFrame({"features": X[:128]})
+
+def fit_all():
+    pca = PCA(k=4).setInputCol("features").fit(df)
+    km = KMeans(k=6, maxIter=20, seed=2).setFeaturesCol("features").fit(df)
+    ann = ApproximateNearestNeighbors(k=10, num_workers=1).fit(df)
+    _, _, knn = ann.kneighbors(qdf)
+    return pca, km, ann, np.asarray(knn["indices"])
+
+os.environ.pop("TPUML_MESH_MP", None)
+os.environ["TPUML_ANN_GATE_ROWS"] = "1"
+telemetry.reset_telemetry()
+pca1, km1, ann1, ids1 = fit_all()
+assert pca1._fit_report == {} and km1._fit_report == {}, "defaults not inert"
+assert "mp_degree" not in ann1._ann_report, ann1._ann_report
+
+os.environ["TPUML_MESH_MP"] = "2"
+pca2, km2, ann2, ids2 = fit_all()
+os.environ.pop("TPUML_MESH_MP")
+os.environ.pop("TPUML_ANN_GATE_ROWS")
+
+for report, bytes_key in (
+    (pca2._fit_report, "gram_shard_bytes"),
+    (km2._fit_report, "centroid_shard_bytes"),
+    (ann2._ann_report, "index_shard_bytes"),
+):
+    assert report["mp_degree"] == 2 and report[bytes_key] > 0, report
+
+np.testing.assert_allclose(
+    np.abs(np.asarray(pca1.components_)),
+    np.abs(np.asarray(pca2.components_)), rtol=2e-4, atol=2e-4,
+)
+np.testing.assert_allclose(
+    np.sort(np.asarray(km1.cluster_centers_), axis=0),
+    np.sort(np.asarray(km2.cluster_centers_), axis=0),
+    rtol=1e-3, atol=1e-3,
+)
+overlap = np.mean([len(set(a) & set(b)) / 10 for a, b in zip(ids1, ids2)])
+assert overlap >= 0.99, overlap
+
+storms = telemetry.metrics_snapshot().get("retrace_storms")
+assert not storms or all(s["value"] == 0 for s in storms["series"]), storms
+print(f"2-D mesh smoke OK: mp_degree 2 for pca/kmeans/ann, "
+      f"ann overlap {overlap:.3f}, 0 retrace storms")
+EOF
+
 echo "== telemetry trace smoke =="
 # A traced streamed KMeans fit must produce a Perfetto-loadable trace
 # whose spans cover the fit end to end: the root span brackets the whole

@@ -45,6 +45,7 @@ from .runtime import envspec, telemetry
 from .parallel.mesh import (
     global_row_count,
     make_mesh,
+    resolve_mesh_mp,
     row_sharding,
     shard_aligned,
     shard_rows,
@@ -668,9 +669,16 @@ class _TpuEstimator(Params, _TpuParams):
         masks and solver state keep the resolved input dtype."""
         return None
 
+    def _model_axis_bytes(self, n_features_padded: int, dtype) -> float:
+        """Bytes of the largest structure the estimator can shard along the
+        model (``mp``) axis — what ``TPUML_MESH_MP=auto`` budgets against.
+        Default: the d×d Gram/covariance accumulator (PCA, the linear
+        solvers). Estimators whose model axis is not feature-squared
+        (KMeans centroids, IVF lists) override."""
+        return float(n_features_padded) ** 2 * np.dtype(dtype).itemsize
+
     def _pre_process_data(self, dataset: DataFrame) -> FitInputs:
         X, X_sparse = _resolve_feature_matrix(self, dataset)
-        mesh = make_mesh(self.num_workers)
         if X_sparse is not None:
             # Sparse path: the device arrays are densified (TPUs have no
             # sparse MXU path); the host CSR is kept on FitInputs so solvers
@@ -682,15 +690,20 @@ class _TpuEstimator(Params, _TpuParams):
             dtype = self._target_dtype(X)
             X = np.ascontiguousarray(X, dtype=dtype)
             n_rows, n_features = X.shape
+        pad_mult = self._feature_pad_multiple()
+        d_padded = int(n_features)
+        if pad_mult > 0 and n_features % pad_mult:
+            d_padded = -(-int(n_features) // pad_mult) * pad_mult
+        # model-axis degree is resolved AFTER the feature width is known so
+        # TPUML_MESH_MP=auto can budget against the estimator's dominant
+        # model-axis structure (the d×d Gram by default)
+        mp = resolve_mesh_mp(self._model_axis_bytes(d_padded, dtype))
+        mesh = make_mesh(self.num_workers, mp=mp)
         # chunk size must be agreed across the process world (it shapes the
         # compiled program and its collectives): derive it from the GLOBAL
         # row count, never the local partition size
         n_global = global_row_count(int(n_rows))
         csize = self._chunk_rows(n_global, mesh.shape["dp"])
-        pad_mult = self._feature_pad_multiple()
-        d_padded = int(n_features)
-        if pad_mult > 0 and n_features % pad_mult:
-            d_padded = -(-int(n_features) // pad_mult) * pad_mult
         if X_sparse is not None:
             X = np.asarray(X_sparse.todense(), dtype=dtype)
         if d_padded != n_features:
@@ -866,6 +879,11 @@ class _TpuEstimator(Params, _TpuParams):
             ) as d_span:
                 result = fit_func(inputs, ps)
                 d_span.fence(result)
+            # fit provenance (model-axis degree, per-shard bytes, ...) rides
+            # out of the kernel beside the model arrays; strip it before the
+            # estimator unpacks result into model constructor kwargs. Absent
+            # on the defaults path — reports attach only when a knob engaged.
+            fit_report = result.pop("_fit_report", None) if isinstance(result, dict) else None
             model = est._create_model(result)
             est._copyValues(model)
             est._copy_tpu_params(model)
@@ -874,6 +892,8 @@ class _TpuEstimator(Params, _TpuParams):
             # line — on the clean path.
             res_delta = _res_counters.delta_since(res_base)
             model._resilience_report = res_delta
+            if fit_report:
+                model._fit_report = fit_report
             if res_delta:
                 self.logger.info("resilience events during fit: %s", res_delta)
             if streaming:

@@ -37,7 +37,12 @@ from ..params import (
     TypeConverters,
     _mk,
 )
-from ..ops.kmeans_kernels import count_closest, kmeans_lloyd, min_sq_dists
+from ..ops.kmeans_kernels import (
+    count_closest,
+    kmeans_lloyd,
+    min_sq_dists,
+    mp_kmeans_shards,
+)
 from ..runtime import envspec
 
 _CHUNK = 4096
@@ -361,11 +366,23 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansParams):
                 matmul_dtype=mm,
             )
             # strip lane-padding columns (zero by the Lloyd invariant)
-            return {
+            result = {
                 "cluster_centers": np.asarray(centers)[:, : inputs.n_features],
                 "training_cost": float(cost),
                 "n_iter": int(n_iter),
             }
+            mp = mp_kmeans_shards(inputs.mesh, k)
+            if mp > 1:
+                kb = -(-k // mp)
+                result["_fit_report"] = {
+                    "mp_degree": mp,
+                    "centroid_shard_bytes": int(
+                        kb
+                        * inputs.n_features_padded
+                        * jnp.dtype(inputs.dtype).itemsize
+                    ),
+                }
+            return result
 
         return _fit
 

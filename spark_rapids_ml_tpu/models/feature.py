@@ -39,7 +39,12 @@ from ..params import (
     TypeConverters,
     _mk,
 )
-from ..ops.linalg import mean_and_cov, mean_and_cov_chunked, topk_eigh
+from ..ops.linalg import (
+    mean_and_cov,
+    mean_and_cov_chunked,
+    mp_gram_blocks,
+    topk_eigh,
+)
 
 
 class PCAClass:
@@ -86,21 +91,34 @@ def _pca_from_cov(mean: jax.Array, cov: jax.Array, n: jax.Array, k: int):
     }
 
 
-@functools.partial(jax.jit, static_argnames=("k", "mesh", "csize"))
-def _pca_fit_kernel(X: jax.Array, mask: jax.Array, k: int, mesh=None, csize=None):
+@functools.partial(
+    jax.jit, static_argnames=("k", "mesh", "csize", "mp_blocks")
+)
+def _pca_fit_kernel(
+    X: jax.Array, mask: jax.Array, k: int, mesh=None, csize=None,
+    mp_blocks: bool = False,
+):
     """Resident-fit kernel. With ``mesh``/``csize`` (rows dp-sharded, padded
     to a per-device ``csize`` multiple) the covariance is accumulated in
     row-chunk scans with O(csize·d) temporaries — at double-digit-GB row
     counts the fused form can materialize the centered copy of X and OOM;
     without them (e.g. 2-D (dp, mp)-sharded dry runs) the fused global-math
-    path is used."""
+    path is used. ``mp_blocks`` (static; resolve with ``mp_gram_blocks``
+    outside jit) column-shards the Gram accumulator over the mesh's mp
+    axis; the blocked covariance also rides out in the result so the
+    caller can measure its per-shard bytes."""
     if mesh is not None and _TpuEstimator.rows_chunkable(
         X.shape[0], mesh, csize
     ):
-        mean, cov, n = mean_and_cov_chunked(X, mask, mesh, csize)
+        mean, cov, n = mean_and_cov_chunked(
+            X, mask, mesh, csize, mp_blocks=mp_blocks
+        )
     else:
         mean, cov, n = mean_and_cov(X, mask)
-    return _pca_from_cov(mean, cov, n, k)
+    out = _pca_from_cov(mean, cov, n, k)
+    if mp_blocks:
+        out["cov"] = cov
+    return out
 
 
 class PCA(PCAClass, _TpuEstimator, _PCAParams):
@@ -136,10 +154,27 @@ class PCA(PCAClass, _TpuEstimator, _PCAParams):
                 raise ValueError(
                     f"k={k} must be <= number of features {inputs.n_features}"
                 )
-            out = _pca_fit_kernel(
-                inputs.X, inputs.mask, k, mesh=inputs.mesh, csize=inputs.csize
+            mp = mp_gram_blocks(inputs.mesh, inputs.X.shape[1])
+            use_mp = mp > 1 and _TpuEstimator.rows_chunkable(
+                inputs.X.shape[0], inputs.mesh, inputs.csize
             )
-            return {key: np.asarray(v) for key, v in out.items()}
+            out = _pca_fit_kernel(
+                inputs.X, inputs.mask, k, mesh=inputs.mesh,
+                csize=inputs.csize, mp_blocks=use_mp,
+            )
+            report = None
+            if use_mp:
+                cov = out.pop("cov")
+                report = {
+                    "mp_degree": mp,
+                    "gram_shard_bytes": int(
+                        cov.addressable_shards[0].data.nbytes
+                    ),
+                }
+            result = {key: np.asarray(v) for key, v in out.items()}
+            if report:
+                result["_fit_report"] = report
+            return result
 
         return _fit
 
@@ -160,9 +195,13 @@ class PCA(PCAClass, _TpuEstimator, _PCAParams):
                 inputs.source, inputs.mesh, inputs.chunk_rows, inputs.dtype,
                 with_y=False, fit_intercept=True,
             )
+            report = stats.pop("_mp_report", None)
             cov = stats["G"] / (stats["n"] - 1.0)
             out = _pca_from_cov(stats["mean_x"], cov, stats["n"], k)
-            return {key: np.asarray(v) for key, v in out.items()}
+            result = {key: np.asarray(v) for key, v in out.items()}
+            if report:
+                result["_fit_report"] = report
+            return result
 
         return _fit
 

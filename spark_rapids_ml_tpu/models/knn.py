@@ -591,6 +591,7 @@ class ApproximateNearestNeighborsModel(
         from ..ops.ivf_kernels import (
             ivf_feasible,
             ivf_search,
+            last_search_report,
             resolve_ann_gate_rows,
         )
         from ..parallel.context import ensure_distributed
@@ -672,6 +673,8 @@ class ApproximateNearestNeighborsModel(
             "build_seconds": round(stages.get("build", 0.0), 4),
             "search_seconds": round(stages.get("search", 0.0), 4),
         }
+        # list-sharded search provenance (empty on the replicated layout)
+        self._ann_report.update(last_search_report())
         return item_df, query_df_withid, knn_df
 
     def approxSimilarityJoin(

@@ -44,6 +44,7 @@ from ..params import (
     TypeConverters,
     _mk,
 )
+from ..ops.linalg import mp_gram_blocks
 from ..ops.linreg_kernels import (
     linreg_suffstats,
     linreg_suffstats_chunked,
@@ -290,26 +291,42 @@ class LinearRegression(
     def _get_tpu_fit_func(self, dataset: DataFrame) -> FitFunc:
         stats_cache: Dict[bool, Dict[str, jax.Array]] = {}
 
+        blocked_mp: Dict[bool, int] = {}
+
         def _fit(inputs: FitInputs, params: Dict[str, Any]) -> Dict[str, Any]:
             fit_intercept = bool(params["fit_intercept"])
             if fit_intercept not in stats_cache:
                 # the single data pass — shared by every param map
                 csize = inputs.csize
+                mp = mp_gram_blocks(inputs.mesh, inputs.X.shape[1])
                 if self.rows_chunkable(inputs.X.shape[0], inputs.mesh, csize):
                     stats_cache[fit_intercept] = linreg_suffstats_chunked(
                         inputs.X, inputs.mask, inputs.y, inputs.weight,
                         mesh=inputs.mesh, csize=csize,
                         fit_intercept=fit_intercept,
                         weighted=inputs.weight is not None,
+                        mp_blocks=mp > 1,
                     )
+                    blocked_mp[fit_intercept] = mp
                 else:
                     stats_cache[fit_intercept] = linreg_suffstats(
                         inputs.X, inputs.mask, inputs.y, inputs.weight,
                         fit_intercept=fit_intercept,
                     )
-            return self._solve_from_stats(
+                    blocked_mp[fit_intercept] = 1
+            result = self._solve_from_stats(
                 stats_cache[fit_intercept], params, inputs.dtype
             )
+            mp = blocked_mp[fit_intercept]
+            if mp > 1:
+                G = stats_cache[fit_intercept]["G"]
+                result["_fit_report"] = {
+                    "mp_degree": mp,
+                    "gram_shard_bytes": int(
+                        G.addressable_shards[0].data.nbytes
+                    ),
+                }
+            return result
 
         return _fit
 
@@ -330,9 +347,12 @@ class LinearRegression(
                     inputs.source, inputs.mesh, inputs.chunk_rows, inputs.dtype,
                     with_y=True, fit_intercept=fit_intercept,
                 )
-            return self._solve_from_stats(
-                stats_cache[fit_intercept], params, inputs.dtype
-            )
+            stats = dict(stats_cache[fit_intercept])
+            report = stats.pop("_mp_report", None)
+            result = self._solve_from_stats(stats, params, inputs.dtype)
+            if report:
+                result["_fit_report"] = report
+            return result
 
         return _fit
 

@@ -27,7 +27,9 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding
+
+from ..parallel.layout import LAYOUT
 
 from ..core import FitFunc, FitInputs, _TpuEstimatorSupervised, _TpuModel
 from ..data.dataframe import DataFrame
@@ -364,7 +366,7 @@ class _RandomForestEstimator(_RandomForestClass, _TpuEstimatorSupervised, _Rando
             # array is not possible)
             keys = jax.make_array_from_callback(
                 keys_np.shape,
-                NamedSharding(inputs.mesh, P(DP_AXIS)),
+                NamedSharding(inputs.mesh, LAYOUT.rows()),
                 lambda idx: keys_np[idx],
             )
 
@@ -1373,7 +1375,7 @@ class _GBTEstimator(_GBTClass, _TpuEstimatorSupervised, _GBTParams):
             n_pad_global = bins.shape[0]
             margins = jax.make_array_from_callback(
                 (n_pad_global, n_v),
-                NamedSharding(inputs.mesh, P(DP_AXIS)),
+                NamedSharding(inputs.mesh, LAYOUT.rows()),
                 lambda idx: np.ascontiguousarray(
                     np.broadcast_to(init[None, :], (n_pad_global, n_v))[idx]
                 ),
@@ -1426,7 +1428,7 @@ class _GBTEstimator(_GBTClass, _TpuEstimatorSupervised, _GBTParams):
                 r0, saved, _ = resumed
                 margins = jax.make_array_from_callback(
                     (n_pad_global, n_v),
-                    NamedSharding(inputs.mesh, P(DP_AXIS)),
+                    NamedSharding(inputs.mesh, LAYOUT.rows()),
                     lambda idx: np.ascontiguousarray(saved["margins"][idx]),
                 )
                 # the committed forest prefix rides as one pseudo-round

@@ -42,8 +42,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from ._compat import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
+from ..parallel.layout import LAYOUT
 from ..parallel.mesh import DP_AXIS
 from .tree_kernels import ForestConfig, _grow_trees_batched
 
@@ -151,11 +152,11 @@ def gbt_round(
     feat, thr_bin, leaf_stats, gain, values, margins = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(DP_AXIS), P(DP_AXIS), P(DP_AXIS), P(DP_AXIS), P()),
+        in_specs=(LAYOUT.rows(), LAYOUT.rows(), LAYOUT.rows(), LAYOUT.rows(), LAYOUT.replicated()),
         # tree tables are computed from all-reduced histograms — identical
         # on every device, so they leave replicated (check_vma=False as in
         # build_forest: the builder's internals mix manual collectives)
-        out_specs=(P(), P(), P(), P(), P(), P(DP_AXIS)),
+        out_specs=(LAYOUT.replicated(), LAYOUT.replicated(), LAYOUT.replicated(), LAYOUT.replicated(), LAYOUT.replicated(), LAYOUT.rows()),
         check_vma=False,
     )(bins, mask, y, margins, key)
     return {
@@ -198,7 +199,7 @@ def gbt_loss(
     return shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(DP_AXIS), P(DP_AXIS), P(DP_AXIS)),
-        out_specs=P(),
+        in_specs=(LAYOUT.rows(), LAYOUT.rows(), LAYOUT.rows()),
+        out_specs=LAYOUT.replicated(),
         check_vma=False,
     )(y, margins, mask)

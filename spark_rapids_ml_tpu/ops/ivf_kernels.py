@@ -33,7 +33,7 @@ VMEM-resident item block for a kernel to exploit — unlike the dense
 distance tile ``knn_pallas.py`` fuses. See ``docs/ann_performance.md``.
 
 Distribution: queries are dp-sharded exactly like ``ring_knn``'s query
-side; the (replicated) index arrays ride ``P()`` specs. Rotating index
+side; the (replicated) index arrays ride ``LAYOUT.replicated()`` specs. Rotating index
 shards around the ring — the exact path's layout — would multiply the
 sparse gather passes by ``n_dev`` without reducing per-device work, since
 a probe touches O(nprobe * cap) rows wherever they live.
@@ -51,10 +51,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from ._compat import shard_map
-from ..parallel.mesh import DP_AXIS
+from ..parallel.layout import LAYOUT
+from ..parallel.mesh import DP_AXIS, MP_AXIS
 from .kmeans_kernels import kmeans_lloyd, pairwise_sq_dists
 from .knn_kernels import _tile_top_k
 
@@ -106,6 +107,22 @@ def resolve_umap_graph() -> str:
     from ..runtime import envspec
 
     return str(envspec.get("TPUML_UMAP_GRAPH"))
+
+
+def mp_ivf_shards(mesh, nlist: int) -> int:
+    """Resolved model-axis degree for list-sharded IVF search: the mesh's
+    mp extent when ``TPUML_MP_IVF`` is on and there are at least mp lists,
+    else 1. Reads the env OUTSIDE jit."""
+    from ..runtime import envspec
+
+    from ..parallel.mesh import MP_AXIS
+
+    n_mp = int(mesh.shape.get(MP_AXIS, 1))
+    if n_mp <= 1 or nlist < n_mp:
+        return 1
+    if str(envspec.get("TPUML_MP_IVF")) == "off":
+        return 1
+    return n_mp
 
 
 def resolve_ann_gate_rows() -> int:
@@ -503,8 +520,134 @@ def _ivf_search_sharded(
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(DP_AXIS), P(), P(), P(), P()),
-        out_specs=(P(DP_AXIS), P(DP_AXIS)),
+        in_specs=(LAYOUT.rows(), LAYOUT.replicated(), LAYOUT.replicated(), LAYOUT.replicated(), LAYOUT.replicated()),
+        out_specs=(LAYOUT.rows(), LAYOUT.rows()),
+        check_vma=False,
+    )(Xq, cents, gx, gsq, gids)
+
+
+def _probe_scan_mp(
+    Xq_l: jax.Array,
+    cents: jax.Array,
+    gx_l: jax.Array,
+    gsq_l: jax.Array,
+    gids_l: jax.Array,
+    *,
+    k: int,
+    nprobe: int,
+    cap: int,
+    topk_impl: str,
+    qchunk: int,
+    n_local: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """:func:`_probe_scan` with the grouped index LIST-SHARDED over mp.
+
+    Each device holds only its own ``n_local = nlist_pad/mp`` lists
+    (``LAYOUT.list_blocks()`` on dim 0 of the grouped arrays) — the index
+    residency that bounds corpus size on a chip shrinks by 1/mp. The
+    coarse quantizer stays replicated (it is (nlist, d) — small), so
+    every mp peer ranks the same probe sequence; per probe only the
+    OWNING shard gathers real candidates, the rest fold masked +inf/-1
+    rows (a no-op on their running top-k). One all-gather of the per-shard
+    (k) finalists per query chunk — O(mp·k) per row, never the candidate
+    tiles — and a (qc, mp·k) top-k merge produce the global result.
+    Probed lists are disjoint across shards, so the merged pool equals the
+    replicated path's candidate pool exactly: recall is identical at equal
+    nprobe (docs/mesh.md tolerance contract)."""
+    from ..parallel.mesh import MP_AXIS
+
+    nq = Xq_l.shape[0]
+    qc = min(qchunk, nq)
+    pad = (-nq) % qc
+    Xq_p = jnp.pad(Xq_l, ((0, pad), (0, 0)))
+    c_sq = (cents * cents).sum(axis=1)
+    cap_ar = jnp.arange(cap, dtype=jnp.int32)
+    l0 = lax.axis_index(MP_AXIS) * n_local     # first OWNED global list id
+
+    def qbody(_, xq):
+        x_sq = (xq * xq).sum(axis=1)
+        dc = pairwise_sq_dists(xq, cents, c_sq)  # (qc, nlist) MXU
+        _, probes = lax.top_k(-dc, nprobe)       # (qc, nprobe) global ids
+        bd0 = jnp.full((qc, k), jnp.inf, Xq_l.dtype)
+        bi0 = jnp.full((qc, k), -1, jnp.int32)
+
+        def pstep(carry, pj):
+            bd, bi = carry
+            local = pj - l0                          # (qc,)
+            own = (local >= 0) & (local < n_local)
+            lc = jnp.clip(local, 0, n_local - 1)     # clamped: gather legal
+            cand = lc[:, None] * cap + cap_ar[None, :]
+            xi = gx_l[cand]                          # (qc, cap, d)
+            csq = gsq_l[cand]
+            ids = gids_l[cand]
+            dots = jnp.einsum("qd,qcd->qc", xq, xi)
+            d2 = jnp.maximum(x_sq[:, None] - 2.0 * dots + csq, 0.0)
+            d2 = jnp.where(own[:, None], d2, jnp.inf)
+            ids = jnp.where(own[:, None], ids, -1)
+            if cap < k:
+                d2 = jnp.pad(
+                    d2, ((0, 0), (0, k - cap)), constant_values=jnp.inf
+                )
+                ids = jnp.pad(
+                    ids, ((0, 0), (0, k - cap)), constant_values=-1
+                )
+            negd, sel = _tile_top_k(-d2, k, topk_impl)
+            blk_ids = jnp.take_along_axis(ids, sel, axis=1)
+            cat_d = jnp.concatenate([bd, -negd], axis=1)
+            cat_i = jnp.concatenate([bi, blk_ids], axis=1)
+            negm, selm = lax.top_k(-cat_d, k)
+            return (-negm, jnp.take_along_axis(cat_i, selm, axis=1)), None
+
+        (bd, bi), _ = lax.scan(
+            pstep, (bd0, bi0), jnp.transpose(probes)
+        )
+        # 2k-style shard merge: every peer's k finalists, one all-gather
+        abd = lax.all_gather(bd, MP_AXIS)            # (mp, qc, k)
+        abi = lax.all_gather(bi, MP_AXIS)
+        cat_d = jnp.moveaxis(abd, 0, 1).reshape(qc, -1)
+        cat_i = jnp.moveaxis(abi, 0, 1).reshape(qc, -1)
+        negm, selm = lax.top_k(-cat_d, k)
+        return None, (-negm, jnp.take_along_axis(cat_i, selm, axis=1))
+
+    _, (bd, bi) = lax.scan(
+        qbody, None, Xq_p.reshape(-1, qc, Xq_l.shape[1])
+    )
+    return bd.reshape(-1, k)[:nq], bi.reshape(-1, k)[:nq]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "k", "nprobe", "cap", "topk_impl", "qchunk", "n_local"
+    ),
+)
+def _ivf_search_sharded_mp(
+    Xq: jax.Array,
+    cents: jax.Array,
+    gx: jax.Array,
+    gsq: jax.Array,
+    gids: jax.Array,
+    *,
+    mesh: Mesh,
+    k: int,
+    nprobe: int,
+    cap: int,
+    topk_impl: str,
+    qchunk: int,
+    n_local: int,
+) -> Tuple[jax.Array, jax.Array]:
+    from ..parallel.mesh import MP_AXIS
+
+    body = functools.partial(
+        _probe_scan_mp,
+        k=k, nprobe=nprobe, cap=cap, topk_impl=topk_impl, qchunk=qchunk,
+        n_local=n_local,
+    )
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(LAYOUT.rows(), LAYOUT.replicated(), LAYOUT.list_blocks(), LAYOUT.list_blocks(), LAYOUT.list_blocks()),
+        out_specs=(LAYOUT.rows(), LAYOUT.rows()),
         check_vma=False,
     )(Xq, cents, gx, gsq, gids)
 
@@ -531,6 +674,19 @@ def _ivf_search_local(
     )
 
 
+# provenance of the most recent ivf_search dispatch (mirrors
+# ops.streaming.last_ingest_report): callers read it AFTER the search to
+# surface mp_degree / measured per-shard index bytes without threading a
+# side channel through the return contract.
+_LAST_SEARCH_REPORT: dict = {}
+
+
+def last_search_report() -> dict:
+    """Copy of the most recent :func:`ivf_search` dispatch provenance.
+    Empty dict when the last search ran the replicated (1-D) layout."""
+    return dict(_LAST_SEARCH_REPORT)
+
+
 def ivf_search(
     Xq: jax.Array,
     index: IvfIndex,
@@ -549,7 +705,15 @@ def ivf_search(
     whole search runs on the default device (the single-host UMAP graph
     path, mirroring ``knn_brute``). ``topk_impl`` comes from
     ``resolve_knn_topk()`` — resolved by the caller outside jit.
+
+    On a mesh with a model axis (and ``TPUML_MP_IVF`` on) the grouped
+    index arrays are list-sharded over mp — lists padded to a multiple of
+    mp with never-probed empty slots — and the probe scan runs
+    :func:`_probe_scan_mp`; :func:`last_search_report` then carries
+    ``mp_degree`` and the measured per-shard index bytes.
     """
+    global _LAST_SEARCH_REPORT
+    _LAST_SEARCH_REPORT = {}
     qchunk = _search_qchunk(index.cap, index.grouped_x.shape[1])
     if mesh is None:
         return _ivf_search_local(
@@ -558,9 +722,48 @@ def ivf_search(
             k=k, nprobe=nprobe, cap=index.cap, topk_impl=topk_impl,
             qchunk=qchunk,
         )
+    n_mp = mp_ivf_shards(mesh, index.nlist)
+    if n_mp > 1:
+        cap = index.cap
+        n_local = -(-index.nlist // n_mp)
+        nlist_pad = n_local * n_mp
+        pad_rows = (nlist_pad - index.nlist) * cap
+        gx, gsq, gids = index.grouped_x, index.grouped_sq, index.grouped_ids
+        if pad_rows:
+            # empty pad lists: +inf ||x||² / -1 ids keep the slot
+            # convention; their global list ids exceed nlist-1 so the
+            # coarse quantizer can never rank them into a probe set
+            gx = jnp.concatenate(
+                [gx, jnp.zeros((pad_rows, gx.shape[1]), gx.dtype)]
+            )
+            gsq = jnp.concatenate(
+                [gsq, jnp.full((pad_rows,), jnp.inf, gsq.dtype)]
+            )
+            gids = jnp.concatenate(
+                [gids, jnp.full((pad_rows,), -1, gids.dtype)]
+            )
+        rep = NamedSharding(mesh, LAYOUT.replicated())
+        blocks = NamedSharding(mesh, LAYOUT.list_blocks())
+        cents = jax.device_put(index.centroids, rep)
+        gx = jax.device_put(gx, blocks)
+        gsq = jax.device_put(gsq, blocks)
+        gids = jax.device_put(gids, blocks)
+        _LAST_SEARCH_REPORT = {
+            "mp_degree": n_mp,
+            "index_shard_bytes": int(
+                gx.addressable_shards[0].data.nbytes
+                + gsq.addressable_shards[0].data.nbytes
+                + gids.addressable_shards[0].data.nbytes
+            ),
+        }
+        return _ivf_search_sharded_mp(
+            Xq, cents, gx, gsq, gids,
+            mesh=mesh, k=k, nprobe=nprobe, cap=cap, topk_impl=topk_impl,
+            qchunk=qchunk, n_local=n_local,
+        )
     # pin the (replicated) index operands to the SEARCH mesh: the build may
     # have committed them elsewhere, and jit refuses mixed device sets
-    rep = NamedSharding(mesh, P())
+    rep = NamedSharding(mesh, LAYOUT.replicated())
     cents, gx, gsq, gids = (
         jax.device_put(a, rep)
         for a in (

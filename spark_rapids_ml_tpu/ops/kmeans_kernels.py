@@ -22,9 +22,10 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 from ._compat import shard_map
 
+from ..parallel.layout import LAYOUT
 from ..parallel.mesh import DP_AXIS
 from .linalg import check_row_chunking, row_chunk
 
@@ -119,9 +120,29 @@ def _chunk_stats(X_local, mask_local, centers, csize: int, matmul_dtype=None):
     return lax.fori_loop(0, n_chunks, body, init)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("mesh", "csize", "max_iter", "matmul_dtype")
-)
+def mp_kmeans_shards(mesh, k: int) -> int:
+    """Resolved model-axis degree for centroid-sharded Lloyd: the mesh's mp
+    extent when ``TPUML_MP_KMEANS`` is on and there are at least mp
+    centroids, else 1. Reads the env OUTSIDE jit."""
+    from ..runtime import envspec
+
+    from ..parallel.mesh import MP_AXIS
+
+    n_mp = int(mesh.shape.get(MP_AXIS, 1))
+    if n_mp <= 1 or k < n_mp:
+        return 1
+    if str(envspec.get("TPUML_MP_KMEANS")) == "off":
+        return 1
+    return n_mp
+
+
+# Sentinel coordinate for k-padding rows on the centroid-sharded path:
+# large enough that a padded center can never win an argmin against any
+# real center, small enough that ||c||² = d·1e30 stays finite in f32
+# (jnp.inf would poison the centroid-shift reduction with inf-inf=NaN).
+_PAD_CENTER = 1e15
+
+
 def kmeans_lloyd(
     X: jax.Array,
     mask: jax.Array,
@@ -133,7 +154,48 @@ def kmeans_lloyd(
     tol: float,
     matmul_dtype=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Run Lloyd to convergence. Returns (centers, cost, n_iters)."""
+    """Run Lloyd to convergence. Returns (centers, cost, n_iters).
+
+    Dispatching wrapper: resolves the centroid-sharding gate (env read —
+    must stay outside jit) and routes to the replicated-table kernel or the
+    mp-sharded one. With ``TPUML_MESH_MP`` unset the mesh has no model axis
+    and this is exactly the historical 1-D program."""
+    k = centers0.shape[0]
+    n_mp = mp_kmeans_shards(mesh, k)
+    if n_mp == 1:
+        return _kmeans_lloyd_1d(
+            X, mask, centers0, mesh=mesh, csize=csize, max_iter=max_iter,
+            tol=tol, matmul_dtype=matmul_dtype,
+        )
+    kb = -(-k // n_mp)
+    k_pad = kb * n_mp
+    if k_pad != k:
+        pad = jnp.full(
+            (k_pad - k, centers0.shape[1]), _PAD_CENTER, centers0.dtype
+        )
+        centers0 = jnp.concatenate([centers0, pad], axis=0)
+    centers, cost, it = _kmeans_lloyd_mp(
+        X, mask, centers0, mesh=mesh, csize=csize, max_iter=max_iter,
+        tol=tol, matmul_dtype=matmul_dtype, n_mp=n_mp,
+    )
+    return centers[:k], cost, it
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "csize", "max_iter", "matmul_dtype")
+)
+def _kmeans_lloyd_1d(
+    X: jax.Array,
+    mask: jax.Array,
+    centers0: jax.Array,
+    *,
+    mesh: Mesh,
+    csize: int,
+    max_iter: int,
+    tol: float,
+    matmul_dtype=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Replicated-centroid-table Lloyd (the historical kernel)."""
 
     def per_device(X_local, mask_local, centers):
         def cond(state):
@@ -178,8 +240,133 @@ def kmeans_lloyd(
     return shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(DP_AXIS), P(DP_AXIS), P()),
-        out_specs=(P(), P(), P()),
+        in_specs=(LAYOUT.rows(), LAYOUT.rows(), LAYOUT.replicated()),
+        out_specs=(LAYOUT.replicated(), LAYOUT.replicated(), LAYOUT.replicated()),
+        check_vma=False,
+    )(X, mask, centers0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "csize", "max_iter", "matmul_dtype", "n_mp"),
+)
+def _kmeans_lloyd_mp(
+    X: jax.Array,
+    mask: jax.Array,
+    centers0: jax.Array,
+    *,
+    mesh: Mesh,
+    csize: int,
+    max_iter: int,
+    tol: float,
+    matmul_dtype=None,
+    n_mp: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Centroid-sharded Lloyd: the k axis is partitioned over mp.
+
+    Each device computes distance tiles against only its OWN (k/mp, d)
+    centroid block — the (chunk, k) distance tile and the one-hot stats
+    contraction, the two structures that bound k on a chip, shrink by
+    1/mp. Per chunk the per-shard (min, argmin) pairs are all-gathered
+    over mp (2 floats + int per row per shard — O(mp·chunk), not O(k·d))
+    and reduced to the global assignment; cross-shard ties resolve to the
+    LOWEST shard index, which together with argmin's first-occurrence
+    within a block reproduces ``jnp.argmin``'s tie-break over the full
+    row, so assignments are identical to the 1-D kernel up to matmul
+    reduction-order rounding (docs/mesh.md tolerance contract). Stats
+    accumulate for the own block only, psum over dp, and the updated
+    blocks all-gather over mp into the replicated table the next
+    iteration slices.
+
+    ``centers0`` must be k-padded to a multiple of ``n_mp`` with
+    ``_PAD_CENTER`` sentinel rows (the :func:`kmeans_lloyd` wrapper does
+    this); sentinel centers never win an argmin, keep zero counts, and so
+    persist unchanged through every update.
+    """
+    from ..parallel.mesh import MP_AXIS
+
+    k_pad = centers0.shape[0]
+    kb = k_pad // n_mp
+
+    def per_device(X_local, mask_local, centers):
+        s = lax.axis_index(MP_AXIS)
+        nc = check_row_chunking(X_local.shape[0], csize)
+
+        def assign_rows(x, m, block, c_sq_b, mm_dtype):
+            """Global (assign, best-d²) for one chunk from the own-block
+            distances + the (mp, chunk) all-gathered partial argmins."""
+            d2 = pairwise_sq_dists(x, block, c_sq_b, matmul_dtype=mm_dtype)
+            lmin = d2.min(axis=1)
+            larg = d2.argmin(axis=1) + s * kb
+            gmin = lax.all_gather(lmin, MP_AXIS)     # (mp, chunk)
+            garg = lax.all_gather(larg, MP_AXIS)     # (mp, chunk)
+            win = jnp.argmin(gmin, axis=0)           # ties -> lowest shard
+            cols = jnp.arange(x.shape[0])
+            return garg[win, cols], gmin[win, cols]
+
+        def iter_stats(centers, mm_dtype):
+            block = lax.dynamic_slice_in_dim(centers, s * kb, kb, 0)
+            c_sq_b = (block * block).sum(axis=1)
+
+            def body(i, carry):
+                sums, counts, cost = carry
+                x, m = row_chunk(i, csize, X_local, mask_local)
+                assign, best = assign_rows(x, m, block, c_sq_b, mm_dtype)
+                # one-hot over the OWN block only: rows assigned elsewhere
+                # contribute nothing here (their owner accumulates them)
+                local = assign - s * kb
+                own = (local >= 0) & (local < kb)
+                onehot = (
+                    jax.nn.one_hot(jnp.where(own, local, 0), kb, dtype=x.dtype)
+                    * (own & (m > 0))[:, None]
+                )
+                sums = sums + stats_dot(onehot, x, mm_dtype)
+                counts = counts + onehot.sum(axis=0).astype(jnp.int32)
+                cost = cost + (best * m).sum()
+                return (sums, counts, cost)
+
+            init = (
+                jnp.zeros((kb, X_local.shape[1]), X_local.dtype),
+                jnp.zeros((kb,), jnp.int32),
+                jnp.zeros((), X_local.dtype),
+            )
+            return block, lax.fori_loop(0, nc, body, init)
+
+        def cond(state):
+            centers, prev_shift, it = state
+            return jnp.logical_and(it < max_iter, prev_shift > tol * tol)
+
+        def body(state):
+            centers, _, it = state
+            block, (sums, counts, _) = iter_stats(centers, matmul_dtype)
+            sums = lax.psum(sums, DP_AXIS)
+            counts = lax.psum(counts, DP_AXIS)
+            countsf = counts.astype(sums.dtype)
+            safe = jnp.maximum(countsf, 1.0)
+            # empty cluster keeps its previous center (Spark behavior);
+            # sentinel pad rows always fall here (zero counts, unchanged)
+            new_block = jnp.where(
+                counts[:, None] > 0, sums / safe[:, None], block
+            )
+            new_centers = lax.all_gather(
+                new_block, MP_AXIS, tiled=True
+            )  # (k_pad, d), shard-order = global centroid order
+            shift = ((new_centers - centers) ** 2).sum(axis=1).max()
+            return (new_centers, shift, it + 1)
+
+        state = (centers, jnp.asarray(jnp.inf, X_local.dtype), jnp.asarray(0))
+        centers, _, it = lax.while_loop(cond, body, state)
+        # final cost pass at converged centers, always f32 operands (see
+        # the 1-D kernel's cancellation note)
+        _, (_, _, cost) = iter_stats(centers, None)
+        cost = lax.psum(cost, DP_AXIS)
+        return centers, cost, it
+
+    return shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(LAYOUT.rows(), LAYOUT.rows(), LAYOUT.replicated()),
+        out_specs=(LAYOUT.replicated(), LAYOUT.replicated(), LAYOUT.replicated()),
         check_vma=False,
     )(X, mask, centers0)
 
@@ -207,8 +394,8 @@ def min_sq_dists(
     return shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(DP_AXIS), P(DP_AXIS), P()),
-        out_specs=P(DP_AXIS),
+        in_specs=(LAYOUT.rows(), LAYOUT.rows(), LAYOUT.replicated()),
+        out_specs=LAYOUT.rows(),
         check_vma=False,
     )(X, mask, centers)
 
@@ -226,7 +413,7 @@ def count_closest(
     return shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(DP_AXIS), P(DP_AXIS), P()),
-        out_specs=P(),
+        in_specs=(LAYOUT.rows(), LAYOUT.rows(), LAYOUT.replicated()),
+        out_specs=LAYOUT.replicated(),
         check_vma=False,
     )(X, mask, centers)

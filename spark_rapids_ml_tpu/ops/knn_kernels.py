@@ -24,8 +24,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from ._compat import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
+from ..parallel.layout import LAYOUT
 from ..parallel.mesh import DP_AXIS
 from .kmeans_kernels import pairwise_sq_dists
 
@@ -234,7 +235,7 @@ def ring_knn(
     return shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(DP_AXIS), P(DP_AXIS), P(DP_AXIS), P(DP_AXIS)),
-        out_specs=(P(DP_AXIS), P(DP_AXIS)),
+        in_specs=(LAYOUT.rows(), LAYOUT.rows(), LAYOUT.rows(), LAYOUT.rows()),
+        out_specs=(LAYOUT.rows(), LAYOUT.rows()),
         check_vma=False,
     )(Xq, Xi, mi, ids_i)

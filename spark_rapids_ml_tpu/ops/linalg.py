@@ -17,9 +17,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from ._compat import pallas_tpu_compiler_params, shard_map
-from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import DP_AXIS
+from ..parallel.layout import LAYOUT
+from ..parallel.mesh import DP_AXIS, MP_AXIS
 
 
 def masked_mean(X: jax.Array, mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -167,6 +167,22 @@ def _shifted_gram_pallas(
     return G, s[0]
 
 
+def mp_gram_blocks(mesh, d: int) -> int:
+    """Resolved model-axis degree for the blocked (feature-sharded) Gram
+    accumulators: the mesh's mp extent when ``TPUML_MP_GRAM`` is on and the
+    (padded) feature width splits evenly across it, else 1. Reads the env
+    OUTSIDE jit — callers pass the result in as a static arg so retraces
+    track the knob."""
+    from ..runtime import envspec
+
+    n_mp = int(mesh.shape.get(MP_AXIS, 1))
+    if n_mp <= 1 or d % n_mp != 0:
+        return 1
+    if str(envspec.get("TPUML_MP_GRAM")) == "off":
+        return 1
+    return n_mp
+
+
 def _pallas_gram_ok(d: int, dtype) -> bool:
     """Trace-time gate for the Pallas gram path: TPU backend, lane-aligned
     feature width, f32 (the kernel accumulates in f32; f64 fits keep the
@@ -182,7 +198,7 @@ def _pallas_gram_ok(d: int, dtype) -> bool:
 
 
 def mean_and_cov_chunked(
-    X: jax.Array, mask: jax.Array, mesh, csize: int
+    X: jax.Array, mask: jax.Array, mesh, csize: int, *, mp_blocks: bool = False
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """:func:`mean_and_cov` with O(csize·d) temporaries and ~1 pass over X.
 
@@ -209,9 +225,27 @@ def mean_and_cov_chunked(
 
     Requires per-device rows divisible by ``csize`` (``shard_rows`` pads to
     this); rows must be sharded over dp only.
+
+    With ``mp_blocks`` (resolve via :func:`mp_gram_blocks` — env is read
+    outside jit) each device accumulates only its OWN column block of the
+    shifted Gram, ``Σ m·(x-μ̂)(x-μ̂[blk])ᵀ`` of shape (d, d/mp): the d²
+    accumulator — the structure that bounds feature width on a chip —
+    shrinks by 1/mp, the SUMMA-style row-panel × column-panel product. The
+    psum stays over dp only (mp peers hold *different* blocks, dp peers the
+    same block) and the returned covariance is column-sharded over mp
+    (``LAYOUT.cols()``). Per-element reduction order matches the full-width
+    scan, so parity with the 1-D path is tight (see docs/mesh.md tolerance
+    contract).
     """
 
-    use_pallas = _pallas_gram_ok(X.shape[1], X.dtype)
+    n_mp = int(mesh.shape.get(MP_AXIS, 1)) if mp_blocks else 1
+    if n_mp > 1 and X.shape[1] % n_mp != 0:
+        raise ValueError(
+            f"blocked Gram requires feature width ({X.shape[1]}) divisible "
+            f"by the mp extent ({n_mp}); gate with mp_gram_blocks"
+        )
+    bw = X.shape[1] // n_mp
+    use_pallas = n_mp == 1 and _pallas_gram_ok(X.shape[1], X.dtype)
 
     def per_device(Xl, ml):
         d = Xl.shape[1]
@@ -232,12 +266,19 @@ def mean_and_cov_chunked(
             cnt = ml.sum()
         else:
             nc = check_row_chunking(Xl.shape[0], csize)
+            # column-block start of THIS device's Gram panel (0 at mp=1)
+            c0 = lax.axis_index(MP_AXIS) * bw if n_mp > 1 else 0
 
             def body(i, carry):
                 s, cnt, G = carry
                 x, m = row_chunk(i, csize, Xl, ml)
                 xs = (x - mean_hat[None, :]) * m[:, None]
-                return (s + xs.sum(axis=0), cnt + m.sum(), G + xs.T @ xs)
+                xb = (
+                    lax.dynamic_slice_in_dim(xs, c0, bw, 1)
+                    if n_mp > 1
+                    else xs
+                )
+                return (s + xs.sum(axis=0), cnt + m.sum(), G + xs.T @ xb)
 
             s, cnt, G = lax.fori_loop(
                 0,
@@ -246,7 +287,7 @@ def mean_and_cov_chunked(
                 (
                     jnp.zeros((d,), Xl.dtype),
                     jnp.zeros((), Xl.dtype),
-                    jnp.zeros((d, d), Xl.dtype),
+                    jnp.zeros((d, bw), Xl.dtype),
                 ),
             )
         n = lax.psum(cnt, DP_AXIS)
@@ -254,14 +295,20 @@ def mean_and_cov_chunked(
         G = lax.psum(G, DP_AXIS)
         delta = s / n                      # exact mean minus μ̂
         mean = mean_hat + delta
-        cov = (G - n * jnp.outer(delta, delta)) / (n - 1.0)
+        if n_mp > 1:
+            delta_b = lax.dynamic_slice_in_dim(
+                delta, lax.axis_index(MP_AXIS) * bw, bw, 0
+            )
+            cov = (G - n * jnp.outer(delta, delta_b)) / (n - 1.0)
+        else:
+            cov = (G - n * jnp.outer(delta, delta)) / (n - 1.0)
         return mean, cov, n
 
     return shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(DP_AXIS), P(DP_AXIS)),
-        out_specs=(P(), P(), P()),
+        in_specs=(LAYOUT.rows(), LAYOUT.rows()),
+        out_specs=(LAYOUT.replicated(), LAYOUT.cols() if n_mp > 1 else LAYOUT.replicated(), LAYOUT.replicated()),
         check_vma=False,
     )(X, mask)
 

@@ -71,7 +71,8 @@ def linreg_suffstats(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "csize", "fit_intercept", "weighted")
+    jax.jit,
+    static_argnames=("mesh", "csize", "fit_intercept", "weighted", "mp_blocks"),
 )
 def linreg_suffstats_chunked(
     X: jax.Array,
@@ -83,6 +84,7 @@ def linreg_suffstats_chunked(
     csize: int,
     fit_intercept: bool = True,
     weighted: bool = False,
+    mp_blocks: bool = False,
 ) -> Dict[str, jax.Array]:
     """:func:`linreg_suffstats` with O(csize·d) temporaries and one pass.
 
@@ -106,19 +108,35 @@ def linreg_suffstats_chunked(
     covariance, where the Pallas gram kernel beats XLA ~1.9×. The scan is
     kept as the single implementation; don't re-add a Pallas path here
     without profiling past that result.
+
+    With ``mp_blocks`` (gate via ``ops.linalg.mp_gram_blocks`` — env read
+    outside jit) the d×d Gram accumulates as each device's own (d, d/mp)
+    column block, psum over dp only, returned column-sharded over mp
+    (``LAYOUT.cols()``) — same SUMMA panel product as the blocked
+    covariance. The d-vector statistics (Xy, sums, variance) stay
+    replicated: they are O(d), not O(d²).
     """
     from ._compat import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    from ..parallel.mesh import DP_AXIS
+    from ..parallel.layout import LAYOUT
+    from ..parallel.mesh import DP_AXIS, MP_AXIS
     from .linalg import check_row_chunking, row_chunk
 
     if not weighted:
         row_w = None
 
+    n_mp = int(mesh.shape.get(MP_AXIS, 1)) if mp_blocks else 1
+    if n_mp > 1 and X.shape[1] % n_mp != 0:
+        raise ValueError(
+            f"blocked Gram requires feature width ({X.shape[1]}) divisible "
+            f"by the mp extent ({n_mp}); gate with mp_gram_blocks"
+        )
+    bw = X.shape[1] // n_mp
+
     def per_device(Xl, ml, yl, *rw):
         d = Xl.shape[1]
         wl = ml if not rw else ml * rw[0]
+        # column-block start of THIS device's Gram panel (0 at mp=1)
+        blk0 = lax.axis_index(MP_AXIS) * bw if n_mp > 1 else 0
 
         # mean estimate from each device's leading rows — shifts the
         # sum/variance accumulators ALWAYS (stable var even in the
@@ -142,12 +160,17 @@ def linreg_suffstats_chunked(
             xs = (xd if fit_intercept else x) * sqw[:, None]
             ys = ((yv - mu_y) if fit_intercept else yv) * sqw
             xdw = xd * sqw[:, None]
+            xb = (
+                lax.dynamic_slice_in_dim(xs, blk0, bw, 1)
+                if n_mp > 1
+                else xs
+            )
             return (
                 sx + (xdw * sqw[:, None]).sum(axis=0),  # Σ w (x-μ̂x)
                 sy + ((yv - mu_y) * w).sum(),           # Σ w (y-μ̂y)
                 vs + (xdw * xdw).sum(axis=0),           # Σ w (x-μ̂x)²
                 W + w.sum(),
-                G + xs.T @ xs,
+                G + xs.T @ xb,
                 Xy + xs.T @ ys,
                 yy + (ys * ys).sum(),
             )
@@ -159,7 +182,7 @@ def linreg_suffstats_chunked(
             body,
             (
                 zero((d,)), zero(()), zero((d,)), zero(()),
-                zero((d, d)), zero((d,)), zero(()),
+                zero((d, bw)), zero((d,)), zero(()),
             ),
         )
         sx = lax.psum(sx, DP_AXIS)
@@ -172,9 +195,12 @@ def linreg_suffstats_chunked(
 
         dx, dy = sx / n, sy / n
         var = vs / n - dx * dx             # shifted: stable for any |μ|
+        dx_b = (
+            lax.dynamic_slice_in_dim(dx, blk0, bw, 0) if n_mp > 1 else dx
+        )
         if fit_intercept:
             # re-center the shifted statistics at the true weighted means
-            G = G - n * jnp.outer(dx, dx)
+            G = G - n * jnp.outer(dx, dx_b)
             Xy = Xy - n * dx * dy
             yy = yy - n * dy * dy
             mean_x, mean_y = mu_x + dx, mu_y + dy
@@ -184,12 +210,13 @@ def linreg_suffstats_chunked(
         return n, mean_x, mean_y, G, Xy, yy, var
 
     args = (X, mask, y) + ((row_w,) if row_w is not None else ())
-    in_specs = (P(DP_AXIS),) * len(args)
+    in_specs = (LAYOUT.rows(),) * len(args)
+    g_spec = LAYOUT.cols() if n_mp > 1 else LAYOUT.replicated()
     n, mean_x, mean_y, G, Xy, yy, var = shard_map(
         per_device,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=(P(),) * 7,
+        out_specs=(LAYOUT.replicated(), LAYOUT.replicated(), LAYOUT.replicated(), g_spec, LAYOUT.replicated(), LAYOUT.replicated(), LAYOUT.replicated()),
         check_vma=False,
     )(*args)
     return {

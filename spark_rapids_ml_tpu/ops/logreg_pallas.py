@@ -26,8 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from ._compat import pallas_tpu_compiler_params, shard_map
-from jax.sharding import PartitionSpec as P
-
+from ..parallel.layout import LAYOUT
 from ..parallel.mesh import DP_AXIS
 
 _LANES = 128
@@ -210,8 +209,8 @@ def make_fused_data_loss(X, y, mask, mesh, K: int, multinomial: bool,
         gA, acc = shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(P(DP_AXIS), P(DP_AXIS), P(DP_AXIS), P(), P()),
-            out_specs=(P(), P()),
+            in_specs=(LAYOUT.rows(), LAYOUT.rows(), LAYOUT.rows(), LAYOUT.replicated(), LAYOUT.replicated()),
+            out_specs=(LAYOUT.replicated(), LAYOUT.replicated()),
             check_vma=False,
         )(X, y, mask, A, b_row)
         return acc[0, 0], gA[:K], acc[0, 1:1 + K]

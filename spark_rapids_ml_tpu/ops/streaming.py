@@ -836,8 +836,21 @@ def moments1_step(
 # ---------------------------------------------------------------------------
 
 
-def gram2_init(d: int, dtype, with_y: bool) -> Dict[str, jax.Array]:
-    acc = {"G": jnp.zeros((d, d), dtype)}
+def gram2_init(d: int, dtype, with_y: bool, mesh=None) -> Dict[str, jax.Array]:
+    """Zero second-moment accumulators. With ``mesh`` (a 2-D mesh whose mp
+    extent divides ``d`` — gate via ``ops.linalg.mp_gram_blocks``) the d×d
+    Gram is created column-sharded over mp (``LAYOUT.cols()``) from host
+    zeros, so each device ever allocates only its (d, d/mp) block; the
+    blocked step keeps it there across donated folds."""
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        from ..parallel.layout import LAYOUT
+
+        cols = NamedSharding(mesh, LAYOUT.cols())
+        acc = {"G": jax.device_put(np.zeros((d, d), dtype), cols)}
+    else:
+        acc = {"G": jnp.zeros((d, d), dtype)}
     if with_y:
         acc["Xy"] = jnp.zeros((d,), dtype)
         acc["yy"] = jnp.zeros((), dtype)
@@ -859,6 +872,41 @@ def gram2_step(
     Xc = (X - mean_x[None, :]) * sw[:, None]
     out = dict(acc)
     out["G"] = acc["G"] + Xc.T @ Xc
+    if y is not None:
+        yc = (y - mean_y) * sw
+        out["Xy"] = acc["Xy"] + Xc.T @ yc
+        out["yy"] = acc["yy"] + (yc * yc).sum()
+    return out
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("mesh",)
+)
+def gram2_step_blocked(
+    acc: Dict[str, jax.Array],
+    X: jax.Array,
+    rw: jax.Array,
+    mean_x: jax.Array,
+    y: Optional[jax.Array] = None,
+    mean_y: Optional[jax.Array] = None,
+    *,
+    mesh,
+) -> Dict[str, jax.Array]:
+    """:func:`gram2_step` with the Gram accumulator pinned column-sharded
+    over the mesh's mp axis: the sharding constraint makes GSPMD compute
+    each device's ``XcᵀXc`` column panel in place (the SUMMA product of the
+    blocked resident scan), so the fold never materializes a full d×d per
+    device. Init with ``gram2_init(..., mesh=mesh)``."""
+    from jax.sharding import NamedSharding
+
+    from ..parallel.layout import LAYOUT
+
+    X = wire_dense(X)
+    sw = jnp.sqrt(rw)
+    Xc = (X - mean_x[None, :]) * sw[:, None]
+    cols = NamedSharding(mesh, LAYOUT.cols())
+    out = dict(acc)
+    out["G"] = jax.lax.with_sharding_constraint(acc["G"] + Xc.T @ Xc, cols)
     if y is not None:
         yc = (y - mean_y) * sw
         out["Xy"] = acc["Xy"] + Xc.T @ yc
@@ -1043,7 +1091,17 @@ def streamed_suffstats(
         mean_x = jnp.zeros((d,), dtype)
         mean_y = jnp.zeros((), dtype) if with_y else None
 
-    acc2 = gram2_init(d, dtype, with_y)
+    # blocked (mp-column-sharded) Gram accumulation when the mesh has a
+    # model axis and the gate allows it — env resolved here, outside jit
+    from .linalg import mp_gram_blocks
+
+    mp = mp_gram_blocks(mesh, d)
+    acc2 = gram2_init(d, dtype, with_y, mesh=mesh if mp > 1 else None)
+    step = (
+        functools.partial(gram2_step_blocked, mesh=mesh)
+        if mp > 1
+        else gram2_step
+    )
     guard = StreamGuard()
     with telemetry.span("suffstats.pass", which="gram"):
         with contextlib.closing(
@@ -1051,12 +1109,20 @@ def streamed_suffstats(
         ) as chunks:
             for _, dev in chunks:
                 rw = dev["mask"] if dev["w"] is None else dev["mask"] * dev["w"]
-                acc2 = gram2_step(
+                acc2 = step(
                     acc2, dev["X"], rw, mean_x,
                     dev["y"] if with_y else None, mean_y,
                 )
                 guard.tick(dev, acc2)
         guard.flush(acc2)
+    mp_report = None
+    if mp > 1:
+        mp_report = {
+            "mp_degree": mp,
+            "gram_shard_bytes": int(
+                acc2["G"].addressable_shards[0].data.nbytes
+            ),
+        }
     if with_y:
         G_h, Xy_h, yy_h = allreduce_sum_host(acc2["G"], acc2["Xy"], acc2["yy"])
     else:
@@ -1078,6 +1144,8 @@ def streamed_suffstats(
         stats["mean_y"] = mean_y
         stats["Xy"] = jnp.asarray(Xy_h, dtype)
         stats["yy"] = jnp.asarray(yy_h, dtype)
+    if mp_report:
+        stats["_mp_report"] = mp_report
     return stats
 
 

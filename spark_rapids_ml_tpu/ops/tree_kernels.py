@@ -33,8 +33,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from ._compat import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
+from ..parallel.layout import LAYOUT
 from ..parallel.mesh import DP_AXIS
 from ..runtime import envspec, telemetry
 
@@ -1749,8 +1750,8 @@ def build_forest(
     return shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(DP_AXIS), P(DP_AXIS), P(DP_AXIS), P(DP_AXIS)),
-        out_specs=P(DP_AXIS),
+        in_specs=(LAYOUT.rows(), LAYOUT.rows(), LAYOUT.rows(), LAYOUT.rows()),
+        out_specs=LAYOUT.rows(),
         check_vma=False,
     )(bins, mask, stats, keys)
 

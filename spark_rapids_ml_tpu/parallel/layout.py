@@ -1,0 +1,100 @@
+"""Named ``PartitionSpec`` layout over the ``(dp, mp)`` mesh.
+
+Single source of truth for how framework arrays map onto the 2-D device
+mesh (``parallel/mesh.py``): rows of the design matrix shard over ``dp``,
+model-axis blocks (Gram column blocks, centroid blocks, IVF list shards)
+shard over ``mp``, scalars and small solver state replicate. Estimator
+and ops code must take specs from here — ``tpuml_lint`` rule TPU009
+rejects inline ``PartitionSpec(...)`` construction outside ``parallel/``,
+so the axis-name contract lives in exactly one module.
+
+Every spec is valid on ANY ``(dp, mp)`` mesh: with mp=1 the mp-named
+specs degenerate to single-device-axis shardings and the compiled
+programs are identical to the historical 1-D ones (the defaults-inert
+contract asserted by ``tests/test_mesh2d.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+from jax.sharding import PartitionSpec
+
+from .mesh import DP_AXIS, MP_AXIS
+
+Axis = str
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Canonical PartitionSpecs for framework arrays over ``(dp, mp)``."""
+
+    dp_axis: Axis = DP_AXIS
+    mp_axis: Axis = MP_AXIS
+
+    def rows(self) -> PartitionSpec:
+        """Row-sharded inputs: dim 0 over dp, replicated over mp — the
+        design matrix, masks, labels, weights, per-row outputs."""
+        return PartitionSpec(self.dp_axis)
+
+    def replicated(self) -> PartitionSpec:
+        """Fully replicated: scalars, reduced statistics, small solver
+        state (means, coefficients, centroid tables on the 1-D path)."""
+        return PartitionSpec()
+
+    def cols(self) -> PartitionSpec:
+        """Column-blocked square accumulators: dim 1 over mp — the
+        SUMMA-style Gram/covariance blocks (d, d/mp per device)."""
+        return PartitionSpec(None, self.mp_axis)
+
+    def feature_blocks(self) -> PartitionSpec:
+        """Feature-sharded parameter blocks: dim 0 over mp — per-feature
+        parameter/state vectors split along the model axis."""
+        return PartitionSpec(self.mp_axis)
+
+    def centroid_blocks(self) -> PartitionSpec:
+        """Centroid-sharded tables: dim 0 (k axis) over mp."""
+        return PartitionSpec(self.mp_axis)
+
+    def list_blocks(self) -> PartitionSpec:
+        """List-sharded IVF grouped arrays: dim 0 (nlist*cap rows,
+        list-major) over mp."""
+        return PartitionSpec(self.mp_axis)
+
+    def rows_and_cols(self) -> PartitionSpec:
+        """Fully 2-D sharded matrices: rows over dp AND columns over mp
+        (wide-feature design matrices in the multichip dryrun)."""
+        return PartitionSpec(self.dp_axis, self.mp_axis)
+
+
+#: The framework-wide layout instance. Import this — constructing a
+#: private SpecLayout is only for tests exercising alternate axis names.
+LAYOUT = SpecLayout()
+
+#: Named registry for docs/tests: every canonical spec by name.
+_REGISTRY: Dict[str, PartitionSpec] = {
+    "rows": LAYOUT.rows(),
+    "replicated": LAYOUT.replicated(),
+    "cols": LAYOUT.cols(),
+    "feature_blocks": LAYOUT.feature_blocks(),
+    "centroid_blocks": LAYOUT.centroid_blocks(),
+    "list_blocks": LAYOUT.list_blocks(),
+    "rows_and_cols": LAYOUT.rows_and_cols(),
+}
+
+
+def spec(name: str) -> PartitionSpec:
+    """Resolve a canonical spec by registry name; raises ``KeyError``
+    listing the known names on a miss."""
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown layout spec {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def spec_names() -> Dict[str, PartitionSpec]:
+    """A copy of the full name -> spec registry (docs/tests)."""
+    return dict(_REGISTRY)

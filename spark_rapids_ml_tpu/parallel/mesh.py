@@ -37,17 +37,86 @@ def _cached_mesh(n_dp: int, n_mp: int) -> Mesh:
     return Mesh(devices, (DP_AXIS, MP_AXIS))
 
 
-def make_mesh(num_workers: Optional[int] = None, mp: int = 1) -> Mesh:
+def _default_mp_budget() -> float:
+    """Default HBM budget for one device's model-axis shard under
+    ``TPUML_MESH_MP=auto``: a quarter of the device memory limit (4 GB
+    when the backend reports none, e.g. the CPU test mesh) — the same
+    convention as the gang-fit and tree-batch resolvers."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = float(stats.get("bytes_limit", 0.0))
+    except Exception:
+        limit = 0.0
+    if limit <= 0.0:
+        limit = float(16 << 30)
+    return limit / 4.0
+
+
+def resolve_mesh_mp(model_bytes: float = 0.0) -> int:
+    """Resolved model-parallel degree for :func:`make_mesh` (1 = the 1-D
+    row-sharded mesh, bit-identical to the pre-2-D behavior).
+
+    ``TPUML_MESH_MP``: ``off`` (default) keeps mp=1, an integer pins the
+    degree (clamped to the device count with a warning), ``auto`` picks
+    the smallest power-of-two degree whose per-device model-axis shard
+    (``model_bytes / mp`` — the caller's Gram-block / centroid-table /
+    IVF-index estimate) fits the HBM budget (``TPUML_MESH_MP_BUDGET``,
+    default a quarter of device memory).
+    """
+    from ..runtime import envspec
+
+    raw = str(envspec.get("TPUML_MESH_MP")).strip().lower()
+    if raw == "off":
+        return 1
+    avail = default_device_count()
+    if raw == "auto":
+        budget = envspec.get("TPUML_MESH_MP_BUDGET")
+        budget = float(budget) if budget else _default_mp_budget()
+        mp = 1
+        while float(model_bytes) / mp > budget and mp * 2 <= avail:
+            mp *= 2
+    else:
+        try:
+            mp = int(raw)
+        except ValueError:
+            raise envspec.EnvSpecError(
+                f"TPUML_MESH_MP={raw!r}: expected 'auto', 'off', or a "
+                "positive integer"
+            ) from None
+        if mp < 1:
+            raise envspec.EnvSpecError(
+                f"TPUML_MESH_MP={mp}: mp degree must be >= 1"
+            )
+        if mp > avail:
+            from ..utils.logging import get_logger
+
+            get_logger("mesh").warning(
+                "TPUML_MESH_MP=%d > %d devices; clamping mp to %d",
+                mp, avail, avail,
+            )
+            mp = avail
+    if mp > 1:
+        from ..runtime import telemetry
+
+        telemetry.record_hbm_estimate("mesh_mp", float(model_bytes) / mp)
+    return mp
+
+
+def make_mesh(num_workers: Optional[int] = None, mp: Optional[int] = None) -> Mesh:
     """Build a (dp, mp) mesh over the first ``num_workers * mp`` devices.
 
-    ``num_workers`` defaults to all devices (with mp=1) — *global* devices
-    when a multi-process world is configured. Requesting more workers than
-    devices available clamps down with a warning — the reference similarly
-    clamps/validates against the cluster's GPU count (``params.py:377-409``).
+    ``num_workers`` defaults to all devices — *global* devices when a
+    multi-process world is configured. ``mp`` defaults to the
+    ``TPUML_MESH_MP`` resolution (:func:`resolve_mesh_mp`; 1 when the env
+    is unset). Requesting more workers than devices available clamps down
+    with a warning — the reference similarly clamps/validates against the
+    cluster's GPU count (``params.py:377-409``).
     """
     from .context import ensure_distributed
 
     ensure_distributed()
+    if mp is None:
+        mp = resolve_mesh_mp()
     avail = default_device_count()
     if jax.process_count() > 1:
         # multi-process worlds always span the FULL device world: a mesh
@@ -82,6 +151,46 @@ def row_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def col_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard dim 1 over mp; replicate over dp — the SUMMA column-blocked
+    layout of square (d, d) model-axis accumulators."""
+    return NamedSharding(mesh, P(None, MP_AXIS))
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard dim 0 over mp; replicate over dp — feature/centroid/list
+    blocks of model-axis state."""
+    return NamedSharding(mesh, P(MP_AXIS))
+
+
+def shard_cols(x: Any, mesh: Mesh) -> jax.Array:
+    """``device_put`` a host/device array column-blocked over the mp axis
+    (dim 1 of d x d accumulators). Dim 1 must divide by the mesh's mp
+    degree; with mp=1 this is a plain replicated placement."""
+    n_mp = mesh.shape[MP_AXIS]
+    if x.shape[1] % n_mp:
+        raise ValueError(
+            f"dim 1 ({x.shape[1]}) does not divide the mesh mp degree "
+            f"({n_mp}); pad the model axis before sharding"
+        )
+    return jax.device_put(x, col_sharding(mesh))
+
+
+def fetch_blocked(arr: jax.Array, mesh: Mesh) -> np.ndarray:
+    """Host-fetch a model-axis-blocked global array (column-blocked Gram,
+    centroid/list blocks) as the full unsharded value.
+
+    Single-process meshes read the addressable shards directly; a
+    multi-host fetch reshards to fully-replicated first (one all_gather)
+    so every process can assemble the complete value — the model-axis
+    analog of :func:`fetch_global`.
+    """
+    if jax.process_count() <= 1:
+        return np.asarray(arr)
+    rep = _replicate_jit(mesh)(arr)
+    return np.asarray(rep.addressable_shards[0].data)
 
 
 def pad_rows(
@@ -321,6 +430,8 @@ def host_file_shard(
     *,
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
+    mp: int = 1,
+    devices_per_process: Optional[int] = None,
 ) -> List[Any]:
     """This host's round-robin subset of the ingest file list.
 
@@ -328,25 +439,53 @@ def host_file_shard(
     (see :func:`local_mesh`), N hosts reading the SAME parquet directory
     would each decode every file and N-fold overcount the global
     statistics at the allreduce. Round-robin assignment
-    (``files[process_index::process_count]``) makes the subsets a disjoint
-    cover, so N hosts pull N files concurrently and the existing
+    (``files[group::n_groups]``) makes the subsets a disjoint cover, so N
+    hosts pull N files concurrently and the existing
     :func:`allreduce_sum_host` of partials is exact. Round-robin (not
     contiguous blocks) keeps per-host byte counts balanced when file sizes
     trend across the directory (time-partitioned writers).
 
-    ``process_index`` / ``process_count`` default to the live jax process
-    world; tests and ``dryrun_multichip`` override them to validate the
-    assignment without a real multi-host world. Identity when the world
-    has one process.
+    The shard key is the process's **dp replica group**, not its bare
+    rank: on a 2-D mesh where one process owns fewer devices than the mp
+    degree, the ``mp // devices_per_process`` consecutive processes
+    spanning one dp row replicate the same logical data rows and must
+    read the SAME file subset — keying off rank alone would hand them
+    disjoint sets that disagree across the model axis. With ``mp=1`` (or
+    processes owning whole dp rows) every process is its own group and
+    the assignment reduces to the historical ``files[rank::nprocs]``.
+
+    ``process_index`` / ``process_count`` / ``devices_per_process``
+    default to the live jax process world; tests and ``dryrun_multichip``
+    override them to validate the assignment without a real multi-host
+    world. Identity when the world has one group.
     """
     idx = jax.process_index() if process_index is None else int(process_index)
     n = jax.process_count() if process_count is None else int(process_count)
     if n < 1 or not (0 <= idx < n):
         raise ValueError(f"invalid process world: index {idx} of {n}")
+    n_mp = int(mp)
+    if n_mp < 1:
+        raise ValueError(f"invalid mp degree: {n_mp}")
+    dpp = (
+        jax.local_device_count()
+        if devices_per_process is None
+        else int(devices_per_process)
+    )
+    if dpp < 1:
+        raise ValueError(f"invalid devices_per_process: {dpp}")
+    # processes spanning one dp row (row-major device order: a process
+    # owning < mp devices shares its dp row with the next ones)
+    procs_per_group = max(1, n_mp // dpp)
+    if n % procs_per_group:
+        raise ValueError(
+            f"process world of {n} does not divide into mp replica groups "
+            f"of {procs_per_group} (mp={n_mp}, devices_per_process={dpp})"
+        )
     files = list(files)
-    if n == 1:
+    n_groups = n // procs_per_group
+    if n_groups == 1:
         return files
-    return files[idx::n]
+    return files[idx // procs_per_group :: n_groups]
 
 
 def local_mesh(mp: int = 1) -> Mesh:

@@ -114,6 +114,57 @@ SPEC: Dict[str, EnvVar] = _registry(
         "launcher together with `TPUML_COORDINATOR`.",
         minimum=0, category="distributed",
     ),
+    # --- 2-D mesh / model axis (parallel/mesh.py, parallel/layout.py) -----
+    EnvVar(
+        "TPUML_MESH_MP", "str", "off",
+        "Model-parallel (`mp`) degree of the 2-D `(dp, mp)` device mesh: "
+        "`off` (default) keeps the 1-D row-sharded mesh (mp=1, "
+        "bit-identical to the pre-2-D behavior), an integer pins the mp "
+        "degree (clamped to the device count), `auto` picks the smallest "
+        "power-of-two degree whose per-device model-axis shard (Gram "
+        "block / centroid block / IVF list shard) fits the HBM budget "
+        "(`TPUML_MESH_MP_BUDGET`). See `docs/mesh.md` for axis semantics "
+        "and the tolerance contract.",
+        category="distributed",
+        also_documented_in=("docs/mesh.md",),
+    ),
+    EnvVar(
+        "TPUML_MESH_MP_BUDGET", "float", None,
+        "HBM budget in bytes for one device's model-axis shard under "
+        "`TPUML_MESH_MP=auto` (default: a quarter of the device's "
+        "reported memory, 4 GB fallback) — the same convention as the "
+        "gang-fit and tree-batch resolvers.",
+        exclusive_minimum=0, category="distributed",
+        also_documented_in=("docs/mesh.md",),
+    ),
+    EnvVar(
+        "TPUML_MP_GRAM", "choice", "auto",
+        "Per-kernel gate for the feature-sharded (SUMMA-blocked) Gram/"
+        "covariance accumulators (PCA, LinearRegression, streamed "
+        "suffstats): `auto` shards the d-axis over mp when the mesh has "
+        "mp>1 and d divides evenly, `off` pins the replicated 1-D "
+        "accumulator on any mesh.",
+        choices=("auto", "off"), category="distributed",
+        also_documented_in=("docs/mesh.md",),
+    ),
+    EnvVar(
+        "TPUML_MP_KMEANS", "choice", "auto",
+        "Per-kernel gate for centroid-sharded KMeans (k-axis over mp, "
+        "per-shard partial argmin + global min-reduce): `auto` shards "
+        "when the mesh has mp>1 and k >= mp, `off` pins the replicated "
+        "centroid table.",
+        choices=("auto", "off"), category="distributed",
+        also_documented_in=("docs/mesh.md",),
+    ),
+    EnvVar(
+        "TPUML_MP_IVF", "choice", "auto",
+        "Per-kernel gate for list-sharded IVF-Flat search (cluster lists "
+        "partitioned over mp instead of whole-index replication): `auto` "
+        "shards when the mesh has mp>1 and nlist >= mp, `off` pins the "
+        "replicated index.",
+        choices=("auto", "off"), category="distributed",
+        also_documented_in=("docs/mesh.md",),
+    ),
     # --- ingest / streaming ----------------------------------------------
     EnvVar(
         "TPUML_STREAM_THRESHOLD_BYTES", "int", None,

@@ -20,6 +20,7 @@ from tpuml_lint import (
     tpu006_lane_align,
     tpu007_metric_catalog,
     tpu008_label_cardinality,
+    tpu009_inline_pspec,
 )
 from tpuml_lint.core import (
     Finding,
@@ -466,6 +467,63 @@ def test_tpu008_suppression_comment():
     """)
     assert len(findings) == 1
     assert "model" in findings[0].message
+
+
+# --- TPU009: inline PartitionSpec outside parallel/ -------------------------
+
+
+def test_tpu009_flags_inline_pspec_in_kernels():
+    findings = lint_snippet(tpu009_inline_pspec, """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        a = P("dp")
+        b = P(None, "mp")
+        c = jax.sharding.PartitionSpec("dp", "mp")
+    """, path="spark_rapids_ml_tpu/ops/some_kernels.py")
+    assert len(findings) == 3
+    assert all(f.rule == "TPU009" for f in findings)
+    assert "LAYOUT" in findings[0].fixit
+
+
+def test_tpu009_allows_parallel_package_and_out_of_scope_paths():
+    code = """
+        from jax.sharding import PartitionSpec
+
+        s = PartitionSpec("dp")
+    """
+    for path in (
+        "spark_rapids_ml_tpu/parallel/layout.py",
+        "spark_rapids_ml_tpu/parallel/mesh.py",
+        "tests/test_mesh2d.py",
+        "bench.py",
+    ):
+        assert lint_snippet(tpu009_inline_pspec, code, path=path) == []
+
+
+def test_tpu009_ignores_layout_calls_and_unrelated_names():
+    findings = lint_snippet(tpu009_inline_pspec, """
+        from spark_rapids_ml_tpu.parallel.layout import LAYOUT
+
+        a = LAYOUT.rows()
+        b = LAYOUT.cols()
+
+        def P(x):
+            return x
+
+        c = P("not a partition spec")
+    """, path="spark_rapids_ml_tpu/ops/clean.py")
+    assert findings == []
+
+
+def test_tpu009_suppression_comment():
+    findings = lint_snippet(tpu009_inline_pspec, """
+        from jax.sharding import PartitionSpec as P
+
+        a = P("dp")  # tpuml: ignore[TPU009]
+        b = P("dp")
+    """, path="spark_rapids_ml_tpu/ops/some_kernels.py")
+    assert len(findings) == 1
 
 
 # --- baseline + suppression mechanics --------------------------------------

@@ -1,0 +1,341 @@
+"""2-D ``(dp, mp)`` mesh layer: the named layout registry, model-parallel
+degree resolution (``TPUML_MESH_MP``), parity of the feature-sharded Gram,
+centroid-sharded Lloyd, and list-sharded IVF kernels against their 1-D
+forms, and the defaults-inert contract (env unset == the historical 1-D
+programs, bit-identical).
+
+Tolerance tiers (documented in ``docs/mesh.md``): mp=1 vs the unblocked
+kernel is **bitwise** (same XLA program); mp>1 vs mp=1 is float32
+accumulation-order tolerance (``rtol=2e-5``-ish) — the blocked SUMMA
+panels and the per-shard argmin change reduction order, never the math.
+"""
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from spark_rapids_ml_tpu.ops import ivf_kernels as ik
+from spark_rapids_ml_tpu.ops.kmeans_kernels import kmeans_lloyd
+from spark_rapids_ml_tpu.ops.linalg import mean_and_cov_chunked
+from spark_rapids_ml_tpu.parallel.layout import LAYOUT, spec, spec_names
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DP_AXIS,
+    MP_AXIS,
+    fetch_blocked,
+    host_file_shard,
+    make_mesh,
+    resolve_mesh_mp,
+    shard_cols,
+    shard_rows,
+)
+from spark_rapids_ml_tpu.runtime.envspec import EnvSpecError
+
+
+def _blobs(n=512, d=16, centers=6, seed=3):
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(
+        n_samples=n, n_features=d, centers=centers, random_state=seed
+    )
+    return X.astype(np.float32)
+
+
+# --------------------------------------------------------------------------
+# named layout registry
+# --------------------------------------------------------------------------
+
+
+def test_layout_methods_resolve_to_canonical_specs():
+    assert LAYOUT.rows() == PartitionSpec(DP_AXIS)
+    assert LAYOUT.replicated() == PartitionSpec()
+    assert LAYOUT.cols() == PartitionSpec(None, MP_AXIS)
+    assert LAYOUT.feature_blocks() == PartitionSpec(MP_AXIS)
+    assert LAYOUT.centroid_blocks() == PartitionSpec(MP_AXIS)
+    assert LAYOUT.list_blocks() == PartitionSpec(MP_AXIS)
+    assert LAYOUT.rows_and_cols() == PartitionSpec(DP_AXIS, MP_AXIS)
+
+
+def test_spec_registry_lookup_and_unknown_name():
+    assert spec("rows") == LAYOUT.rows()
+    assert spec("cols") == LAYOUT.cols()
+    names = spec_names()
+    assert set(names) >= {
+        "rows", "replicated", "cols", "feature_blocks",
+        "centroid_blocks", "list_blocks", "rows_and_cols",
+    }
+    with pytest.raises(KeyError) as ei:
+        spec("diagonal")
+    # the error names the known layouts so the fix is self-describing
+    assert "rows" in str(ei.value)
+
+
+# --------------------------------------------------------------------------
+# TPUML_MESH_MP resolution
+# --------------------------------------------------------------------------
+
+
+def test_resolve_mp_defaults_off(monkeypatch):
+    monkeypatch.delenv("TPUML_MESH_MP", raising=False)
+    assert resolve_mesh_mp() == 1
+    assert resolve_mesh_mp(model_bytes=1e12) == 1  # off ignores size
+
+
+def test_resolve_mp_explicit_integer(monkeypatch):
+    monkeypatch.setenv("TPUML_MESH_MP", "2")
+    assert resolve_mesh_mp() == 2
+
+
+def test_resolve_mp_clamps_to_device_count(monkeypatch):
+    monkeypatch.setenv("TPUML_MESH_MP", "64")
+    assert resolve_mesh_mp() == 8  # conftest forces 8 CPU devices
+
+
+@pytest.mark.parametrize("bad", ["junk", "1.5", "0", "-2"])
+def test_resolve_mp_rejects_malformed(monkeypatch, bad):
+    monkeypatch.setenv("TPUML_MESH_MP", bad)
+    with pytest.raises(EnvSpecError) as ei:
+        resolve_mesh_mp()
+    assert "TPUML_MESH_MP" in str(ei.value)
+
+
+def test_resolve_mp_auto_budgeted(monkeypatch):
+    monkeypatch.setenv("TPUML_MESH_MP", "auto")
+    monkeypatch.setenv("TPUML_MESH_MP_BUDGET", "300")
+    # 1024 B / mp must fit in 300 B: 1024 -> 512 -> 256 @ mp=4
+    assert resolve_mesh_mp(model_bytes=1024.0) == 4
+    # already under budget: stays 1-D
+    assert resolve_mesh_mp(model_bytes=128.0) == 1
+    # never exceeds the device count even when nothing fits
+    assert resolve_mesh_mp(model_bytes=1e12) == 8
+
+
+def test_make_mesh_2d_shape(monkeypatch):
+    monkeypatch.delenv("TPUML_MESH_MP", raising=False)
+    m1 = make_mesh()
+    assert dict(m1.shape) == {DP_AXIS: 8, MP_AXIS: 1}
+    m2 = make_mesh(mp=2)
+    assert dict(m2.shape) == {DP_AXIS: 4, MP_AXIS: 2}
+    assert m2.axis_names == (DP_AXIS, MP_AXIS)
+
+
+# --------------------------------------------------------------------------
+# column-blocked placement helpers
+# --------------------------------------------------------------------------
+
+
+def test_shard_cols_halves_per_device_bytes_and_roundtrips():
+    mesh = make_mesh(mp=2)
+    G = np.arange(16 * 16, dtype=np.float32).reshape(16, 16)
+    g = shard_cols(G, mesh)
+    assert g.addressable_shards[0].data.nbytes == G.nbytes // 2
+    np.testing.assert_array_equal(fetch_blocked(g, mesh), G)
+
+
+def test_shard_cols_rejects_indivisible_dim():
+    mesh = make_mesh(mp=2)
+    with pytest.raises(ValueError, match="divide"):
+        shard_cols(np.zeros((4, 5), np.float32), mesh)
+
+
+# --------------------------------------------------------------------------
+# feature-sharded Gram/covariance parity
+# --------------------------------------------------------------------------
+
+
+def test_blocked_cov_matches_replicated_cov():
+    X = _blobs(n=512, d=16)
+    m1, m2 = make_mesh(), make_mesh(mp=2)
+    x1, k1 = shard_rows(X, m1)
+    x2, k2 = shard_rows(X, m2)
+    mu1, c1, n1 = mean_and_cov_chunked(x1, k1, m1, 32)
+    mu2, c2, n2 = mean_and_cov_chunked(x2, k2, m2, 32, mp_blocks=True)
+    assert int(n1) == int(n2) == 512
+    # mp=2 shards the d x d accumulator: half the bytes per device
+    assert c2.addressable_shards[0].data.nbytes == 16 * 8 * 4
+    np.testing.assert_allclose(np.asarray(mu1), np.asarray(mu2), rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(c1), fetch_blocked(c2, m2), rtol=2e-5, atol=1e-4
+    )
+
+
+def test_mp1_blocked_path_is_bit_identical():
+    """Defaults-inert: on an mp=1 mesh the block width equals d, so the
+    ``mp_blocks`` flag must compile to the identical program."""
+    X = _blobs(n=256, d=8)
+    mesh = make_mesh()
+    xs, ks = shard_rows(X, mesh)
+    mu_a, c_a, n_a = mean_and_cov_chunked(xs, ks, mesh, 32)
+    mu_b, c_b, n_b = mean_and_cov_chunked(xs, ks, mesh, 32, mp_blocks=True)
+    np.testing.assert_array_equal(np.asarray(mu_a), np.asarray(mu_b))
+    np.testing.assert_array_equal(np.asarray(c_a), np.asarray(c_b))
+
+
+def test_blocked_cov_rejects_indivisible_features():
+    X = _blobs(n=256, d=10)
+    mesh = make_mesh(mp=4)  # 10 % 4 != 0
+    xs, ks = shard_rows(X, mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        mean_and_cov_chunked(xs, ks, mesh, 32, mp_blocks=True)
+
+
+# --------------------------------------------------------------------------
+# centroid-sharded KMeans parity
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [6, 5])  # 5: k % mp != 0 -> sentinel padding
+def test_centroid_sharded_lloyd_matches_1d(k):
+    X = _blobs(n=512, d=16, centers=k)
+    centers0 = X[:k].copy()
+    m1, m2 = make_mesh(), make_mesh(mp=2)
+
+    x1, k1 = shard_rows(X, m1)
+    c1, cost1, it1 = kmeans_lloyd(
+        x1, k1, centers0, mesh=m1, csize=32, max_iter=50, tol=1e-6
+    )
+    x2, k2 = shard_rows(X, m2)
+    c2, cost2, it2 = kmeans_lloyd(
+        x2, k2, centers0, mesh=m2, csize=32, max_iter=50, tol=1e-6
+    )
+    assert c2.shape == (k, 16)
+    np.testing.assert_allclose(
+        np.asarray(c1), np.asarray(c2), rtol=1e-4, atol=1e-4
+    )
+    assert abs(float(cost1) - float(cost2)) <= 1e-4 * max(1.0, float(cost1))
+
+
+def test_centroid_sharding_gated_by_env(monkeypatch):
+    from spark_rapids_ml_tpu.ops.kmeans_kernels import mp_kmeans_shards
+
+    m2 = make_mesh(mp=2)
+    assert mp_kmeans_shards(m2, 8) == 2
+    assert mp_kmeans_shards(m2, 1) == 1  # fewer centroids than shards
+    monkeypatch.setenv("TPUML_MP_KMEANS", "off")
+    assert mp_kmeans_shards(m2, 8) == 1
+    assert mp_kmeans_shards(make_mesh(), 8) == 1  # mp=1 mesh
+
+
+# --------------------------------------------------------------------------
+# list-sharded IVF parity
+# --------------------------------------------------------------------------
+
+
+def test_list_sharded_ivf_matches_replicated_at_equal_nprobe():
+    X = _blobs(n=2000, d=16, centers=12, seed=7)
+    index = ik.build_ivf_index(X, nlist=40, seed=0)  # 40 % 2 != 0: pads
+    Xq = X[:256]
+    d2_r, ids_r = ik.ivf_search(Xq, index, k=10, nprobe=8)
+
+    mesh = make_mesh(mp=2)
+    xq, _ = shard_rows(Xq, mesh)
+    d2_s, ids_s = ik.ivf_search(xq, index, k=10, nprobe=8, mesh=mesh)
+    report = ik.last_search_report()
+    assert report["mp_degree"] == 2
+    assert report["index_shard_bytes"] > 0
+
+    ids_r, ids_s = np.asarray(ids_r), np.asarray(ids_s)[: len(Xq)]
+    overlap = np.mean([
+        len(set(a) & set(b)) / ids_r.shape[1]
+        for a, b in zip(ids_r, ids_s)
+    ])
+    assert overlap == 1.0  # same lists probed -> same candidate set
+    np.testing.assert_allclose(
+        np.sort(np.asarray(d2_r), axis=1),
+        np.sort(np.asarray(d2_s)[: len(Xq)], axis=1),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_ivf_replicated_mesh_path_reports_nothing():
+    X = _blobs(n=1500, d=16, centers=8, seed=9)
+    index = ik.build_ivf_index(X, nlist=16, seed=0)
+    mesh = make_mesh()
+    xq, _ = shard_rows(X[:128], mesh)
+    ik.ivf_search(xq, index, k=8, nprobe=4, mesh=mesh)
+    assert ik.last_search_report() == {}
+
+
+# --------------------------------------------------------------------------
+# mp-aware host file sharding
+# --------------------------------------------------------------------------
+
+
+def test_host_file_shard_mp1_is_round_robin():
+    files = [f"f{i}" for i in range(10)]
+    parts = [
+        host_file_shard(files, process_index=i, process_count=4)
+        for i in range(4)
+    ]
+    assert parts[0] == files[0::4]
+    assert sorted(sum(parts, [])) == sorted(files)
+
+
+def test_host_file_shard_mp_groups_share_subsets():
+    """Processes spanning one dp row (mp=2, one device each) replicate the
+    same logical rows, so they must read the SAME files."""
+    files = [f"f{i}" for i in range(8)]
+    parts = [
+        host_file_shard(
+            files, process_index=i, process_count=4,
+            mp=2, devices_per_process=1,
+        )
+        for i in range(4)
+    ]
+    assert parts[0] == parts[1]  # dp group 0
+    assert parts[2] == parts[3]  # dp group 1
+    assert not set(parts[0]) & set(parts[2])
+    assert sorted(parts[0] + parts[2]) == sorted(files)
+
+
+def test_host_file_shard_whole_row_processes_degenerate_to_rank():
+    # one process owns a full dp row (devices_per_process >= mp):
+    # every process is its own group -> historical rank round-robin
+    files = list("abcdef")
+    got = host_file_shard(
+        files, process_index=1, process_count=2, mp=4,
+        devices_per_process=4,
+    )
+    assert got == files[1::2]
+
+
+def test_host_file_shard_rejects_ragged_world():
+    with pytest.raises(ValueError, match="replica group"):
+        host_file_shard(
+            ["a"], process_index=0, process_count=3, mp=2,
+            devices_per_process=1,
+        )
+
+
+# --------------------------------------------------------------------------
+# estimator surface: defaults-inert end to end
+# --------------------------------------------------------------------------
+
+
+def test_pca_defaults_have_no_fit_report(monkeypatch):
+    monkeypatch.delenv("TPUML_MESH_MP", raising=False)
+    from spark_rapids_ml_tpu.data import DataFrame
+    from spark_rapids_ml_tpu.feature import PCA
+
+    X = _blobs(n=256, d=8)
+    df = DataFrame({"features": X})
+    model = PCA(k=3).setInputCol("features").fit(df)
+    assert model._fit_report == {}
+
+
+def test_pca_mp2_reports_and_matches(monkeypatch):
+    from spark_rapids_ml_tpu.data import DataFrame
+    from spark_rapids_ml_tpu.feature import PCA
+
+    X = _blobs(n=256, d=8)
+    df = DataFrame({"features": X})
+    monkeypatch.delenv("TPUML_MESH_MP", raising=False)
+    base = PCA(k=3).setInputCol("features").fit(df)
+    monkeypatch.setenv("TPUML_MESH_MP", "2")
+    sharded = PCA(k=3).setInputCol("features").fit(df)
+    assert sharded._fit_report["mp_degree"] == 2
+    assert sharded._fit_report["gram_shard_bytes"] > 0
+    np.testing.assert_allclose(
+        np.abs(np.asarray(base.components_)),
+        np.abs(np.asarray(sharded.components_)),
+        rtol=2e-4, atol=2e-4,
+    )

@@ -18,6 +18,7 @@ from . import (
     tpu006_lane_align,
     tpu007_metric_catalog,
     tpu008_label_cardinality,
+    tpu009_inline_pspec,
 )
 from .core import (
     Finding,
@@ -40,6 +41,7 @@ FILE_RULES = (
     tpu004_nondeterminism,
     tpu005_static_args,
     tpu006_lane_align,
+    tpu009_inline_pspec,
 )
 PROJECT_RULES = (
     tpu002_env_docs,

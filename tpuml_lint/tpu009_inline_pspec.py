@@ -1,0 +1,77 @@
+"""TPU009 — inline ``PartitionSpec(...)`` outside ``parallel/``.
+
+Every array layout in the framework is named: ``parallel/layout.py``
+owns the canonical specs over the ``(dp, mp)`` mesh (``LAYOUT.rows()``,
+``LAYOUT.cols()``, ``LAYOUT.list_blocks()``, ...). An inline
+``PartitionSpec("dp")`` in a kernel hard-codes axis names at the call
+site, so a mesh-axis rename (or a third axis) becomes a grep-and-pray
+sweep instead of a one-file change. Kernels under
+``spark_rapids_ml_tpu/`` must take their specs from
+``parallel.layout.LAYOUT`` (or ``parallel.mesh`` helpers); only the
+``parallel/`` package itself may construct ``PartitionSpec`` directly.
+Tests, scripts, and benchmark code are out of scope — they legitimately
+build ad-hoc specs to probe layouts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from .core import Finding, SourceFile, dotted_name
+
+CODE = "TPU009"
+NAME = "inline-partition-spec"
+
+_FIXIT = (
+    "use a named layout: LAYOUT.rows()/replicated()/cols()/"
+    "feature_blocks()/centroid_blocks()/list_blocks() "
+    "(from spark_rapids_ml_tpu.parallel.layout import LAYOUT), "
+    "or add the spec to parallel/layout.py"
+)
+
+
+def _in_scope(path: str) -> bool:
+    return path.startswith("spark_rapids_ml_tpu/") and not path.startswith(
+        "spark_rapids_ml_tpu/parallel/"
+    )
+
+
+def _pspec_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to ``jax.sharding.PartitionSpec`` by imports."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+            "jax.sharding",
+            "jax.experimental.pjit",
+            "jax.interpreters.pxla",
+        ):
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def check_file(sf: SourceFile) -> Iterator[Finding]:
+    if not _in_scope(sf.path):
+        return
+    aliases = _pspec_aliases(sf.tree)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = dotted_name(node.func)
+        if fn is None:
+            continue
+        hit = (
+            fn in aliases
+            or fn.endswith(".PartitionSpec")
+            or fn == "PartitionSpec"
+        )
+        if hit:
+            yield sf.finding(
+                CODE,
+                node,
+                f"inline PartitionSpec construction ({fn}) outside "
+                f"parallel/ hard-codes mesh axis names at the call site",
+                _FIXIT,
+            )
