@@ -1169,6 +1169,80 @@ print(f"serving overload smoke OK: {shed}/200 shed at 2x capacity, "
       f"goodput {goodput:.0f} qps, 0 retrace storms")
 EOF
 
+echo "== pod-scale router smoke =="
+# Fleet contract (docs/serving.md pod-scale section): a 2-replica
+# loopback fleet serves a mixed-shape stream bit-identically with zero
+# retrace storms, /statusz's fleet section reports both ranks with the
+# merged-reservoir p99, and a replica killed mid-stream resolves its
+# in-flight futures with typed errors — never a hang — while the
+# survivor keeps the fleet serving.
+JAX_PLATFORMS=cpu TPUML_OPS_PORT=0 python - <<'EOF'
+import json
+import urllib.request
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.runtime import opsplane, telemetry
+from spark_rapids_ml_tpu.runtime.admission import ShuttingDown
+from spark_rapids_ml_tpu.serving import Router
+
+rng = np.random.default_rng(37)
+X = rng.normal(size=(256, 10)).astype(np.float32)
+model = PCA(k=3).fit(DataFrame({"features": X}))
+telemetry.reset_telemetry()
+assert opsplane.ensure_started()
+
+with Router(
+    replicas=2, policy="p2c",
+    runtime_kwargs=dict(batch_window_us=2_000, max_bucket_rows=32),
+) as router:
+    router.register("pca", model)
+    queries = [rng.normal(size=(s, 10)).astype(np.float32)
+               for s in (1, 2, 5, 13, 1, 17, 3, 8) * 3]
+    futs = [router.predict_async("pca", q) for q in queries]
+    for q, f in zip(queries, futs):
+        out = f.result(120)
+        direct = model.transform(DataFrame({"features": q}))
+        for col, served in out.items():
+            assert np.array_equal(served, np.asarray(direct[col])), col
+
+    host, port = opsplane.address()
+    with urllib.request.urlopen(
+        f"http://{host}:{port}/statusz", timeout=30
+    ) as r:
+        st = json.loads(r.read())
+    routers = st["fleet"]["routers"]
+    assert len(routers) == 1 and routers[0]["healthy"] == 2, routers
+    assert [rep["rank"] for rep in routers[0]["replicas"]] == [0, 1], routers
+    assert routers[0]["warmup"]["ready"] is True, routers[0]
+    assert routers[0]["p99_ms"].get("pca", 0) > 0, routers[0]
+
+    # chaos: replica 0 dies with requests still in flight — those
+    # futures resolve served-or-typed, and the survivor keeps serving
+    inflight = [router.replicas[0].predict_async("pca", queries[1])
+                for _ in range(4)]
+    router.replicas[0].close()
+    for f in inflight:
+        try:
+            f.result(30)  # served before the close landed — fine
+        except ShuttingDown:
+            pass  # typed, never a hang
+    assert router.healthy_count() == 1
+    outs = [router.predict("pca", q, timeout=120) for q in queries[:8]]
+    assert len(outs) == 8
+
+snap = telemetry.metrics_snapshot()
+storms = snap.get("retrace_storms")
+assert not storms or all(s["value"] == 0 for s in storms["series"]), storms
+picks = {s["labels"]["replica"]: s["value"]
+         for s in snap["router_picks_total"]["series"]}
+assert picks.get("0", 0) > 0 and picks.get("1", 0) > 0, picks
+print("pod-scale router smoke OK: both ranks in /statusz, replica kill "
+      "survived, 0 retrace storms")
+EOF
+
 echo "== fit scheduler chaos smoke =="
 # Multi-tenant fit scheduler (docs/scheduler.md contract): an injected
 # sched:dispatch fault fails exactly one tenant while survivors stay

@@ -16,6 +16,11 @@ newest run against the most recent prior run that produced entries:
 - ``qps_sweep[<q>].p99_ms`` — every swept QPS level's tail gates like
   ``p99_ms``, so a regression visible only at high offered load cannot
   hide behind the top-level number
+- ``aggregate_goodput_qps`` / ``replica_scaling_efficiency`` —
+  regressions when they shrink past ``-threshold`` (the router bench's
+  fleet goodput and its fraction of perfect N-replica scaling)
+- ``fleet_p99_ms`` — regression when it grows past ``+threshold``
+  (fleet tail measured from the MERGED per-rank reservoirs)
 
 Rules that keep the gate honest on real trajectories:
 
@@ -143,6 +148,9 @@ _STATIC_FIELDS = (
     ("shed_frac", +1),        # shedding more at the same offered load
     ("fits_per_sec", -1),     # fit-scheduler capacity regression
     ("fit_p99_ms", +1),       # scheduled-fit tail latency growth
+    ("aggregate_goodput_qps", -1),        # fleet goodput collapse
+    ("replica_scaling_efficiency", -1),   # router stopped spreading load
+    ("fleet_p99_ms", +1),     # merged-reservoir fleet tail growth
 )
 
 _QPS_FIELD_RE = re.compile(r"^qps_sweep\[(.+)\]\.p99_ms$")

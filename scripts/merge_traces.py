@@ -15,9 +15,10 @@ script folds them:
   layout wants.
 - **Metrics** — kind-aware fold of the JSON snapshots: counters SUM,
   gauges MAX, histogram count/sum SUM with min/max merged and per-rank
-  ring quantiles dropped (they cannot be merged exactly). These are the
-  same rules as ``telemetry.merge_metric_snapshots``; the
-  ``dryrun_multichip`` harness parity-checks the two implementations.
+  reservoirs pooled, bounded, and re-quantiled (fleet p99 is measured
+  over the pooled samples, not approximated). These are the same rules
+  as ``telemetry.merge_metric_snapshots``; the ``dryrun_multichip``
+  harness parity-checks the two implementations.
 
 Deliberately stdlib-only and importable without jax or the package
 (``dryrun_multichip`` and the tests load it by file path).
@@ -118,12 +119,36 @@ def merge_trace_docs(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+#: Mirror of ``telemetry.RESERVOIR_MERGE_CAP`` (this script is
+#: stdlib-only and cannot import the package).
+RESERVOIR_MERGE_CAP = 4096
+
+
+def _merged_quantile(ordered: List[float], q: float) -> float:
+    """The exact ``_Hist.quantile`` rule over an already-sorted list."""
+    q = min(1.0, max(0.0, q))
+    return ordered[min(len(ordered) - 1, int(q * (len(ordered) - 1)))]
+
+
+def _fold_reservoir(samples: List[float]) -> List[float]:
+    """Sort concatenated per-rank reservoirs and evenly downsample to
+    ``RESERVOIR_MERGE_CAP`` keeping both endpoints — deterministic
+    (TPU004: no sampling randomness) and input-order-independent."""
+    ordered = sorted(samples)
+    n = len(ordered)
+    cap = RESERVOIR_MERGE_CAP
+    if n <= cap:
+        return ordered
+    return [ordered[i * (n - 1) // (cap - 1)] for i in range(cap)]
+
+
 def merge_metric_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Kind-aware fold of ``telemetry.metrics_snapshot`` dicts: counters
-    SUM, gauges MAX, histogram count/sum SUM + min/max merged, ring
-    quantiles dropped. Must stay rule-for-rule identical to
-    ``telemetry.merge_metric_snapshots`` (parity-checked in
-    ``dryrun_multichip``)."""
+    SUM, gauges MAX, histogram count/sum SUM + min/max merged, per-rank
+    reservoirs pooled/bounded/re-quantiled (quantiles of reservoir-less
+    legacy snapshots are dropped rather than faked). Must stay
+    rule-for-rule identical to ``telemetry.merge_metric_snapshots``
+    (parity-checked in ``dryrun_multichip``)."""
     merged: Dict[str, Any] = {}
     for snap in snaps:
         for name, entry in snap.items():
@@ -141,6 +166,9 @@ def merge_metric_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
                             "sum": series.get("sum", 0.0),
                             "min": series.get("min"),
                             "max": series.get("max"),
+                            "reservoir": list(
+                                series.get("reservoir") or []
+                            ),
                         }
                     else:
                         have["count"] += series.get("count", 0)
@@ -152,6 +180,9 @@ def merge_metric_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
                                     v if have[fld] is None
                                     else pick(have[fld], v)
                                 )
+                        have["reservoir"].extend(
+                            series.get("reservoir") or []
+                        )
                 else:
                     value = series.get("value", 0)
                     if have is None:
@@ -162,13 +193,20 @@ def merge_metric_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
                         have["value"] = max(have["value"], value)
                     else:
                         have["value"] += value
-    return {
-        name: {
-            "kind": entry["kind"],
-            "series": [entry["series"][k] for k in sorted(entry["series"])],
-        }
-        for name, entry in sorted(merged.items())
-    }
+    out: Dict[str, Any] = {}
+    for name, entry in sorted(merged.items()):
+        series_out = []
+        for k in sorted(entry["series"]):
+            s = entry["series"][k]
+            if entry["kind"] == "histogram":
+                res = _fold_reservoir(s.pop("reservoir"))
+                if res:
+                    s["reservoir"] = res
+                    for q in (0.5, 0.95, 0.99):
+                        s[f"p{int(q * 100)}"] = _merged_quantile(res, q)
+            series_out.append(s)
+        out[name] = {"kind": entry["kind"], "series": series_out}
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:

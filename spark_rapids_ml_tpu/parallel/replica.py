@@ -1,0 +1,67 @@
+"""Serving replica groups over the pod's process grid.
+
+A pod-scale serving fleet is N replicas over a world of W processes:
+each replica owns a contiguous block of ``W // N`` ranks — one rank per
+replica in the common case, ``mesh_mp`` ranks per replica when the
+replica itself shards model state over the PR-16 model axis
+(``TPUML_MESH_MP``), mirroring how ``host_file_shard`` keys dp replica
+groups for input reading. The serving router (``serving/router.py``)
+uses these groups to map replica indices onto process ranks and to
+rank-stamp warmup spans and residency reports.
+
+Deliberately numpy/jax-free: the router imports this at construction
+time and the grouping math is pure integer arithmetic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+__all__ = ["ReplicaGroup", "replica_groups", "group_of"]
+
+
+@dataclass(frozen=True)
+class ReplicaGroup:
+    """One serving replica's slot in the process grid."""
+
+    index: int
+    ranks: Tuple[int, ...]
+
+    @property
+    def leader(self) -> int:
+        """The rank that speaks for the group (loads report residency
+        per leader; model-sharded members hold 1/mp of the state)."""
+        return self.ranks[0]
+
+    @property
+    def size(self) -> int:
+        return len(self.ranks)
+
+
+def replica_groups(world: int, mp: int = 1) -> List[ReplicaGroup]:
+    """Partition ``world`` process ranks into contiguous serving
+    replicas of ``mp`` ranks each. Ragged worlds raise — a replica
+    missing model-axis shards could not answer any request."""
+    world, mp = int(world), int(mp)
+    if world < 1:
+        raise ValueError(f"world size must be >= 1, got {world}")
+    if mp < 1:
+        raise ValueError(f"mp degree must be >= 1, got {mp}")
+    if world % mp:
+        raise ValueError(
+            f"world size {world} is not divisible by mp={mp}; every "
+            "serving replica needs a full set of model-axis shards"
+        )
+    return [
+        ReplicaGroup(index=i, ranks=tuple(range(i * mp, (i + 1) * mp)))
+        for i in range(world // mp)
+    ]
+
+
+def group_of(rank: int, world: int, mp: int = 1) -> ReplicaGroup:
+    """The replica group containing ``rank``."""
+    rank = int(rank)
+    if not 0 <= rank < int(world):
+        raise ValueError(f"rank {rank} outside world of {world}")
+    return replica_groups(world, mp)[rank // int(mp)]

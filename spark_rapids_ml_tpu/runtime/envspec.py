@@ -519,6 +519,66 @@ SPEC: Dict[str, EnvVar] = _registry(
         exclusive_minimum=0, category="serving",
         also_documented_in=("docs/serving.md",),
     ),
+    # --- pod-scale serving router (serving/router.py, docs/serving.md) ----
+    EnvVar(
+        "TPUML_ROUTER_REPLICAS", "int", 2,
+        "Default replica count for a `serving.Router()` constructed "
+        "without an explicit replica list: the router builds this many "
+        "in-process loopback `ServingRuntime` replicas (ranks 0..N-1). "
+        "Only read by an explicitly constructed router — no router "
+        "thread, replica, or metric series exists otherwise.",
+        minimum=1, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_ROUTER_POLICY", "choice", "p2c",
+        "Replica-picking policy of the serving router: `p2c` (default) "
+        "scores two rotating candidates by EWMA-estimated wait and "
+        "queue depth and takes the better (power-of-two-choices — "
+        "near-least-loaded at O(2) probes); `round_robin` ignores load; "
+        "`least_loaded` scores every replica on every request. All "
+        "policies route around breaker-open and unhealthy replicas.",
+        choices=("p2c", "round_robin", "least_loaded"),
+        category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_ROUTER_BREAKER_FAILS", "int", 3,
+        "Consecutive *dispatch-fault* failures (not typed sheds) that "
+        "trip a replica's router-side circuit breaker; while open the "
+        "replica is routed around, not queued behind, and re-probed "
+        "after `TPUML_ROUTER_BREAKER_COOLDOWN_MS`. `0` disables the "
+        "router breakers.",
+        minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_ROUTER_BREAKER_COOLDOWN_MS", "float", 1000.0,
+        "How long an open router-side replica breaker blocks before "
+        "moving to half-open and admitting a single probe request. "
+        "Only read when `TPUML_ROUTER_BREAKER_FAILS` > 0.",
+        exclusive_minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_ROUTER_REROUTES", "int", 1,
+        "How many *additional* replicas the router tries when the "
+        "picked replica sheds at admission (queue full, deadline "
+        "unmeetable, draining). `0` = no rerouting: the first pick's "
+        "shed is the caller's shed.",
+        minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_REPLICA_RANK", "int", None,
+        "Replica rank of a subprocess serving worker "
+        "(`serving/_replica_worker.py`); set by the parent "
+        "`SubprocessReplica` transport, never by hand. The worker's "
+        "runtime rank-stamps its warmup spans and residency reports "
+        "with this value.",
+        minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
     # --- fit scheduler (docs/scheduler.md) --------------------------------
     EnvVar(
         "TPUML_SCHED_QUEUE_LIMIT", "int", None,

@@ -372,6 +372,53 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "persistently 0 under load means staging is the bottleneck, "
         "persistently full means the fold is.",
     ),
+    # --- pod-scale serving router (serving/router.py, PR 17) --------------
+    MetricSpec(
+        "router_requests_total", "counter",
+        "Requests presented to the serving router's front door, labeled "
+        "by model — before replica picking, so "
+        "`router_requests_total - sum(router_shed_total)` is the count "
+        "actually handed to a replica.",
+        labels=("model",),
+    ),
+    MetricSpec(
+        "router_picks_total", "counter",
+        "Requests dispatched to each replica (labeled by replica "
+        "index); the pick distribution under load is the routing "
+        "policy's observable — a slow replica's share collapses while "
+        "its EWMA wait dominates the score.",
+        labels=("replica",),
+    ),
+    MetricSpec(
+        "router_shed_total", "counter",
+        "Requests the router rejected with a typed `Overloaded` after "
+        "exhausting its reroute budget, labeled by model and reason "
+        "(`queue_full` | `deadline_unmeetable` | `breaker_open` | "
+        "`draining` | `no_replicas`). Every shed is typed — a router "
+        "caller never sees a bare RuntimeError for load.",
+        labels=("model", "reason"),
+    ),
+    MetricSpec(
+        "router_breaker_state", "gauge",
+        "Per-replica router-side circuit-breaker state (0 closed, 1 "
+        "half-open, 2 open), labeled by replica index. Open means the "
+        "replica is being routed around after "
+        "`TPUML_ROUTER_BREAKER_FAILS` consecutive dispatch faults.",
+        labels=("replica",),
+    ),
+    MetricSpec(
+        "router_replica_depth", "gauge",
+        "Queue depth of a replica as last observed by the router at "
+        "pick time, labeled by replica index (loopback: live dispatcher "
+        "queue size; subprocess: in-flight RPC count).",
+        labels=("replica",),
+    ),
+    MetricSpec(
+        "fleet_replicas", "gauge",
+        "Replica count of the most recently constructed serving "
+        "router; static per router lifetime. Compare with the healthy "
+        "count in `/statusz`'s fleet section to see degraded capacity.",
+    ),
 )
 
 

@@ -70,6 +70,7 @@ __all__ = [
     "address",
     "track_registry",
     "track_runtime",
+    "track_router",
     "flight_recorder",
     "slo_status",
     "FlightRecorder",
@@ -89,6 +90,7 @@ _SIGTERM_INSTALLED = False
 _REGISTRIES: List["weakref.ref[Any]"] = []
 _RUNTIMES: List["weakref.ref[Any]"] = []
 _SCHEDULERS: List["weakref.ref[Any]"] = []
+_ROUTERS: List["weakref.ref[Any]"] = []
 
 
 def _active() -> bool:
@@ -243,7 +245,10 @@ class _SloEvaluator(threading.Thread):
         the thread's cadence)."""
         if now is None:
             now = time.monotonic()
-        snap = telemetry.metrics_snapshot()
+        # fleet-merged when a tracked router has out-of-process
+        # replicas; exactly the local snapshot otherwise — so the SLO
+        # table answers for the fleet, not the process
+        snap = _fleet_snapshot()
         state: Dict[str, Any] = {}
         for spec in slo.CATALOG:
             value = slo.measured_value(spec, snap, self._prev)
@@ -321,6 +326,35 @@ def track_scheduler(scheduler: Any) -> None:
         _SCHEDULERS.append(weakref.ref(scheduler))
 
 
+def track_router(router: Any) -> None:
+    """Weakly track a serving Router: /statusz gains the fleet roll-up
+    section, /readyz gates on the fleet having a routable replica, the
+    SLO evaluator scores fleet-merged snapshots, and the SIGTERM
+    handler drains the whole fleet before the flight dump."""
+    with _LOCK:
+        _prune(_ROUTERS)
+        _ROUTERS.append(weakref.ref(router))
+
+
+def _fleet_snapshot() -> Dict[str, Any]:
+    """The snapshot SLO evaluation and /statusz quantile tables read:
+    the local process's metrics, merged (reservoirs pooled) with every
+    out-of-process replica snapshot a tracked router can fetch. With no
+    router — or an all-loopback fleet — this is exactly the local
+    snapshot, byte-identical to pre-fleet behavior."""
+    local = telemetry.metrics_snapshot()
+    extra: List[Dict[str, Any]] = []
+    for router in _live(_ROUTERS):
+        try:
+            if not router.is_closed():
+                extra.extend(router.replica_snapshots())
+        except Exception:
+            continue
+    if not extra:
+        return local
+    return telemetry.merge_metric_snapshots([local] + extra)
+
+
 def _prune(refs: List["weakref.ref[Any]"]) -> None:
     refs[:] = [r for r in refs if r() is not None]
 
@@ -388,6 +422,22 @@ def _readiness() -> Tuple[bool, List[str]]:
             if open_breakers:
                 reasons.append(
                     f"breaker_open={json.dumps(open_breakers)}"
+                )
+        except Exception:
+            continue
+    for router in _live(_ROUTERS):
+        try:
+            if router.is_closed():
+                continue  # a cleanly closed router is not a fault
+            if router.healthy_count() == 0:
+                reasons.append("router_no_healthy_replicas")
+            open_replicas = sorted(
+                str(st["replica"]) for st in router.replica_states()
+                if st.get("breaker") == "open"
+            )
+            if open_replicas:
+                reasons.append(
+                    f"router_breaker_open={json.dumps(open_replicas)}"
                 )
         except Exception:
             continue
@@ -531,6 +581,28 @@ def _statusz() -> Dict[str, Any]:
             telemetry.counter("sched_dispatch_errors_total").value() or 0
         ),
     }
+    fleet: List[Dict[str, Any]] = []
+    for router in _live(_ROUTERS):
+        entry: Dict[str, Any] = {
+            "policy": getattr(router, "policy", "?"),
+            "closed": router.is_closed(),
+        }
+        try:
+            entry["replicas"] = router.replica_states()
+            entry["healthy"] = router.healthy_count()
+            entry["warmup"] = router.fleet_warmup_state()
+            # measured fleet p99 from merged (pooled-reservoir)
+            # snapshots — the pod-scale answer to "how slow are we"
+            entry["p99_ms"] = router.fleet_p99_ms()
+        except Exception as exc:
+            entry["error"] = str(exc)
+        fleet.append(entry)
+    router_sheds = {
+        "{}/{}".format(
+            s["labels"].get("model", "?"), s["labels"].get("reason", "?")
+        ): s.get("value")
+        for s in _series("router_shed_total")
+    }
     ready, reasons = _readiness()
     rec = _RECORDER
     return {
@@ -543,6 +615,7 @@ def _statusz() -> Dict[str, Any]:
             reg.warmup_state() for reg in _live(_REGISTRIES)
         ],
         "serving": serving,
+        "fleet": {"routers": fleet, "router_shed_total": router_sheds},
         "scheduler": scheduler,
         "heartbeat_ages_s": heartbeats,
         "ingest_ring_occupancy": _scalar("ingest_ring_occupancy"),
@@ -652,6 +725,11 @@ def _on_sigterm(signum: int, frame: Any) -> None:
     # in-flight work flushes, every future resolves typed) so the
     # flight dump below captures the post-drain state; bounded — a
     # wedged dispatcher cannot stall process death past the timeout
+    for router in _live(_ROUTERS):
+        try:
+            router.drain(timeout=SIGTERM_DRAIN_TIMEOUT_S)
+        except Exception:
+            pass
     for rt in _live(_RUNTIMES):
         try:
             rt.drain(timeout=SIGTERM_DRAIN_TIMEOUT_S)
@@ -772,6 +850,7 @@ def stop() -> None:
         _REGISTRIES.clear()
         _RUNTIMES.clear()
         _SCHEDULERS.clear()
+        _ROUTERS.clear()
     if server is not None:
         try:
             server.shutdown()

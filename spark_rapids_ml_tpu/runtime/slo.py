@@ -118,6 +118,21 @@ CATALOG: Tuple[SLOSpec, ...] = _catalog(
             "and trips the burn alert + one-shot flight dump.",
     ),
     SLOSpec(
+        name="router_shed_rate",
+        metric="router_shed_total",
+        measure="window_delta",
+        objective=0.0,
+        sense="max",
+        error_budget=0.10,
+        doc="Fleet front-door load-shed budget: a router shed means a "
+            "request exhausted its reroute budget with *no* replica "
+            "able to admit it — single-tick sheds are a burst outrunning "
+            "the whole fleet briefly, sustained shedding (>= 10% of "
+            "ticks seeing new `router_shed_total` increments across "
+            "both burn windows) means offered load has outrun aggregate "
+            "fleet capacity or too many replicas are breaker-open.",
+    ),
+    SLOSpec(
         name="serving_deadline_miss",
         metric="serve_deadline_miss_total",
         measure="window_delta",

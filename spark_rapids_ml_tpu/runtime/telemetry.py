@@ -819,7 +819,9 @@ def prometheus_dump() -> str:
 
 def metrics_snapshot() -> Dict[str, Any]:
     """A JSON-able snapshot of every live metric: kind plus each labeled
-    series (histograms as count/sum/min/max + ring quantiles)."""
+    series (histograms as count/sum/min/max + ring quantiles + the
+    sorted bounded reservoir itself, so a cross-rank merge can quantile
+    the fleet exactly instead of approximating from count/sum)."""
     with _MLOCK:
         out: Dict[str, Any] = {}
         for name, m in sorted(_METRICS.items()):
@@ -834,6 +836,7 @@ def metrics_snapshot() -> Dict[str, Any]:
                             "sum": v.sum,
                             "min": v.min,
                             "max": v.max,
+                            "reservoir": sorted(v.ring),
                             **{
                                 f"p{int(q * 100)}": v.quantile(q)
                                 for q in _QUANTILES
@@ -871,13 +874,41 @@ def write_metrics(out_dir: Optional[str] = None) -> Optional[Tuple[str, str]]:
 # --------------------------------------------------------------------------
 
 
+#: Bound on a merged reservoir: concatenated per-rank rings are sorted
+#: and evenly downsampled to at most this many samples, so an N-rank
+#: fold stays O(cap) no matter the fleet size. Mirrored verbatim in
+#: ``scripts/merge_traces.py`` (stdlib-only, cannot import this module).
+RESERVOIR_MERGE_CAP = 4096
+
+
+def _merged_quantile(ordered: List[float], q: float) -> float:
+    """The exact ``_Hist.quantile`` rule over an already-sorted list."""
+    q = min(1.0, max(0.0, q))
+    return ordered[min(len(ordered) - 1, int(q * (len(ordered) - 1)))]
+
+
+def _fold_reservoir(samples: List[float]) -> List[float]:
+    """Sort concatenated per-rank reservoirs and evenly downsample to
+    ``RESERVOIR_MERGE_CAP`` keeping both endpoints — deterministic
+    (TPU004: no sampling randomness) and input-order-independent."""
+    ordered = sorted(samples)
+    n = len(ordered)
+    cap = RESERVOIR_MERGE_CAP
+    if n <= cap:
+        return ordered
+    return [ordered[i * (n - 1) // (cap - 1)] for i in range(cap)]
+
+
 def merge_metric_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold per-process :func:`metrics_snapshot` dicts into one
     cluster-wide view, kind-aware per labeled series: counters SUM,
     gauges MAX (each rank's last-write is a local reading; the peak is
     the conservative cluster answer), histogram count/sum SUM with
-    min/max merged (ring quantiles are per-rank windows and cannot be
-    merged exactly, so they are dropped rather than faked).
+    min/max merged and per-rank reservoirs concatenated, sorted,
+    bounded to ``RESERVOIR_MERGE_CAP``, and re-quantiled — merged p99
+    is measured over the pooled samples, not approximated. Snapshots
+    predating the reservoir export (no ``reservoir`` key) still merge;
+    their per-rank quantiles are dropped rather than faked.
 
     ``scripts/merge_traces.py`` implements these same rules over the
     on-disk ``metrics-r*-*.json`` shards; ``dryrun_multichip`` parity-
@@ -900,6 +931,9 @@ def merge_metric_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
                             "sum": series.get("sum", 0.0),
                             "min": series.get("min"),
                             "max": series.get("max"),
+                            "reservoir": list(
+                                series.get("reservoir") or []
+                            ),
                         }
                     else:
                         have["count"] += series.get("count", 0)
@@ -911,6 +945,9 @@ def merge_metric_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
                                     v if have[fld] is None
                                     else pick(have[fld], v)
                                 )
+                        have["reservoir"].extend(
+                            series.get("reservoir") or []
+                        )
                 else:
                     value = series.get("value", 0)
                     if have is None:
@@ -921,13 +958,20 @@ def merge_metric_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
                         have["value"] = max(have["value"], value)
                     else:
                         have["value"] += value
-    return {
-        name: {
-            "kind": entry["kind"],
-            "series": [entry["series"][k] for k in sorted(entry["series"])],
-        }
-        for name, entry in sorted(merged.items())
-    }
+    out: Dict[str, Any] = {}
+    for name, entry in sorted(merged.items()):
+        series_out = []
+        for k in sorted(entry["series"]):
+            s = entry["series"][k]
+            if entry["kind"] == "histogram":
+                res = _fold_reservoir(s.pop("reservoir"))
+                if res:
+                    s["reservoir"] = res
+                    for q in (0.5, 0.95, 0.99):
+                        s[f"p{int(q * 100)}"] = _merged_quantile(res, q)
+            series_out.append(s)
+        out[name] = {"kind": entry["kind"], "series": series_out}
+    return out
 
 
 def aggregate_metrics() -> Dict[str, Any]:

@@ -29,17 +29,22 @@ from .registry import (
     serving_family,
 )
 from .runtime import ServingRuntime
+from .router import POLICIES, LoopbackReplica, Router, SubprocessReplica
 
 __all__ = [
     "AdmissionController",
     "CircuitBreaker",
     "DeadlineExceeded",
+    "LoopbackReplica",
     "ModelRegistry",
     "Overloaded",
+    "POLICIES",
     "ResidentModel",
+    "Router",
     "ServingError",
     "ServingRuntime",
     "ShuttingDown",
+    "SubprocessReplica",
     "feature_width",
     "resident_nbytes",
     "serving_family",

@@ -1,0 +1,135 @@
+"""Subprocess serving replica worker (``SubprocessReplica``'s far side).
+
+Run as ``python -m spark_rapids_ml_tpu.serving._replica_worker`` with
+``TPUML_REPLICA_RANK`` set by the parent. Speaks a length-prefixed
+pickle protocol: requests on stdin, replies on stdout, each frame a
+4-byte big-endian length + pickled dict. The real stdout is claimed
+for the protocol before anything heavyweight imports, and fd 1 is
+re-pointed at stderr so stray prints (jax warnings, model logging)
+can never corrupt a frame.
+
+Ops: ``load`` (persist-path replication), ``predict`` (replied when
+the runtime's future resolves — requests pipeline, replies are
+out-of-order by design), ``queue_depth``, ``warmup_state``,
+``metrics`` (this process's ``telemetry.metrics_snapshot``, merged
+fleet-wide by the router), ``drain``, ``close``.
+
+Errors reply as ``{"type", "message", "reason"}`` and are revived as
+their typed twins parent-side, so a subprocess replica's sheds are as
+typed as a loopback replica's.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+
+def _read_exact(f: Any, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = f.read(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def main() -> int:
+    # claim the protocol channel FIRST: dup the real stdout, then point
+    # fd 1 at stderr so any later print/log lands off-channel
+    proto_out = os.fdopen(os.dup(sys.stdout.fileno()), "wb")
+    os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
+    wlock = threading.Lock()
+
+    def reply(obj: Dict[str, Any]) -> None:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        with wlock:
+            proto_out.write(struct.pack("!I", len(payload)))
+            proto_out.write(payload)
+            proto_out.flush()
+
+    def encode_error(e: BaseException) -> Dict[str, Any]:
+        return {
+            "type": type(e).__name__,
+            "message": str(e),
+            "reason": getattr(e, "reason", None),
+        }
+
+    # heavyweight imports after the fd surgery
+    from ..runtime import envspec, telemetry
+    from .runtime import ServingRuntime
+
+    rank = envspec.get("TPUML_REPLICA_RANK")
+    rt = ServingRuntime(rank=0 if rank is None else int(rank))
+    # hello frame: the parent's readiness barrier
+    reply({"id": -1, "ok": True, "value": {"rank": rt.rank, "pid": os.getpid()}})
+
+    stdin = sys.stdin.buffer
+    while True:
+        header = _read_exact(stdin, 4)
+        if header is None:
+            break  # parent closed the pipe: shut down
+        (ln,) = struct.unpack("!I", header)
+        body = _read_exact(stdin, ln)
+        if body is None:
+            break
+        msg = pickle.loads(body)
+        rid, op = msg.get("id"), msg.get("op")
+        try:
+            if op == "predict":
+                fut = rt.predict_async(
+                    msg["name"], msg["X"], deadline_ms=msg.get("deadline_ms")
+                )
+
+                def _done(f: Any, rid: Any = rid) -> None:
+                    exc = f.exception()
+                    if exc is None:
+                        reply({"id": rid, "ok": True, "value": f.result()})
+                    else:
+                        reply(
+                            {"id": rid, "ok": False,
+                             "error": encode_error(exc)}
+                        )
+
+                fut.add_done_callback(_done)
+                continue  # replied when the dispatch resolves
+            if op == "load":
+                entry = rt.load(msg["name"], msg["path"])
+                value: Any = {
+                    "name": entry.name,
+                    "family": entry.family,
+                    "engine": entry.engine,
+                    "coalesce": entry.coalesce,
+                    "resident_bytes": entry.nbytes,
+                    "mp_degree": entry.mp_degree,
+                    "shard_bytes": entry.shard_nbytes,
+                }
+            elif op == "queue_depth":
+                value = rt.queue_depth()
+            elif op == "warmup_state":
+                value = rt.registry.warmup_state()
+            elif op == "metrics":
+                value = telemetry.metrics_snapshot()
+            elif op == "drain":
+                value = rt.drain(float(msg.get("timeout_s", 30.0)))
+            elif op == "close":
+                rt.close()
+                reply({"id": rid, "ok": True, "value": None})
+                return 0
+            else:
+                raise ValueError(f"unknown replica op {op!r}")
+        except BaseException as e:  # every failure replies, none kills
+            reply({"id": rid, "ok": False, "error": encode_error(e)})
+            continue
+        reply({"id": rid, "ok": True, "value": value})
+    rt.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

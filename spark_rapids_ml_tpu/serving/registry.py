@@ -164,6 +164,15 @@ class ResidentModel:
     # at a cold bucket runs under a warmup span so its compiles never
     # land on the steady-state dispatch site
     warmed: Set[int] = field(default_factory=set)
+    # model-axis sharding (TPUML_MESH_MP, PR 16): each of mp ranks holds
+    # ceil(nbytes / mp) resident bytes — what this replica's rank
+    # charges against its HBM budget (== nbytes when mp == 1)
+    mp_degree: int = 1
+    shard_nbytes: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.shard_nbytes:
+            self.shard_nbytes = -(-self.nbytes // max(1, self.mp_degree))
 
 
 # the probe samples (n, bucket) pairs up to this bucket size; kernels
@@ -173,7 +182,8 @@ _PROBE_BUCKET_CAP = 128
 
 
 def _probe_pad_invariance(
-    name: str, fn: Callable, n_features: int, ladder: List[int]
+    name: str, fn: Callable, n_features: int, ladder: List[int],
+    rank_tag: str = "",
 ) -> bool:
     """Empirically verify the bit-identity contract padding relies on:
     a row's outputs must not depend on batch row count, pad tail, or
@@ -195,7 +205,9 @@ def _probe_pad_invariance(
     rng = np.random.default_rng(0)
 
     def run(X: np.ndarray) -> Dict[str, np.ndarray]:
-        with telemetry.span(f"serve.warmup.{name}.probe", warmup=True):
+        with telemetry.span(
+            f"serve.warmup.{name}.probe{rank_tag}", warmup=True
+        ):
             return {k: np.asarray(v) for k, v in fn(X).items()}
 
     a, b = 5, 3
@@ -262,6 +274,8 @@ class ModelRegistry:
         hbm_budget_bytes: Optional[float] = None,
         warmup: Optional[bool] = None,
         max_bucket_rows: Optional[int] = None,
+        rank: Optional[int] = None,
+        mesh_mp: Optional[int] = None,
     ) -> None:
         if hbm_budget_bytes is None:
             hbm_budget_bytes = envspec.get("TPUML_SERVE_HBM_BUDGET")
@@ -270,6 +284,17 @@ class ModelRegistry:
             bool(envspec.get("TPUML_SERVE_WARMUP")) if warmup is None
             else bool(warmup)
         )
+        # replica identity (pod-scale serving): rank-stamps every warmup
+        # and probe span so a merged fleet trace attributes compiles to
+        # the replica that paid them; None (the default) keeps all span
+        # names byte-identical to single-replica serving
+        self._rank = None if rank is None else int(rank)
+        self._rank_tag = "" if rank is None else f".r{int(rank)}"
+        # model-axis degree for residency accounting: each of mp ranks
+        # holds 1/mp of a sharded model's state (PR-16 col/block
+        # layouts), so the per-rank HBM budget is charged shard bytes,
+        # not whole-model bytes. None = resolve TPUML_MESH_MP per model.
+        self._mesh_mp = None if mesh_mp is None else max(1, int(mesh_mp))
         raw = (
             int(envspec.get("TPUML_SERVE_MAX_BUCKET_ROWS"))
             if max_bucket_rows is None else int(max_bucket_rows)
@@ -301,9 +326,15 @@ class ModelRegistry:
         with self._lock:
             return list(self._entries)
 
+    @property
+    def rank(self) -> Optional[int]:
+        return self._rank
+
     def resident_bytes(self) -> int:
+        """This rank's resident bytes (shard bytes under model-axis
+        sharding; whole-model bytes at mp=1)."""
         with self._lock:
-            return sum(e.nbytes for e in self._entries.values())
+            return sum(e.shard_nbytes for e in self._entries.values())
 
     def warmup_state(self) -> Dict[str, Any]:
         """Readiness introspection for the ops plane (`/readyz` and
@@ -327,14 +358,17 @@ class ModelRegistry:
                 models[name] = {
                     "coalesce": e.coalesce,
                     "resident_bytes": e.nbytes,
+                    "mp_degree": e.mp_degree,
+                    "shard_bytes": e.shard_nbytes,
                     "warmed_buckets": sorted(e.warmed),
                     "pending_buckets": pending,
                 }
             return {
                 "ready": ready,
+                "rank": self._rank,
                 "ladder": ladder,
                 "resident_bytes_total": sum(
-                    e.nbytes for e in self._entries.values()
+                    e.shard_nbytes for e in self._entries.values()
                 ),
                 "evictions": self._evictions,
                 "models": models,
@@ -366,7 +400,8 @@ class ModelRegistry:
         coalesce = family in _COALESCE_FAMILIES
         if coalesce:
             coalesce = _probe_pad_invariance(
-                name, fn, n_features, self.bucket_ladder()
+                name, fn, n_features, self.bucket_ladder(),
+                rank_tag=self._rank_tag,
             )
             if not coalesce:
                 _LOGGER.info(
@@ -375,6 +410,7 @@ class ModelRegistry:
                     "will serve exact request shapes",
                     name,
                 )
+        nbytes = resident_nbytes(model)
         entry = ResidentModel(
             name=name,
             model=model,
@@ -382,14 +418,21 @@ class ModelRegistry:
             fn=fn,
             engine=engine,
             coalesce=coalesce,
-            nbytes=resident_nbytes(model),
+            nbytes=nbytes,
             n_features=n_features,
+            mp_degree=self._resolve_mp(nbytes),
         )
         with self._lock:
-            if self._budget is not None and entry.nbytes > self._budget:
+            if self._budget is not None and entry.shard_nbytes > self._budget:
                 raise ValueError(
-                    f"model {name!r} needs {entry.nbytes} resident bytes, "
-                    f"over the whole TPUML_SERVE_HBM_BUDGET "
+                    f"model {name!r} needs {entry.shard_nbytes} resident "
+                    f"bytes on this rank"
+                    + (
+                        f" (of {entry.nbytes} total over "
+                        f"mp={entry.mp_degree} model-axis shards)"
+                        if entry.mp_degree > 1 else ""
+                    )
+                    + f", over the whole TPUML_SERVE_HBM_BUDGET "
                     f"({self._budget:.0f})"
                 )
             self._entries.pop(name, None)
@@ -429,11 +472,26 @@ class ModelRegistry:
         _LOGGER.info("serving: evicted %s (%dB)", name, entry.nbytes)
 
     # -- internals ---------------------------------------------------------
+    def _resolve_mp(self, nbytes: int) -> int:
+        """Model-axis degree charged for a model of ``nbytes``:
+        constructor override first, else the ``TPUML_MESH_MP``
+        resolution (1 when the env is unset — identical accounting to
+        pre-replica serving). ``auto`` mode sizes against this model's
+        own footprint, so only models too big for one HBM shard."""
+        if self._mesh_mp is not None:
+            return self._mesh_mp
+        try:
+            from ..parallel.mesh import resolve_mesh_mp
+
+            return max(1, int(resolve_mesh_mp(float(nbytes))))
+        except Exception:
+            return 1
+
     def _admit_locked(self, keep: str) -> None:
         if self._budget is None:
             return
         while (
-            sum(e.nbytes for e in self._entries.values()) > self._budget
+            sum(e.shard_nbytes for e in self._entries.values()) > self._budget
             and len(self._entries) > 1
         ):
             victim = next(n for n in self._entries if n != keep)
@@ -442,7 +500,7 @@ class ModelRegistry:
             self._evictions += 1
             _LOGGER.info(
                 "serving: LRU-evicted %s (%dB) for %s",
-                victim, entry.nbytes, keep,
+                victim, entry.shard_nbytes, keep,
             )
 
     @staticmethod
@@ -463,7 +521,7 @@ class ModelRegistry:
     def _file_hbm_locked(self) -> None:
         telemetry.record_hbm_estimate(
             "serve_registry",
-            float(sum(e.nbytes for e in self._entries.values())),
+            float(sum(e.shard_nbytes for e in self._entries.values())),
         )
 
     def warm(self, entry: ResidentModel) -> None:
@@ -486,7 +544,7 @@ class ModelRegistry:
 
             def _compile_bucket(bucket: int = bucket, Xw: np.ndarray = Xw) -> None:
                 with telemetry.span(
-                    f"serve.warmup.{entry.name}.b{bucket}",
+                    f"serve.warmup.{entry.name}.b{bucket}{self._rank_tag}",
                     bucket=bucket, warmup=True,
                 ):
                     entry.fn(Xw)

@@ -1,0 +1,756 @@
+"""Pod-scale serving: a front-door router over N serving replicas.
+
+One ``ServingRuntime`` is a single dispatcher thread over a single
+process — a per-host ceiling no amount of micro-batching lifts. The
+:class:`Router` lifts it sideways: N replicas (in-process *loopback*
+``ServingRuntime`` instances first, subprocess workers behind the same
+duck-typed handle interface for real multi-host runs) serve one model
+fleet, and the router spreads the request stream over them with
+per-replica queue-depth / EWMA-latency awareness.
+
+Routing policy (``TPUML_ROUTER_POLICY``):
+
+- ``p2c`` (default) — power-of-two-choices: two rotating candidates are
+  scored by ``(EWMA-estimated wait, queue depth)`` and the better one
+  takes the request. The classic result applies: sampling *two* queues
+  drops the max load factor exponentially vs random/round-robin while
+  costing O(2) probes per request instead of least-loaded's O(N) — the
+  right trade once replica state lives behind an RPC.
+- ``round_robin`` — rotation only, no load awareness (the baseline the
+  bench compares against).
+- ``least_loaded`` — score every replica on every request; optimal
+  picks at O(N) probe cost per request.
+
+The scoring, breakers, and typed sheds reuse the extracted
+``runtime/admission.py`` primitives (:class:`ServiceEwma`,
+:class:`CircuitBreaker`, ``Overloaded``/``ShuttingDown``) at the
+routing layer, so a slow or breaker-open replica is **routed around,
+not queued behind**: admission sheds at the picked replica spend the
+reroute budget (``TPUML_ROUTER_REROUTES``) on the next candidates in
+score order, dispatch *faults* trip the per-replica breaker
+(``TPUML_ROUTER_BREAKER_FAILS``), and a request that no candidate
+admits sheds with a typed ``Overloaded`` counted on
+``router_shed_total{model,reason}``.
+
+Fleet-wide SLOs: every replica's metric snapshot merges through
+``telemetry.merge_metric_snapshots`` (reservoirs pooled, so the fleet
+``serve_p99_ms`` p99 is *measured* over pooled samples, not
+approximated from per-rank count/sum) — :meth:`Router.fleet_metrics`
+is what ``/statusz``'s fleet section and ``runtime/slo.py`` read.
+
+Explicit-construction only — building a :class:`Router` is the opt-in,
+exactly like ``ServingRuntime``. No router object means no replica
+threads, no ``router_*``/``fleet_*`` metric series, and bit-identical
+single-runtime serving (test-asserted in ``tests/test_router.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import struct
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..parallel.replica import ReplicaGroup, replica_groups
+from ..runtime import envspec, opsplane, telemetry
+from ..runtime.admission import (
+    AdmissionError,
+    CircuitBreaker,
+    DeadlineExceeded,
+    Overloaded,
+    ServiceEwma,
+    ShuttingDown,
+)
+from .registry import ResidentModel
+from .runtime import ServingRuntime
+
+__all__ = [
+    "Router",
+    "LoopbackReplica",
+    "SubprocessReplica",
+    "POLICIES",
+]
+
+logger = logging.getLogger("spark_rapids_ml_tpu.serving.router")
+
+POLICIES = ("p2c", "round_robin", "least_loaded")
+
+# shed reasons the router can emit (closed label set, TPU008): the
+# replica-level reasons propagate through; the last two are router-only
+_ROUTER_SHED_REASONS = (
+    "queue_full", "deadline_unmeetable", "breaker_open", "draining",
+    "no_replicas",
+)
+
+
+# ---------------------------------------------------------------------------
+# replica handles
+# ---------------------------------------------------------------------------
+
+
+class LoopbackReplica:
+    """An in-process ``ServingRuntime`` behind the replica-handle
+    interface — the transport for single-host pod-scale serving and for
+    every test that needs determinism. Shares this process's telemetry
+    registry, so :meth:`metrics_snapshot` returns None (the router's
+    local snapshot already covers it)."""
+
+    transport = "loopback"
+
+    def __init__(
+        self,
+        rank: int,
+        runtime: Optional[ServingRuntime] = None,
+        **runtime_kwargs: Any,
+    ) -> None:
+        self.rank = int(rank)
+        self.runtime = runtime or ServingRuntime(
+            rank=self.rank, **runtime_kwargs
+        )
+
+    def register(self, name: str, model: Any) -> ResidentModel:
+        return self.runtime.register(name, model)
+
+    def load(self, name: str, path: str) -> ResidentModel:
+        return self.runtime.load(name, path)
+
+    def predict_async(
+        self, name: str, X: np.ndarray, deadline_ms: Optional[float] = None
+    ) -> "Future[Dict[str, np.ndarray]]":
+        return self.runtime.predict_async(name, X, deadline_ms=deadline_ms)
+
+    def queue_depth(self) -> int:
+        return self.runtime.queue_depth()
+
+    def healthy(self) -> bool:
+        rt = self.runtime
+        if rt.is_closed() or rt.is_draining():
+            return False
+        return (not rt.dispatcher_started()) or rt.dispatcher_alive()
+
+    def warmup_state(self) -> Dict[str, Any]:
+        return self.runtime.registry.warmup_state()
+
+    def metrics_snapshot(self) -> Optional[Dict[str, Any]]:
+        return None  # shares the process-global telemetry registry
+
+    def drain(self, timeout: float = 30.0) -> Dict[str, Any]:
+        return self.runtime.drain(timeout)
+
+    def close(self) -> None:
+        self.runtime.close()
+
+
+def _encode_error(e: BaseException) -> Dict[str, Any]:
+    return {
+        "type": type(e).__name__,
+        "message": str(e),
+        "reason": getattr(e, "reason", None),
+    }
+
+
+_ERROR_TYPES = {
+    "DeadlineExceeded": DeadlineExceeded,
+    "ShuttingDown": ShuttingDown,
+    "AdmissionError": AdmissionError,
+    "KeyError": KeyError,
+    "ValueError": ValueError,
+}
+
+
+def _revive_error(d: Dict[str, Any]) -> BaseException:
+    """Rebuild a worker-side exception as its typed parent-side twin so
+    router reroute/breaker logic treats subprocess sheds exactly like
+    loopback sheds."""
+    t, msg = d.get("type", "RuntimeError"), d.get("message", "")
+    if t == "Overloaded":
+        return Overloaded(msg, reason=d.get("reason") or "queue_full")
+    return _ERROR_TYPES.get(t, RuntimeError)(msg)
+
+
+def _read_exact(f: Any, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = f.read(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class SubprocessReplica:
+    """A serving replica in its own OS process (its own GIL, its own
+    dispatcher, its own device client), spoken to over a length-prefixed
+    pickle protocol on stdin/stdout (``serving/_replica_worker.py`` is
+    the worker side). Same handle interface as :class:`LoopbackReplica`
+    with two deltas the router already tolerates: admission sheds
+    surface on the returned future (not synchronously), and
+    ``queue_depth`` is the in-flight RPC count (a probe-free proxy)."""
+
+    transport = "subprocess"
+
+    def __init__(
+        self,
+        rank: int,
+        env: Optional[Dict[str, str]] = None,
+        start_timeout_s: float = 120.0,
+        rpc_timeout_s: float = 120.0,
+    ) -> None:
+        self.rank = int(rank)
+        self._rpc_timeout_s = float(rpc_timeout_s)
+        penv = dict(os.environ)
+        penv["TPUML_REPLICA_RANK"] = str(self.rank)
+        penv.update(env or {})
+        self._proc = subprocess.Popen(
+            [
+                sys.executable, "-u", "-m",
+                "spark_rapids_ml_tpu.serving._replica_worker",
+            ],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            env=penv,
+        )
+        self._pending: Dict[int, "Future[Any]"] = {}
+        self._plock = threading.Lock()
+        self._wlock = threading.Lock()
+        self._next_id = 0
+        self._closed = False
+        self._hello: "Future[Dict[str, Any]]" = Future()
+        self._reader = threading.Thread(
+            target=self._read_loop,
+            name=f"tpuml-replica-r{self.rank}-reader",
+            daemon=True,
+        )
+        self._reader.start()
+        # the worker's hello frame doubles as the readiness barrier:
+        # once it arrives the runtime on the far side is constructed
+        self._hello.result(start_timeout_s)
+
+    # -- protocol ----------------------------------------------------------
+    def _submit(self, op: str, **kw: Any) -> "Future[Any]":
+        if self._closed:
+            raise ShuttingDown(
+                f"subprocess replica r{self.rank} is closed"
+            )
+        if self._proc.poll() is not None:
+            raise RuntimeError(
+                f"subprocess replica r{self.rank} exited "
+                f"(rc={self._proc.returncode})"
+            )
+        with self._plock:
+            rid = self._next_id
+            self._next_id += 1
+            fut: "Future[Any]" = Future()
+            self._pending[rid] = fut
+        payload = pickle.dumps(
+            {"id": rid, "op": op, **kw}, protocol=pickle.HIGHEST_PROTOCOL
+        )
+        try:
+            with self._wlock:
+                self._proc.stdin.write(struct.pack("!I", len(payload)))
+                self._proc.stdin.write(payload)
+                self._proc.stdin.flush()
+        except Exception as e:
+            with self._plock:
+                self._pending.pop(rid, None)
+            raise RuntimeError(
+                f"subprocess replica r{self.rank}: pipe write failed"
+            ) from e
+        return fut
+
+    def _call(
+        self, op: str, rpc_timeout: Optional[float] = None, **kw: Any
+    ) -> Any:
+        return self._submit(op, **kw).result(
+            self._rpc_timeout_s if rpc_timeout is None else rpc_timeout
+        )
+
+    def _read_loop(self) -> None:
+        out = self._proc.stdout
+        while True:
+            header = _read_exact(out, 4)
+            if header is None:
+                break
+            (ln,) = struct.unpack("!I", header)
+            body = _read_exact(out, ln)
+            if body is None:
+                break
+            try:
+                msg = pickle.loads(body)
+            except Exception:
+                break
+            rid = msg.get("id")
+            if rid == -1:
+                if not self._hello.done():
+                    self._hello.set_result(msg.get("value"))
+                continue
+            with self._plock:
+                fut = self._pending.pop(rid, None)
+            if fut is None or fut.done():
+                continue
+            if msg.get("ok"):
+                fut.set_result(msg.get("value"))
+            else:
+                fut.set_exception(_revive_error(msg.get("error") or {}))
+        # EOF: the worker died (or closed) — every outstanding future
+        # resolves now; a router upstream counts these as dispatch
+        # faults and trips the replica's breaker
+        exc = RuntimeError(
+            f"subprocess replica r{self.rank} exited "
+            f"(rc={self._proc.poll()})"
+        )
+        with self._plock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        if not self._hello.done():
+            self._hello.set_exception(exc)
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(exc)
+
+    # -- replica-handle interface ------------------------------------------
+    def register(self, name: str, model: Any) -> None:
+        raise NotImplementedError(
+            "subprocess replicas replicate from persisted models on a "
+            "shared path; persist the model and use load(name, path)"
+        )
+
+    def load(self, name: str, path: str) -> Dict[str, Any]:
+        return self._call("load", name=name, path=path)
+
+    def predict_async(
+        self, name: str, X: np.ndarray, deadline_ms: Optional[float] = None
+    ) -> "Future[Dict[str, np.ndarray]]":
+        return self._submit(
+            "predict",
+            name=name,
+            X=np.ascontiguousarray(X),
+            deadline_ms=deadline_ms,
+        )
+
+    def queue_depth(self) -> int:
+        with self._plock:
+            return len(self._pending)
+
+    def healthy(self) -> bool:
+        return not self._closed and self._proc.poll() is None
+
+    def warmup_state(self) -> Dict[str, Any]:
+        return self._call("warmup_state")
+
+    def metrics_snapshot(self) -> Optional[Dict[str, Any]]:
+        return self._call("metrics")
+
+    def drain(self, timeout: float = 30.0) -> Dict[str, Any]:
+        return self._call(
+            "drain", rpc_timeout=timeout + 10.0, timeout_s=timeout
+        )
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            if self._proc.poll() is None:
+                self._submit_close_best_effort()
+                self._proc.wait(timeout=10.0)
+        except Exception:
+            pass
+        if self._proc.poll() is None:
+            self._proc.kill()
+
+    def _submit_close_best_effort(self) -> None:
+        payload = pickle.dumps(
+            {"id": -2, "op": "close"}, protocol=pickle.HIGHEST_PROTOCOL
+        )
+        try:
+            with self._wlock:
+                self._proc.stdin.write(struct.pack("!I", len(payload)))
+                self._proc.stdin.write(payload)
+                self._proc.stdin.flush()
+                self._proc.stdin.close()
+        except Exception:
+            pass
+
+    def kill(self) -> None:
+        """Hard-kill the worker (the CI chaos smoke: one replica dies
+        mid-stream; the fleet's goodput must continue)."""
+        self._closed = True
+        try:
+            self._proc.kill()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+
+class Router:
+    """Front door of a serving replica fleet. See the module docstring
+    for policy and shed semantics.
+
+    ``replicas`` is either an integer (build that many loopback
+    replicas, ranks 0..N-1; default ``TPUML_ROUTER_REPLICAS``) or an
+    explicit sequence of replica handles (anything duck-typing
+    :class:`LoopbackReplica`). ``runtime_kwargs`` forward to each
+    built loopback replica's ``ServingRuntime``.
+    """
+
+    def __init__(
+        self,
+        replicas: Union[int, Sequence[Any], None] = None,
+        policy: Optional[str] = None,
+        breaker_fails: Optional[int] = None,
+        breaker_cooldown_ms: Optional[float] = None,
+        reroutes: Optional[int] = None,
+        runtime_kwargs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        if replicas is None:
+            replicas = int(envspec.get("TPUML_ROUTER_REPLICAS"))
+        if isinstance(replicas, int):
+            if replicas < 1:
+                raise ValueError(f"need >= 1 replica, got {replicas}")
+            kw = dict(runtime_kwargs or {})
+            self.replicas: List[Any] = [
+                LoopbackReplica(rank=i, **kw) for i in range(replicas)
+            ]
+        else:
+            self.replicas = list(replicas)
+            if not self.replicas:
+                raise ValueError("need >= 1 replica handle")
+        self.policy = str(
+            policy if policy is not None else envspec.get("TPUML_ROUTER_POLICY")
+        )
+        if self.policy not in POLICIES:
+            raise ValueError(
+                f"unknown router policy {self.policy!r}; expected one of "
+                f"{POLICIES}"
+            )
+        fails = int(
+            envspec.get("TPUML_ROUTER_BREAKER_FAILS")
+            if breaker_fails is None else breaker_fails
+        )
+        cooldown_ms = float(
+            envspec.get("TPUML_ROUTER_BREAKER_COOLDOWN_MS")
+            if breaker_cooldown_ms is None else breaker_cooldown_ms
+        )
+        self.reroutes = int(
+            envspec.get("TPUML_ROUTER_REROUTES")
+            if reroutes is None else reroutes
+        )
+        self._ewma = ServiceEwma()
+        self._breakers: Dict[int, CircuitBreaker] = {}
+        for i in range(len(self.replicas)):
+            self._breakers[i] = CircuitBreaker(
+                str(i), fails, cooldown_ms / 1e3,
+                on_state=(
+                    lambda state, i=i: telemetry.gauge(
+                        "router_breaker_state"
+                    ).set(state, replica=str(i))
+                ),
+            )
+        # rotation counter behind round_robin and the p2c candidate
+        # pair — deterministic (TPU004: no sampling randomness; a
+        # rotating pair covers all replicas like a random pair does in
+        # expectation, without making tests flaky)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._closed = False
+        telemetry.gauge("fleet_replicas").set(len(self.replicas))
+        opsplane.track_router(self)
+        logger.info(
+            "router: %d replica(s), policy=%s, breaker_fails=%d, "
+            "reroutes=%d",
+            len(self.replicas), self.policy, fails, self.reroutes,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for rep in self.replicas:
+            try:
+                rep.close()
+            except Exception:
+                logger.exception("router: replica close failed")
+
+    def drain(self, timeout: float = 30.0) -> Dict[str, Any]:
+        """Drain every replica (stop admission, flush in-flight, then
+        close); resolves every outstanding future fleet-wide. The
+        timeout bounds the whole fleet, not each replica."""
+        with self._lock:
+            already = self._closed
+            self._closed = True
+        if already:
+            return {"drained": True, "aborted": 0, "replicas": []}
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        per: List[Dict[str, Any]] = []
+        for rep in self.replicas:
+            try:
+                per.append(
+                    rep.drain(max(0.1, deadline - time.monotonic()))
+                )
+            except Exception as e:
+                per.append({"drained": False, "aborted": 0, "error": str(e)})
+        return {
+            "drained": all(bool(p.get("drained")) for p in per),
+            "aborted": sum(int(p.get("aborted", 0)) for p in per),
+            "replicas": per,
+        }
+
+    def is_closed(self) -> bool:
+        return self._closed
+
+    # -- registry replication ----------------------------------------------
+    def register(self, name: str, model: Any) -> List[Any]:
+        """Fan an in-memory model out to every replica (loopback only;
+        subprocess replicas replicate from a shared persisted path via
+        :meth:`load`)."""
+        return [rep.register(name, model) for rep in self.replicas]
+
+    def load(self, name: str, path: str) -> List[Any]:
+        """Replicate one persisted model onto every replica from the
+        shared ``path`` — each replica pins + warms its own copy and
+        reports residency per rank (:meth:`fleet_warmup_state`)."""
+        return [rep.load(name, path) for rep in self.replicas]
+
+    # -- picking -----------------------------------------------------------
+    def _healthy_index(self, i: int) -> bool:
+        try:
+            return bool(self.replicas[i].healthy())
+        except Exception:
+            return False
+
+    def _score(self, i: int) -> Tuple[float, int, int]:
+        """Replica load score, lower is better: (EWMA-estimated wait
+        behind the current depth, raw depth, index). A replica with no
+        latency history scores wait 0 — new capacity gets probed."""
+        try:
+            depth = int(self.replicas[i].queue_depth())
+        except Exception:
+            return (float("inf"), 1 << 30, i)
+        wait = self._ewma.estimated_wait_s(str(i), depth)
+        return (0.0 if wait is None else wait, depth, i)
+
+    def _order(self, healthy: List[int]) -> List[int]:
+        """Candidate replicas in try-order for one request (first is
+        the pick; the rest absorb the reroute budget)."""
+        with self._lock:
+            c = self._seq
+            self._seq += 1
+        n = len(healthy)
+        if n == 1 or self.policy == "round_robin":
+            k = c % n
+            return healthy[k:] + healthy[:k]
+        if self.policy == "least_loaded":
+            return sorted(healthy, key=self._score)
+        # p2c: two rotating candidates, better-scored first; remaining
+        # replicas trail in index order as the reroute fallback chain
+        a, b = healthy[c % n], healthy[(c + 1) % n]
+        if self._score(b) < self._score(a):
+            a, b = b, a
+        return [a, b] + [i for i in healthy if i not in (a, b)]
+
+    # -- request surface ---------------------------------------------------
+    def predict_async(
+        self,
+        name: str,
+        X: np.ndarray,
+        deadline_ms: Optional[float] = None,
+    ) -> "Future[Dict[str, np.ndarray]]":
+        """Route one request to a replica; same future contract as
+        ``ServingRuntime.predict_async``. Typed sheds only: admission
+        rejections at the picked replica spend the reroute budget on
+        the next candidates; a request no candidate admits raises
+        ``Overloaded`` (counted on ``router_shed_total``)."""
+        if self._closed:
+            raise ShuttingDown("Router is closed")
+        telemetry.counter("router_requests_total").inc(1, model=name)
+        healthy = [
+            i for i in range(len(self.replicas)) if self._healthy_index(i)
+        ]
+        if not healthy:
+            self._shed(name, "no_replicas", "no healthy replica in the fleet")
+        order = self._order(healthy)
+        budget = 1 + max(0, self.reroutes)
+        tried = 0
+        last: Optional[AdmissionError] = None
+        for i in order:
+            if tried >= budget:
+                break
+            if not self._breakers[i].allow():
+                continue  # breaker-open: routed around, no budget spent
+            tried += 1
+            rep = self.replicas[i]
+            try:
+                telemetry.gauge("router_replica_depth").set(
+                    rep.queue_depth(), replica=str(i)
+                )
+            except Exception:
+                pass
+            t0 = time.perf_counter()
+            try:
+                fut = rep.predict_async(name, X, deadline_ms=deadline_ms)
+            except AdmissionError as e:
+                last = e  # replica shed at admission: spend the budget
+                continue
+            except (KeyError, ValueError):
+                raise  # caller bug (unknown model, bad shape) — every
+                # replica would answer the same; don't burn breakers
+            except Exception:
+                self._breakers[i].record_failure()
+                logger.exception(
+                    "router: dispatch to replica %d faulted", i
+                )
+                continue
+            telemetry.counter("router_picks_total").inc(1, replica=str(i))
+            self._observe(fut, i, t0)
+            return fut
+        if last is None:
+            reason = "breaker_open"
+            msg = (
+                f"all {len(order)} healthy replica(s) have open router "
+                f"breakers or faulted at dispatch"
+            )
+        elif isinstance(last, ShuttingDown):
+            reason, msg = "draining", str(last)
+        elif isinstance(last, DeadlineExceeded):
+            reason, msg = "deadline_unmeetable", str(last)
+        else:
+            reason = getattr(last, "reason", "queue_full")
+            msg = str(last)
+        self._shed(name, reason, msg)
+
+    def predict(
+        self,
+        name: str,
+        X: np.ndarray,
+        timeout: Optional[float] = None,
+        deadline_ms: Optional[float] = None,
+    ) -> Dict[str, np.ndarray]:
+        return self.predict_async(name, X, deadline_ms=deadline_ms).result(
+            timeout
+        )
+
+    def _shed(self, name: str, reason: str, msg: str) -> None:
+        telemetry.counter("router_shed_total").inc(
+            1, model=name, reason=reason
+        )
+        raise Overloaded(f"router: {msg}", reason=reason)
+
+    def _observe(self, fut: "Future[Any]", i: int, t0: float) -> None:
+        """Fold the request's outcome into the replica's routing state:
+        success and replica-side sheds update the EWMA (a shed arrives
+        late — exactly the signal to steer away from); only dispatch
+        *faults* count against the breaker."""
+        breaker = self._breakers[i]
+        key = str(i)
+
+        def _done(f: "Future[Any]") -> None:
+            dt = time.perf_counter() - t0
+            if f.cancelled():
+                return
+            exc = f.exception()
+            if exc is None:
+                self._ewma.note(key, dt, 1)
+                breaker.record_success()
+            elif isinstance(exc, AdmissionError):
+                self._ewma.note(key, dt, 1)
+            else:
+                breaker.record_failure()
+
+        fut.add_done_callback(_done)
+
+    # -- fleet views (ops plane / SLOs) ------------------------------------
+    def healthy_count(self) -> int:
+        return sum(
+            1 for i in range(len(self.replicas)) if self._healthy_index(i)
+        )
+
+    def replica_states(self) -> List[Dict[str, Any]]:
+        out = []
+        for i, rep in enumerate(self.replicas):
+            try:
+                depth: Optional[int] = int(rep.queue_depth())
+            except Exception:
+                depth = None
+            out.append(
+                {
+                    "replica": i,
+                    "rank": getattr(rep, "rank", i),
+                    "transport": getattr(rep, "transport", "unknown"),
+                    "healthy": self._healthy_index(i),
+                    "breaker": self._breakers[i].state_name(),
+                    "queue_depth": depth,
+                }
+            )
+        return out
+
+    def groups(self, mp: int = 1) -> List[ReplicaGroup]:
+        """The fleet's rank layout as replica groups (``mp`` ranks per
+        replica under model-axis sharding)."""
+        return replica_groups(len(self.replicas) * max(1, int(mp)), mp)
+
+    def replica_snapshots(self) -> List[Dict[str, Any]]:
+        """Metric snapshots of replicas that do NOT share this
+        process's telemetry registry (loopback handles return None and
+        are covered by the local snapshot)."""
+        snaps = []
+        for rep in self.replicas:
+            try:
+                s = rep.metrics_snapshot()
+            except Exception:
+                s = None
+            if s:
+                snaps.append(s)
+        return snaps
+
+    def fleet_metrics(self) -> Dict[str, Any]:
+        """The fleet-wide merged metric snapshot: local process +
+        every out-of-process replica, reservoirs pooled so merged
+        histogram quantiles are measured (`serve_p99_ms` p99 over the
+        pooled samples), counters summed, gauges maxed."""
+        snaps = [telemetry.metrics_snapshot()] + self.replica_snapshots()
+        return telemetry.merge_metric_snapshots(snaps)
+
+    def fleet_p99_ms(self) -> Dict[str, float]:
+        """Measured fleet-wide serve p99 per model, from the merged
+        reservoirs (empty until something has served)."""
+        out: Dict[str, float] = {}
+        entry = self.fleet_metrics().get("serve_p99_ms") or {}
+        for s in entry.get("series", []):
+            if "p99" in s:
+                out[s.get("labels", {}).get("model", "")] = float(s["p99"])
+        return out
+
+    def fleet_warmup_state(self) -> Dict[str, Any]:
+        """Residency/readiness per rank, rolled up: ``ready`` iff every
+        replica's registry reports ready."""
+        reps: List[Dict[str, Any]] = []
+        for rep in self.replicas:
+            try:
+                reps.append(rep.warmup_state())
+            except Exception as e:
+                reps.append({"ready": False, "error": str(e)})
+        return {
+            "ready": bool(reps) and all(r.get("ready") for r in reps),
+            "replicas": reps,
+        }

@@ -132,9 +132,16 @@ class ServingRuntime:
         default_deadline_ms: Optional[float] = None,
         breaker_fails: Optional[int] = None,
         breaker_cooldown_ms: Optional[float] = None,
+        rank: Optional[int] = None,
     ) -> None:
+        # replica identity under a pod-scale router (serving/router.py):
+        # stamps this runtime's warmup spans and residency reports with
+        # its rank. None (the default) is byte-identical single-replica
+        # serving.
+        self.rank = None if rank is None else int(rank)
+        self._rank_tag = "" if rank is None else f".r{int(rank)}"
         self.registry = registry or ModelRegistry(
-            warmup=warmup, max_bucket_rows=max_bucket_rows
+            warmup=warmup, max_bucket_rows=max_bucket_rows, rank=rank
         )
         self._window_s = (
             int(envspec.get("TPUML_SERVE_BATCH_WINDOW_US"))
@@ -598,7 +605,9 @@ class ServingRuntime:
             if bucket in entry.warmed:
                 span_name = "serve.batch"
             else:
-                span_name = f"serve.warmup.{entry.name}.b{bucket}"
+                span_name = (
+                    f"serve.warmup.{entry.name}.b{bucket}{self._rank_tag}"
+                )
                 attrs["warmup"] = True
                 entry.warmed.add(bucket)
 

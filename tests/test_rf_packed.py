@@ -152,7 +152,16 @@ def test_packed_eval_bit_identical_to_bins(monkeypatch):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("depth,trees", [(5, 7), (9, 9), (11, 5)])
+# The deep/wide shapes cost ~30s each in interpret mode for the same packed
+# traversal path as (5, 7); they stay on --runslow to keep tier-1 in budget.
+@pytest.mark.parametrize(
+    "depth,trees",
+    [
+        (5, 7),
+        pytest.param(9, 9, marks=pytest.mark.slow),
+        pytest.param(11, 5, marks=pytest.mark.slow),
+    ],
+)
 def test_rf_transform_packed_matches_bins(monkeypatch, depth, trees):
     """TPUML_RF_APPLY=packed (interpret-forced kernel) must reproduce the
     bins descent bit-for-bit at the model level — every output column,

@@ -1,0 +1,391 @@
+"""Pod-scale serving router: loopback-fleet bit-identity vs a direct
+transform, load-aware steering away from a slowed replica, per-replica
+circuit breaking (routed around, typed ``Overloaded`` sheds when the
+whole fleet is dark), fleet-wide drain resolving every future, the
+defaults-inert contract (no Router => no ``router_*``/``fleet_*``
+series, no replica threads, bit-identical single-runtime serving), and
+the subprocess transport (spawn-probe gated: replicate a persisted
+model, serve bit-identically, merge remote reservoirs, survive a
+mid-stream kill).
+"""
+
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.parallel import group_of, replica_groups
+from spark_rapids_ml_tpu.runtime import telemetry
+from spark_rapids_ml_tpu.runtime.admission import Overloaded, ShuttingDown
+from spark_rapids_ml_tpu.serving import (
+    LoopbackReplica,
+    Router,
+    ServingRuntime,
+    SubprocessReplica,
+)
+
+N, D = 400, 10
+SEED = 7
+
+RT_KW = dict(batch_window_us=10_000, max_bucket_rows=32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset_telemetry()
+    yield
+    telemetry.reset_telemetry()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(SEED)
+    return rng.normal(size=(N, D)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def pca(data):
+    return PCA(k=4).fit(DataFrame({"features": data}))
+
+
+@pytest.fixture(scope="module")
+def pca_path(pca, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("models") / "pca")
+    pca.write().save(path)
+    return path
+
+
+def _queries(rng, sizes):
+    return [rng.normal(size=(s, D)).astype(np.float32) for s in sizes]
+
+
+def _assert_bit_identical(model, q, out):
+    direct = model.transform(DataFrame({"features": q}))
+    for col, served in out.items():
+        assert np.array_equal(served, np.asarray(direct[col])), (
+            col, q.shape,
+        )
+
+
+def _counter_by_label(name, label):
+    """``{label_value: value}`` for one counter's series."""
+    entry = telemetry.metrics_snapshot().get(name) or {}
+    return {
+        s.get("labels", {}).get(label): s.get("value")
+        for s in entry.get("series", [])
+    }
+
+
+# --- loopback fleet --------------------------------------------------------
+
+
+def test_two_replica_fleet_bit_identity(pca):
+    """Every request served through a 2-replica fleet equals the direct
+    transform bit-for-bit, both replicas take traffic, and the fleet
+    p99 is measured from the merged reservoirs."""
+    rng = np.random.default_rng(11)
+    qs = _queries(rng, [3, 1, 17, 2, 9, 1, 5, 8])
+    with Router(
+        replicas=2, policy="round_robin", runtime_kwargs=RT_KW
+    ) as router:
+        router.register("m", pca)
+        futs = [router.predict_async("m", q) for q in qs]
+        outs = [f.result(180) for f in futs]
+        picks = _counter_by_label("router_picks_total", "replica")
+        fleet_p99 = router.fleet_p99_ms()
+        states = router.replica_states()
+        assert router.healthy_count() == 2
+    for q, out in zip(qs, outs):
+        _assert_bit_identical(pca, q, out)
+    # round_robin rotation spreads the stream over both replicas
+    assert picks.get("0", 0) > 0 and picks.get("1", 0) > 0
+    assert sum(picks.values()) == len(qs)
+    # merged-reservoir fleet tail: measured, per model, positive
+    assert fleet_p99.get("m", 0.0) > 0.0
+    assert {s["transport"] for s in states} == {"loopback"}
+    assert all(s["breaker"] == "closed" for s in states)
+
+
+def test_least_loaded_steers_away_from_slow_replica(pca):
+    """A replica whose dispatches slow down stops winning least-loaded
+    picks: its queue depth and EWMA wait grow, so the stream steers to
+    the fast replica instead of queueing behind the slow one."""
+    with Router(
+        replicas=2,
+        policy="least_loaded",
+        runtime_kwargs=dict(batch_window_us=5_000, max_bucket_rows=32),
+    ) as router:
+        router.register("m", pca)
+        # slow replica 0 AFTER registration (warmup stays fast): every
+        # dispatch through it now takes >= 60 ms
+        entry0 = router.replicas[0].runtime.registry.get("m")
+        orig_fn = entry0.fn
+
+        def slow_fn(X):
+            time.sleep(0.06)
+            return orig_fn(X)
+
+        entry0.fn = slow_fn
+        rng = np.random.default_rng(13)
+        futs = []
+        for _ in range(40):
+            futs.append(
+                router.predict_async(
+                    "m", rng.normal(size=(4, D)).astype(np.float32)
+                )
+            )
+            time.sleep(0.002)
+        for f in futs:
+            assert f.result(60)
+        picks = _counter_by_label("router_picks_total", "replica")
+    assert picks.get("1", 0) > picks.get("0", 0), picks
+
+
+def test_breaker_open_replica_routed_around(pca):
+    """One dispatch fault trips the faulting replica's router breaker
+    (``breaker_fails=1``); later requests are routed around it with no
+    reroute budget spent and still serve bit-identically."""
+    rng = np.random.default_rng(17)
+    with Router(
+        replicas=2,
+        policy="round_robin",
+        breaker_fails=1,
+        breaker_cooldown_ms=60_000,
+        runtime_kwargs=RT_KW,
+    ) as router:
+        router.register("m", pca)
+        entry0 = router.replicas[0].runtime.registry.get("m")
+
+        def boom(X):
+            raise RuntimeError("injected dispatch fault")
+
+        entry0.fn = boom
+        # rotation starts at replica 0: this request faults on the
+        # future, and the resolved future's done-callback trips the
+        # breaker before .exception() returns
+        f0 = router.predict_async(
+            "m", rng.normal(size=(4, D)).astype(np.float32)
+        )
+        assert isinstance(f0.exception(60), RuntimeError)
+        assert router.replica_states()[0]["breaker"] == "open"
+        qs = _queries(rng, [3, 2, 5, 4, 2, 6, 3, 2])
+        outs = [router.predict("m", q, timeout=60) for q in qs]
+        picks = _counter_by_label("router_picks_total", "replica")
+    for q, out in zip(qs, outs):
+        _assert_bit_identical(pca, q, out)
+    # the faulted request is replica 0's only pick; everything after
+    # the breaker opened went to replica 1
+    assert picks.get("0") == 1
+    assert picks.get("1") == len(qs)
+
+
+def test_whole_fleet_dark_sheds_typed(pca):
+    """With every replica breaker-open the router sheds with a typed
+    ``Overloaded(reason="breaker_open")`` counted on
+    ``router_shed_total`` — never a bare exception."""
+    rng = np.random.default_rng(19)
+    with Router(
+        replicas=1,
+        breaker_fails=1,
+        breaker_cooldown_ms=60_000,
+        runtime_kwargs=RT_KW,
+    ) as router:
+        router.register("m", pca)
+        entry = router.replicas[0].runtime.registry.get("m")
+        entry.fn = lambda X: (_ for _ in ()).throw(RuntimeError("down"))
+        f0 = router.predict_async(
+            "m", rng.normal(size=(4, D)).astype(np.float32)
+        )
+        assert f0.exception(60) is not None
+        with pytest.raises(Overloaded) as ei:
+            router.predict_async(
+                "m", rng.normal(size=(4, D)).astype(np.float32)
+            )
+        assert ei.value.reason == "breaker_open"
+        sheds = _counter_by_label("router_shed_total", "reason")
+    assert sheds.get("breaker_open", 0) >= 1
+
+
+def test_unknown_model_raises_not_shed(pca):
+    """A caller bug (unknown model name) propagates as-is instead of
+    burning reroute budget or breakers — every replica would answer the
+    same."""
+    with Router(replicas=2, runtime_kwargs=RT_KW) as router:
+        router.register("m", pca)
+        with pytest.raises(KeyError):
+            router.predict_async("nope", np.zeros((2, D), np.float32))
+        assert all(
+            s["breaker"] == "closed" for s in router.replica_states()
+        )
+
+
+def test_drain_fleet_resolves_every_future(pca):
+    """Fleet drain resolves every outstanding future — served or a
+    typed ``ShuttingDown`` — and post-drain submits are refused."""
+    rng = np.random.default_rng(23)
+    with Router(
+        replicas=2,
+        runtime_kwargs=dict(batch_window_us=250_000, max_bucket_rows=32),
+    ) as router:
+        router.register("m", pca)
+        futs = [
+            router.predict_async(
+                "m", rng.normal(size=(3, D)).astype(np.float32)
+            )
+            for _ in range(12)
+        ]
+        res = router.drain(60.0)
+        assert res["drained"] is True
+        assert len(res["replicas"]) == 2
+        for f in futs:
+            assert f.done()
+            exc = f.exception()
+            assert exc is None or isinstance(exc, ShuttingDown)
+        with pytest.raises(ShuttingDown):
+            router.predict_async("m", np.zeros((2, D), np.float32))
+
+
+def test_register_fans_out_and_warmup_rolls_up(pca):
+    """``register`` replicates onto every replica; the fleet warmup
+    roll-up is ready only when every rank's registry is ready."""
+    with Router(replicas=2, runtime_kwargs=RT_KW) as router:
+        entries = router.register("m", pca)
+        assert len(entries) == 2
+        state = router.fleet_warmup_state()
+        assert state["ready"] is True
+        assert len(state["replicas"]) == 2
+
+
+def test_groups_map_replicas_onto_ranks(pca):
+    """The fleet's rank layout under model-axis sharding: N replicas x
+    mp ranks each, contiguous, every rank owned exactly once."""
+    with Router(replicas=2, runtime_kwargs=RT_KW) as router:
+        groups = router.groups(mp=2)
+    assert [g.ranks for g in groups] == [(0, 1), (2, 3)]
+    assert [g.leader for g in groups] == [0, 2]
+    assert group_of(3, 4, 2).index == 1
+    with pytest.raises(ValueError):
+        replica_groups(3, 2)  # ragged world: replica missing a shard
+
+
+# --- defaults-inert --------------------------------------------------------
+
+
+def test_defaults_inert_no_router_no_fleet_surface(pca):
+    """No Router object => no router/fleet metric series, no replica
+    threads, no rank-stamped warmup spans, and single-runtime serving
+    stays bit-identical to the direct transform."""
+    rng = np.random.default_rng(29)
+    qs = _queries(rng, [3, 1, 5])
+    with ServingRuntime(**RT_KW) as rt:
+        rt.register("m", pca)
+        outs = [rt.predict("m", q, timeout=180) for q in qs]
+    for q, out in zip(qs, outs):
+        _assert_bit_identical(pca, q, out)
+    snap = telemetry.metrics_snapshot()
+    assert not [
+        k for k in snap if k.startswith("router_") or k.startswith("fleet_")
+    ]
+    assert not [
+        t.name for t in threading.enumerate()
+        if "tpuml-replica" in t.name
+    ]
+    # rank-less runtime: warmup spans carry no `.r<rank>` stamp
+    assert not [
+        name for name in telemetry.span_stats()
+        if re.search(r"\.r\d+$", name)
+    ]
+
+
+# --- subprocess transport (capability-probed) ------------------------------
+
+_SUB_PROBE_RESULT = None  # None = not probed, "" = capable, else skip reason
+
+
+def _probe_subprocess_replica():
+    """One worker spawn + one RPC round-trip; any failure (sandboxed
+    subprocess, worker import error, pipe policy) becomes the cached
+    skip reason instead of a red test."""
+    try:
+        rep = SubprocessReplica(rank=9, start_timeout_s=180.0)
+    except Exception as e:  # noqa: BLE001 - diagnosis, not control flow
+        return f"worker spawn failed: {type(e).__name__}: {e}"
+    try:
+        state = rep.warmup_state()
+        if not isinstance(state, dict):
+            return f"warmup_state RPC returned {type(state).__name__}"
+    except Exception as e:  # noqa: BLE001
+        return f"worker RPC failed: {type(e).__name__}: {e}"
+    finally:
+        rep.close()
+    return ""
+
+
+def _require_subprocess_replica():
+    global _SUB_PROBE_RESULT
+    if _SUB_PROBE_RESULT is None:
+        _SUB_PROBE_RESULT = _probe_subprocess_replica()
+    if _SUB_PROBE_RESULT:
+        pytest.skip(
+            f"subprocess replicas unavailable here: {_SUB_PROBE_RESULT}"
+        )
+
+
+@pytest.mark.slow
+def test_subprocess_fleet_replicates_serves_and_survives_kill(
+    pca, pca_path
+):
+    """Mixed-transport fleet: a subprocess replica replicates the model
+    from the shared persisted path, serves bit-identically to the
+    parent's direct transform, contributes its reservoirs to the merged
+    fleet snapshot — and when hard-killed mid-stream the loopback
+    replica keeps the fleet serving."""
+    _require_subprocess_replica()
+    rng = np.random.default_rng(31)
+    sub = SubprocessReplica(rank=1)
+    router = Router(
+        replicas=[LoopbackReplica(rank=0, **RT_KW), sub],
+        policy="round_robin",
+        breaker_fails=1,
+        breaker_cooldown_ms=60_000,
+    )
+    try:
+        router.load("m", pca_path)
+        state = router.fleet_warmup_state()
+        assert state["ready"] is True, state
+        assert {
+            s["transport"] for s in router.replica_states()
+        } == {"loopback", "subprocess"}
+
+        qs = _queries(rng, [3, 2, 5, 1, 8, 4])
+        outs = [router.predict("m", q, timeout=120) for q in qs]
+        for q, out in zip(qs, outs):
+            _assert_bit_identical(pca, q, out)
+
+        # remote reservoirs pooled into the fleet view
+        merged = router.fleet_metrics()
+        series = (merged.get("serve_p99_ms") or {}).get("series", [])
+        counts = [s.get("count", 0) for s in series]
+        assert sum(counts) >= len(qs)
+        assert router.fleet_p99_ms().get("m", 0.0) > 0.0
+
+        # chaos: hard-kill the subprocess replica mid-stream — the
+        # fleet keeps serving through the loopback replica
+        sub.kill()
+        assert router.healthy_count() == 1
+        outs = [
+            router.predict(
+                "m",
+                rng.normal(size=(3, D)).astype(np.float32),
+                timeout=120,
+            )
+            for _ in range(6)
+        ]
+        assert len(outs) == 6
+        assert router.replica_states()[1]["healthy"] is False
+    finally:
+        router.close()
