@@ -2429,6 +2429,192 @@ def bench_fit_sched(mesh, n_chips):
     }
 
 
+def bench_lifecycle(mesh, n_chips):
+    """Continuous-training lifecycle bench: sustained closed-loop QPS
+    through >= 3 consecutive versioned hot-swaps, plus the canary
+    re-flip (rollback) latency.
+
+    Reports the client-observed p99 during the swap windows against the
+    steady-state p99 (``swap_p99_delta_ms``) and the time a rollback
+    takes to re-flip the live version (``rollback_ms``). Hard gates —
+    the zero-downtime contract: zero typed sheds and zero new retrace
+    storms across every flip, every version lands (v4 resident at the
+    end), and the during-swap p99 must stay within 15% of steady state
+    (small absolute floor for sub-ms noise), else this entry raises and
+    the bench-regression gate sees it missing."""
+    import threading
+
+    from spark_rapids_ml_tpu.data import DataFrame
+    from spark_rapids_ml_tpu.models.feature import PCA
+    from spark_rapids_ml_tpu.runtime import telemetry as tele
+    from spark_rapids_ml_tpu.serving import ModelLifecycle, ServingRuntime
+
+    rng = np.random.default_rng(53)
+    n, d, k = 2048, 16, 8
+    n_swaps = int(os.environ.get("BENCH_LIFECYCLE_SWAPS", 3))
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    df = DataFrame({"features": X})
+
+    t0 = time.perf_counter()
+    # v1 + swap candidates fitted on the same data with the same params:
+    # served outputs stay identical, so any latency delta is pure swap
+    # machinery (stage+warm beside live, atomic flip, evict)
+    versions = [PCA(k=k).fit(df) for _ in range(1 + n_swaps)]
+    other = rng.standard_normal((n, d)).astype(np.float32)
+    divergent = PCA(k=k).fit(DataFrame({"features": other}))
+    fit_seconds = time.perf_counter() - t0
+
+    def _metric_total(name):
+        s = tele.metrics_snapshot().get(name)
+        return sum(row["value"] for row in s["series"]) if s else 0
+
+    storms_base = _metric_total("retrace_storms")
+    sheds_base = _metric_total("serve_shed_total")
+
+    sizes = (3, 8, 17, 33)
+    queries = [
+        rng.standard_normal((s, d)).astype(np.float32) for s in sizes
+    ]
+
+    # baseline: the direct per-request transform loop a deployment
+    # without the resident registry runs (no hot-swap possible there
+    # short of a process restart)
+    t0 = time.perf_counter()
+    for i in range(64):
+        versions[0].transform(DataFrame({"features": queries[i % 4]}))
+    direct_seconds = time.perf_counter() - t0
+    direct_rows = sum(q.shape[0] for q in queries) * 16
+
+    lat_steady, lat_swap = [], []  # (latency_ms, rows) at resolution
+    phase = {"buf": lat_steady}
+    stop = threading.Event()
+    errors = []
+
+    with ServingRuntime(batch_window_us=2000, max_bucket_rows=64) as rt:
+        rt.register("pca", versions[0])
+        lc = ModelLifecycle(rt)
+
+        def client(tid):
+            i = tid
+            while not stop.is_set():
+                q = queries[i % len(queries)]
+                t_r = time.perf_counter()
+                try:
+                    rt.predict("pca", q, timeout=600)
+                except Exception as e:  # typed shed = gate failure
+                    errors.append(e)
+                    return
+                phase["buf"].append(
+                    ((time.perf_counter() - t_r) * 1e3, q.shape[0])
+                )
+                i += 1
+
+        swap_ms = []
+        t_serve = time.perf_counter()
+        threads = [
+            threading.Thread(target=client, args=(t,)) for t in range(3)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(1.0)  # steady-state window
+            for v, model in enumerate(versions[1:], start=2):
+                phase["buf"] = lat_swap
+                t_s = time.perf_counter()
+                with tele.span("serve.bench.swap", version=v):
+                    lc.swap("pca", model=model)
+                swap_ms.append((time.perf_counter() - t_s) * 1e3)
+                time.sleep(0.2)  # tail of the swap window
+                phase["buf"] = lat_steady
+                time.sleep(0.5)  # recover between consecutive swaps
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(120)
+        serve_seconds = time.perf_counter() - t_serve
+
+        if errors:
+            raise RuntimeError(
+                f"lifecycle load took a typed shed under swap: {errors[0]!r}"
+            )
+        final = rt.registry.get("pca")
+        if final.version != 1 + n_swaps or rt.registry.names() != ["pca"]:
+            raise RuntimeError(
+                f"swap ladder did not land a single consistent version: "
+                f"v{final.version}, resident={rt.registry.names()}"
+            )
+
+        # rollback latency: a mirrored canary re-flipped to the live
+        # version (shadow route cleared + candidate evicted + breaker)
+        lc.start_canary(
+            "pca", model=divergent, fraction=1.0, min_requests=10**6
+        )
+        rt.predict("pca", queries[1], timeout=600)
+        t_r = time.perf_counter()
+        lc.rollback("pca", reason="manual")
+        rollback_ms = (time.perf_counter() - t_r) * 1e3
+        lc.drain(timeout=30)
+
+    new_storms = _metric_total("retrace_storms") - storms_base
+    if new_storms:
+        raise RuntimeError(
+            f"lifecycle load swept {new_storms} retrace storm(s)"
+        )
+    new_sheds = _metric_total("serve_shed_total") - sheds_base
+    if new_sheds:
+        raise RuntimeError(
+            f"lifecycle load shed {new_sheds} request(s) across the flips"
+        )
+
+    steady = np.array([ms for ms, _ in lat_steady])
+    swapw = np.array([ms for ms, _ in lat_swap])
+    if steady.size < 16 or swapw.size < 4:
+        raise RuntimeError(
+            f"lifecycle load under-sampled: steady={steady.size} "
+            f"swap={swapw.size}"
+        )
+    steady_p99 = float(np.percentile(steady, 99))
+    swap_p99 = float(np.percentile(swapw, 99))
+    # the 15% zero-downtime latency gate; the absolute floor absorbs
+    # host-side warm-compile CPU contention on the CPU backend, where
+    # the bucket-ladder compiles and the serving compute share cores
+    # (on an accelerator device compute is unaffected and the relative
+    # bound is the binding one) — a retrace storm or a blocked flip
+    # shows up as a 100ms+ delta and still trips it
+    if swap_p99 > max(1.15 * steady_p99, steady_p99 + 10.0):
+        raise RuntimeError(
+            f"hot-swap disturbed the tail: during-swap p99 "
+            f"{swap_p99:.3f}ms vs steady {steady_p99:.3f}ms (>15%)"
+        )
+
+    rows_served = int(
+        sum(r for _, r in lat_steady) + sum(r for _, r in lat_swap)
+    )
+    return {
+        "samples_per_sec_per_chip": rows_served / serve_seconds / n_chips,
+        "fit_seconds": fit_seconds,
+        "rows": rows_served,
+        "swaps": len(swap_ms),
+        "swap_ms": [round(m, 3) for m in swap_ms],
+        "p50_ms": round(float(np.percentile(steady, 50)), 3),
+        "p99_ms": round(steady_p99, 3),
+        "swap_p99_ms": round(swap_p99, 3),
+        "swap_p99_delta_ms": round(max(0.0, swap_p99 - steady_p99), 3),
+        "rollback_ms": round(rollback_ms, 3),
+        "retrace_storms": new_storms,
+        "flops_model": 2.0 * rows_served * d * k,
+        "baseline_samples_per_sec": direct_rows / direct_seconds / n_chips,
+        "baseline_kind": "direct_transform_loop",
+        "baseline_inputs": {
+            "formula": "per_request_model_transform_loop_v1",
+            "requests": 64,
+            "rows": direct_rows,
+            "direct_seconds": round(direct_seconds, 4),
+            "n": n, "d": d, "k": k,
+        },
+    }
+
+
 def _probe_backend(
     attempts: int | None = None,
     probe_timeout: int | None = None,
@@ -2602,6 +2788,7 @@ def main() -> None:
         "serving": lambda: bench_serving(mesh, n_chips),
         "router": lambda: bench_router(mesh, n_chips),
         "fit_sched": lambda: bench_fit_sched(mesh, n_chips),
+        "lifecycle": lambda: bench_lifecycle(mesh, n_chips),
         "pca": lambda: bench_pca(*_X()[:2], mesh, n_chips),
         "kmeans": lambda: bench_kmeans(*_X()[:2], mesh, n_chips),
         "logreg": lambda: bench_logreg(*_X(), mesh, n_chips),
@@ -2960,6 +3147,8 @@ def _emit_line(results, meta, watchdog_tripped):
         "mp_degree", "mp_ab",
         "replicas", "policy", "offered_qps", "aggregate_goodput_qps",
         "replica_scaling_efficiency", "fleet_p99_ms", "fleet_sweep",
+        "swaps", "swap_ms", "swap_p99_ms", "swap_p99_delta_ms",
+        "rollback_ms",
     )
     for name, r in results.items():
         line[name] = {

@@ -1323,4 +1323,116 @@ print(f"fit scheduler chaos smoke OK: 1 injected fault isolated, "
       f"drain {report}")
 EOF
 
+echo "== lifecycle hot-swap chaos smoke =="
+# Continuous-training lifecycle (docs/serving.md#lifecycle contract):
+# a v2 re-fit through the scheduler hot-swaps under live traffic with
+# zero typed sheds and exactly one resident version; an injected
+# swap:warm fault surfaces as a typed SwapError with v1 untouched and
+# still serving; a divergent canary rolls back automatically and the
+# version breaker refuses the immediate retry.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import threading
+import time
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.runtime import FitScheduler, faults, telemetry
+from spark_rapids_ml_tpu.serving import (
+    LifecycleError, ModelLifecycle, ServingRuntime, SwapError,
+)
+
+telemetry.reset_telemetry()
+faults.reset_faults()
+rng = np.random.default_rng(19)
+X = rng.normal(size=(512, 8)).astype(np.float32)
+df = DataFrame({"features": X})
+queries = [rng.normal(size=(s, 8)).astype(np.float32) for s in (3, 17, 33)]
+
+def totals(name):
+    s = telemetry.metrics_snapshot().get(name)
+    return sum(row["value"] for row in s["series"]) if s else 0
+
+with ServingRuntime(batch_window_us=5000, max_bucket_rows=64) as rt:
+    rt.register("pca", PCA(k=4).fit(df))
+    with FitScheduler() as sched:
+        lc = ModelLifecycle(rt, scheduler=sched)
+        # live closed-loop traffic across the whole swap window
+        stop, errors = threading.Event(), []
+        def client():
+            i = 0
+            while not stop.is_set():
+                try:
+                    rt.predict("pca", queries[i % 3], timeout=300)
+                except Exception as e:
+                    errors.append(e)
+                    return
+                i += 1
+        t = threading.Thread(target=client)
+        t.start()
+        try:
+            # v2 re-fit through the scheduler as a preemptible tenant,
+            # handed straight to the swap path
+            v2 = sched.submit(
+                PCA(k=4), df, tenant="lifecycle", priority=-1,
+                aging_ms=600000.0,
+            ).result(300)
+            entry = lc.swap("pca", model=v2)
+            assert entry.version == 2, entry.version
+            time.sleep(0.3)
+        finally:
+            stop.set()
+            t.join(60)
+        assert not errors, f"typed shed under swap: {errors[0]!r}"
+        assert rt.registry.names() == ["pca"], rt.registry.names()
+        assert totals("serve_shed_total") == 0
+        assert totals("retrace_storms") == 0
+        # served output matches the v2 model exactly
+        direct = v2.transform(DataFrame({"features": queries[1]}))
+        out = rt.predict("pca", queries[1], timeout=300)
+        for col in out:
+            assert np.array_equal(out[col], np.asarray(direct[col])), col
+
+        # injected mid-swap fault: typed, counted, v2 untouched
+        os.environ["TPUML_FAULT_SPEC"] = "swap:warm:0:raise"
+        faults.reset_faults()
+        try:
+            lc.swap("pca", model=PCA(k=4).fit(df))
+            raise AssertionError("injected swap:warm fault did not surface")
+        except SwapError as e:
+            assert e.stage == "warm", e.stage
+        del os.environ["TPUML_FAULT_SPEC"]
+        faults.reset_faults()
+        assert rt.registry.get("pca").version == 2
+        assert not rt.registry.swaps_in_progress()
+        assert totals("swap_failures_total") == 1
+        rt.predict("pca", queries[0], timeout=300)  # still serving
+
+        # divergent canary (fitted on unrelated data — its projection
+        # basis disagrees): auto-rollback + version breaker opens
+        other = rng.normal(size=(512, 8)).astype(np.float32)
+        bad = PCA(k=4).fit(DataFrame({"features": other}))
+        lc.start_canary("pca", model=bad, fraction=1.0, min_requests=4)
+        for _ in range(8):
+            rt.predict("pca", queries[2], timeout=300)
+        deadline = time.monotonic() + 30
+        while lc.canary_in_progress("pca") and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not lc.canary_in_progress("pca"), "canary never settled"
+        assert rt.registry.get("pca").version == 2  # v2 kept serving
+        assert totals("canary_rollbacks_total") == 1
+        assert totals("canary_promotions_total") == 0
+        try:
+            lc.swap("pca", model=v2)
+            raise AssertionError("version breaker admitted a swap")
+        except LifecycleError:
+            pass
+        lc.drain(timeout=30)
+print("lifecycle chaos smoke OK: scheduled re-fit hot-swapped with zero "
+      "sheds, injected swap fault typed + rolled past, divergent canary "
+      "rolled back with breaker open")
+EOF
+
 echo "CI OK"

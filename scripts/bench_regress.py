@@ -151,6 +151,8 @@ _STATIC_FIELDS = (
     ("aggregate_goodput_qps", -1),        # fleet goodput collapse
     ("replica_scaling_efficiency", -1),   # router stopped spreading load
     ("fleet_p99_ms", +1),     # merged-reservoir fleet tail growth
+    ("swap_p99_delta_ms", +1),  # hot-swap tail disturbance growth
+    ("rollback_ms", +1),      # canary re-flip latency growth
 )
 
 _QPS_FIELD_RE = re.compile(r"^qps_sweep\[(.+)\]\.p99_ms$")

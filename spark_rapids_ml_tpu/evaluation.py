@@ -218,3 +218,36 @@ class BinaryClassificationEvaluator(Evaluator):
             recall = np.concatenate([[0.0], recall])
             return float(np.trapezoid(precision, recall))
         raise ValueError(f"Unsupported metric name, found {self.getMetricName()}")
+
+
+def prediction_agreement(live: np.ndarray, shadow: np.ndarray) -> float:
+    """Shadow-vs-live agreement score for canary evaluation
+    (``serving/lifecycle.py``): how well a candidate version's outputs
+    reproduce the currently-served version's on the SAME mirrored
+    requests, treating the live outputs as the label column.
+
+    Integral-valued outputs on both sides (class predictions, cluster
+    ids) score as ``MulticlassClassificationEvaluator`` accuracy;
+    anything continuous scores as ``RegressionEvaluator`` r2. Both are
+    larger-better with 1.0 = perfect agreement, so one
+    ``TPUML_CANARY_MIN_SCORE`` threshold covers every family. A
+    constant live column degenerates r2 — scored as exact-match
+    fraction instead (agreement against a constant is just equality).
+    """
+    y = np.asarray(live, dtype=np.float64).ravel()
+    p = np.asarray(shadow, dtype=np.float64).ravel()
+    if y.shape != p.shape:
+        raise ValueError(
+            f"live/shadow prediction shapes differ: {y.shape} vs {p.shape}"
+        )
+    if y.size == 0:
+        raise ValueError("prediction_agreement needs at least one pair")
+    df = DataFrame({"label": y, "prediction": p})
+    if np.array_equal(y, np.rint(y)) and np.array_equal(p, np.rint(p)):
+        return float(
+            MulticlassClassificationEvaluator(metricName="accuracy")
+            .evaluate(df)
+        )
+    if np.ptp(y) == 0.0:
+        return float(np.mean(y == p))
+    return float(RegressionEvaluator(metricName="r2").evaluate(df))

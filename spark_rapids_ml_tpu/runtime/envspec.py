@@ -579,6 +579,69 @@ SPEC: Dict[str, EnvVar] = _registry(
         minimum=0, category="serving",
         also_documented_in=("docs/serving.md",),
     ),
+    # --- continuous-training lifecycle (serving/lifecycle.py) -------------
+    EnvVar(
+        "TPUML_LIFECYCLE_REFRESH_MS", "float", 300000.0,
+        "Default period between `RefreshDriver` re-fit cycles in "
+        "milliseconds (5 minutes). Only read by an explicitly "
+        "constructed driver — no driver object means no refresh "
+        "thread, no scheduled fits, no metric series.",
+        exclusive_minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_LIFECYCLE_DRIFT_WINDOW", "int", 256,
+        "Served output rows accumulated per drift-scoring window: the "
+        "first full window freezes the reference histogram, every "
+        "later one scores a PSI observation into `serve_drift_score`. "
+        "Smaller windows detect faster but are noisier.",
+        minimum=16, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_LIFECYCLE_DRIFT_BINS", "int", 16,
+        "Histogram bins of the drift reference, placed at the first "
+        "window's quantiles (equal-mass, so every bin starts at "
+        "1/bins probability and the PSI epsilon floor is never the "
+        "signal).",
+        minimum=4, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_CANARY_FRACTION", "float", 0.125,
+        "Fraction of a canaried model's admitted traffic mirrored to "
+        "the candidate (deterministic request-counter picking, no "
+        "RNG). Callers always receive the live version's output; the "
+        "mirror only feeds scoring.",
+        exclusive_minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_CANARY_MIN_REQUESTS", "int", 32,
+        "Mirrored (live, shadow) pairs a canary must score before the "
+        "promote-or-rollback verdict; an SLO-burn alert rolls back "
+        "immediately without waiting for this count.",
+        minimum=1, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_CANARY_MIN_SCORE", "float", 0.99,
+        "Minimum shadow-vs-live agreement score (r2 for continuous "
+        "outputs, accuracy for integral labels — scored through "
+        "`evaluation.prediction_agreement`) for a canary to promote; "
+        "anything under rolls back and opens the version breaker.",
+        category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
+    EnvVar(
+        "TPUML_CANARY_COOLDOWN_MS", "float", 60000.0,
+        "How long a model's version breaker stays open after a canary "
+        "rollback: further swap/canary attempts for that name raise a "
+        "typed error until the cooldown passes (half-open then admits "
+        "one probe attempt).",
+        exclusive_minimum=0, category="serving",
+        also_documented_in=("docs/serving.md",),
+    ),
     # --- fit scheduler (docs/scheduler.md) --------------------------------
     EnvVar(
         "TPUML_SCHED_QUEUE_LIMIT", "int", None,

@@ -47,6 +47,10 @@ SITES = (
     # fit scheduler (hit per job submit / quantum yield / resumed
     # re-dispatch / job dispatch — see runtime/scheduler.py)
     "sched:admit", "sched:preempt", "sched:resume", "sched:dispatch",
+    # hot-swap lifecycle (hit before the staged ladder warmup / before
+    # the atomic routing flip — see serving/registry.py): a fault at
+    # either site must leave the prior version serving untouched
+    "swap:warm", "swap:flip",
 )
 ACTIONS = ("raise", "preempt", "oom")
 

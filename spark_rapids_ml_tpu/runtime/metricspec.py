@@ -419,6 +419,81 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "router; static per router lifetime. Compare with the healthy "
         "count in `/statusz`'s fleet section to see degraded capacity.",
     ),
+    # --- continuous-training lifecycle (serving/lifecycle.py, PR 18) ------
+    MetricSpec(
+        "swap_total", "counter",
+        "Completed zero-downtime hot-swaps (staged vN+1 warmed and "
+        "atomically routed, vN released), labeled by model. A swap only "
+        "counts here after the flip — failures land in "
+        "`swap_failures_total` instead.",
+        labels=("model",),
+    ),
+    MetricSpec(
+        "swap_failures_total", "counter",
+        "Hot-swaps that failed before completing, labeled by model and "
+        "the stage that died (`load` | `warm` | `flip`); every failure "
+        "is also a typed `SwapError` to the caller, and whatever the "
+        "stage the prior version keeps serving untouched.",
+        labels=("model", "stage"),
+    ),
+    MetricSpec(
+        "swap_duration_ms", "histogram",
+        "Wall time of a completed hot-swap in milliseconds (load + "
+        "staged ladder warmup + atomic flip), labeled by model — the "
+        "window during which the staged version doubles the model's "
+        "HBM residency.",
+        labels=("model",),
+    ),
+    MetricSpec(
+        "serve_model_version", "gauge",
+        "Registry version currently routed for a served model, labeled "
+        "by model; bumped by the atomic flip of a hot-swap or canary "
+        "promotion. Only recorded on lifecycle transitions — plain "
+        "register/serve paths never touch it (defaults-inert).",
+        labels=("model",),
+    ),
+    MetricSpec(
+        "canary_requests_total", "counter",
+        "Admitted live requests mirrored to a canary candidate, "
+        "labeled by the LIVE model name (the candidate's own traffic "
+        "shows under `serve_requests_total` at its alias). Callers "
+        "always receive the live version's output while this counts.",
+        labels=("model",),
+    ),
+    MetricSpec(
+        "canary_promotions_total", "counter",
+        "Canary candidates promoted to live after scoring at or above "
+        "`TPUML_CANARY_MIN_SCORE` over `TPUML_CANARY_MIN_REQUESTS` "
+        "mirrored pairs, labeled by model; the promotion reuses the "
+        "already-warmed shadow entry, so it is a pure atomic flip.",
+        labels=("model",),
+    ),
+    MetricSpec(
+        "canary_rollbacks_total", "counter",
+        "Canary candidates discarded with the prior version still "
+        "serving, labeled by model and reason (`score` | `slo_burn` | "
+        "`manual` | `shutdown`); each rollback opens the model's "
+        "version breaker for `TPUML_CANARY_COOLDOWN_MS`.",
+        labels=("model", "reason"),
+    ),
+    MetricSpec(
+        "serve_drift_score", "histogram",
+        "Prediction-distribution drift per scoring window: population "
+        "stability index (PSI) of the served primary output against "
+        "the model's frozen first-window reference, labeled by model. "
+        "Rule of thumb: < 0.1 stable, 0.1-0.25 drifting, > 0.25 "
+        "retrain; the `serving_drift` SLO budgets the worst ring p99.",
+        labels=("model",),
+    ),
+    MetricSpec(
+        "lifecycle_refresh_total", "counter",
+        "RefreshDriver re-fit cycles, labeled by model and outcome "
+        "(`swapped` | `canary` | `failed` | `skipped`): a completed "
+        "low-priority scheduled fit handed to the swap or canary path, "
+        "a fit/swap that raised, or a cycle skipped because a canary "
+        "was already in progress or the version breaker was open.",
+        labels=("model", "outcome"),
+    ),
 )
 
 

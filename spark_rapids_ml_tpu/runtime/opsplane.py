@@ -71,6 +71,7 @@ __all__ = [
     "track_registry",
     "track_runtime",
     "track_router",
+    "track_lifecycle",
     "flight_recorder",
     "slo_status",
     "FlightRecorder",
@@ -91,6 +92,7 @@ _REGISTRIES: List["weakref.ref[Any]"] = []
 _RUNTIMES: List["weakref.ref[Any]"] = []
 _SCHEDULERS: List["weakref.ref[Any]"] = []
 _ROUTERS: List["weakref.ref[Any]"] = []
+_LIFECYCLES: List["weakref.ref[Any]"] = []
 
 
 def _active() -> bool:
@@ -336,6 +338,19 @@ def track_router(router: Any) -> None:
         _ROUTERS.append(weakref.ref(router))
 
 
+def track_lifecycle(lifecycle: Any) -> None:
+    """Weakly track a ModelLifecycle: /statusz gains the lifecycle
+    section (canaries, drift, version breakers, refreshers), /readyz
+    reports 503 with a ``swap_in_progress`` reason while a hot-swap's
+    warmup is incomplete, and the SIGTERM handler drains lifecycles
+    FIRST — refresh drivers halt and canaries roll back before the
+    router/runtime/scheduler drains, so no half-evaluated candidate
+    can promote into a dying process."""
+    with _LOCK:
+        _prune(_LIFECYCLES)
+        _LIFECYCLES.append(weakref.ref(lifecycle))
+
+
 def _fleet_snapshot() -> Dict[str, Any]:
     """The snapshot SLO evaluation and /statusz quantile tables read:
     the local process's metrics, merged (reservoirs pooled) with every
@@ -386,6 +401,15 @@ def _readiness() -> Tuple[bool, List[str]]:
     if storms:
         reasons.append(f"retrace_storms={int(storms)}")
     for reg in _live(_REGISTRIES):
+        try:
+            swapping = reg.swaps_in_progress()
+        except Exception:
+            swapping = {}
+        if swapping:
+            # a flip whose warmup is incomplete: the prior version is
+            # still serving, but rolling-update orchestration must not
+            # advance to the next pod until the flip lands
+            reasons.append(f"swap_in_progress={json.dumps(swapping)}")
         try:
             ws = reg.warmup_state()
         except Exception:
@@ -603,6 +627,12 @@ def _statusz() -> Dict[str, Any]:
         ): s.get("value")
         for s in _series("router_shed_total")
     }
+    lifecycle: List[Dict[str, Any]] = []
+    for lc in _live(_LIFECYCLES):
+        try:
+            lifecycle.append(lc.status())
+        except Exception as exc:
+            lifecycle.append({"error": str(exc)})
     ready, reasons = _readiness()
     rec = _RECORDER
     return {
@@ -617,6 +647,7 @@ def _statusz() -> Dict[str, Any]:
         "serving": serving,
         "fleet": {"routers": fleet, "router_shed_total": router_sheds},
         "scheduler": scheduler,
+        "lifecycle": lifecycle,
         "heartbeat_ages_s": heartbeats,
         "ingest_ring_occupancy": _scalar("ingest_ring_occupancy"),
         "gang": gang,
@@ -721,10 +752,19 @@ def _atexit_dump() -> None:
 
 
 def _on_sigterm(signum: int, frame: Any) -> None:
-    # graceful serving drain FIRST (admission stops, /readyz flips 503,
-    # in-flight work flushes, every future resolves typed) so the
-    # flight dump below captures the post-drain state; bounded — a
-    # wedged dispatcher cannot stall process death past the timeout
+    # lifecycle drivers drain FIRST: refresh threads halt (no new fits
+    # land in a scheduler about to drain) and in-flight canaries roll
+    # back typed (reason="shutdown") before serving admission stops —
+    # a half-evaluated candidate must never promote into a dying
+    # process; then the graceful serving drain (admission stops,
+    # /readyz flips 503, in-flight work flushes, every future resolves
+    # typed) so the flight dump below captures the post-drain state;
+    # bounded — a wedged dispatcher cannot stall death past the timeout
+    for lc in _live(_LIFECYCLES):
+        try:
+            lc.drain(timeout=SIGTERM_DRAIN_TIMEOUT_S)
+        except Exception:
+            pass
     for router in _live(_ROUTERS):
         try:
             router.drain(timeout=SIGTERM_DRAIN_TIMEOUT_S)
@@ -851,6 +891,7 @@ def stop() -> None:
         _RUNTIMES.clear()
         _SCHEDULERS.clear()
         _ROUTERS.clear()
+        _LIFECYCLES.clear()
     if server is not None:
         try:
             server.shutdown()

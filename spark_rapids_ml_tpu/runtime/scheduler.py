@@ -155,6 +155,8 @@ class _Job:
     service_key: str
     t_submit: float = field(default_factory=time.perf_counter)
     deadline: Optional[float] = None  # absolute perf_counter seconds
+    aging_s: Optional[float] = None  # per-job override of the
+    # scheduler-wide aging horizon (background refits age slower)
     resumed: bool = False
     preempt_count: int = 0
     settled: bool = False
@@ -164,6 +166,8 @@ class _Job:
         # aging_s after submit, so it can be overtaken but not starved
         if self.deadline is not None:
             return self.deadline
+        if self.aging_s is not None:
+            aging_s = self.aging_s
         return self.t_submit + aging_s
 
 
@@ -343,6 +347,7 @@ class FitScheduler:
         tenant: str = "default",
         priority: int = 0,
         deadline_ms: Optional[float] = None,
+        aging_ms: Optional[float] = None,
     ) -> "Future[Any]":
         """Enqueue one fit; the future resolves to the fitted model
         (what ``estimator.fit(dataset)`` would return) or raises the
@@ -353,7 +358,11 @@ class FitScheduler:
         with :class:`Overloaded` when the EWMA fit-time estimate says
         the deadline is unmeetable, and an admitted job whose deadline
         passes before dispatch fails with :class:`DeadlineExceeded`.
-        Higher ``priority`` wins ties between equally-due jobs."""
+        Higher ``priority`` wins ties between equally-due jobs.
+        ``aging_ms`` overrides ``TPUML_SCHED_AGING_MS`` for this job
+        only — background work (lifecycle refresh re-fits) passes a
+        long horizon so it ages toward the EDF front slower than
+        interactive fits but still cannot starve."""
         if self._closed:
             raise ShuttingDown("FitScheduler is closed")
         self.start()
@@ -412,6 +421,7 @@ class FitScheduler:
                 service_key=service_key,
                 t_submit=now,
                 deadline=None if deadline_s is None else now + deadline_s,
+                aging_s=None if aging_ms is None else float(aging_ms) / 1e3,
             )
             self._pending += 1
             self._backlog.append(job)

@@ -193,6 +193,35 @@ CATALOG: Tuple[SLOSpec, ...] = _catalog(
             "chaos testing (TPUML_FAULT_*), so a 10% tick budget "
             "alerts only on a sustained fault storm.",
     ),
+    SLOSpec(
+        name="serving_drift",
+        metric="serve_drift_score",
+        measure="p99",
+        objective=0.25,
+        sense="max",
+        error_budget=0.05,
+        doc="Prediction-distribution drift budget: the per-window PSI "
+            "of served outputs against each model's frozen reference "
+            "stays under 0.25 (the classic 'retrain' threshold) for "
+            "the worst labeled model, budgeted at 5% of ticks — a "
+            "sustained breach means the world moved and the "
+            "RefreshDriver cadence (or the model) is stale.",
+    ),
+    SLOSpec(
+        name="canary_rollback_rate",
+        metric="canary_rollbacks_total",
+        measure="window_delta",
+        objective=0.0,
+        sense="max",
+        error_budget=0.10,
+        doc="Canary rollback budget: a rollback is the lifecycle "
+            "working as designed (a bad candidate was caught before "
+            "promotion), so single-tick rollbacks are tolerated — "
+            "sustained rollbacks (>= 10% of ticks seeing new "
+            "`canary_rollbacks_total` increments across both burn "
+            "windows) mean the refresh pipeline is producing "
+            "regressing models and should be halted.",
+    ),
 )
 
 BY_NAME: Dict[str, SLOSpec] = {s.name: s for s in CATALOG}

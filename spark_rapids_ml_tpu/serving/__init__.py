@@ -21,9 +21,12 @@ from .admission import (
     ServingError,
     ShuttingDown,
 )
+from .lifecycle import LifecycleError, ModelLifecycle, RefreshDriver
 from .registry import (
     ModelRegistry,
+    ModelReloadError,
     ResidentModel,
+    SwapError,
     feature_width,
     resident_nbytes,
     serving_family,
@@ -35,16 +38,21 @@ __all__ = [
     "AdmissionController",
     "CircuitBreaker",
     "DeadlineExceeded",
+    "LifecycleError",
     "LoopbackReplica",
+    "ModelLifecycle",
     "ModelRegistry",
+    "ModelReloadError",
     "Overloaded",
     "POLICIES",
+    "RefreshDriver",
     "ResidentModel",
     "Router",
     "ServingError",
     "ServingRuntime",
     "ShuttingDown",
     "SubprocessReplica",
+    "SwapError",
     "feature_width",
     "resident_nbytes",
     "serving_family",

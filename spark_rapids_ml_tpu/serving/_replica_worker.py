@@ -8,7 +8,9 @@ for the protocol before anything heavyweight imports, and fd 1 is
 re-pointed at stderr so stray prints (jax warnings, model logging)
 can never corrupt a frame.
 
-Ops: ``load`` (persist-path replication), ``predict`` (replied when
+Ops: ``load`` (persist-path replication), ``swap`` (versioned hot-swap
+from a persisted path — this rank's leg of the router's rolling fleet
+swap), ``predict`` (replied when
 the runtime's future resolves — requests pipeline, replies are
 out-of-order by design), ``queue_depth``, ``warmup_state``,
 ``metrics`` (this process's ``telemetry.metrics_snapshot``, merged
@@ -98,10 +100,15 @@ def main() -> int:
 
                 fut.add_done_callback(_done)
                 continue  # replied when the dispatch resolves
-            if op == "load":
-                entry = rt.load(msg["name"], msg["path"])
+            if op in ("load", "swap"):
+                entry = (
+                    rt.load(msg["name"], msg["path"])
+                    if op == "load"
+                    else rt.swap(msg["name"], path=msg["path"])
+                )
                 value: Any = {
                     "name": entry.name,
+                    "version": entry.version,
                     "family": entry.family,
                     "engine": entry.engine,
                     "coalesce": entry.coalesce,

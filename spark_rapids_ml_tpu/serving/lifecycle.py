@@ -1,0 +1,818 @@
+"""Continuous-training lifecycle: the layer closing train→deploy→monitor.
+
+Joins the fit plane (``runtime/scheduler.py``) to the serving plane
+(``serving/runtime.py``) so a served model can be refreshed without a
+process restart, and watches what serves so staleness is measured, not
+assumed:
+
+- **Versioned hot-swap** — :meth:`ModelLifecycle.swap` stages vN+1
+  beside the live vN (spare HBM), warms its full bucket ladder under
+  warmup-flagged spans, then flips routing atomically and releases vN.
+  Zero typed sheds, ``retrace_storms == 0``, and a fault at any stage
+  (the ``swap:warm``/``swap:flip`` injection sites) leaves exactly one
+  consistent version serving: the old one.
+- **Shadow canary with auto-rollback** — :meth:`start_canary` registers
+  the candidate fully warmed under an alias and mirrors a deterministic
+  traffic fraction to it; callers keep receiving the live version's
+  (bit-identical) outputs while mirrored pairs score through
+  :func:`evaluation.prediction_agreement`. At
+  ``TPUML_CANARY_MIN_REQUESTS`` pairs the verdict is automatic:
+  promote (an atomic flip of the already-warmed entry) at or above
+  ``TPUML_CANARY_MIN_SCORE``, roll back under it — and a NEW SLO-burn
+  alert (the PR-12 multi-window burn machinery) rolls back immediately
+  without waiting for the count. Every rollback opens the model's
+  *version breaker*: further swap/canary attempts raise a typed
+  :class:`LifecycleError` until ``TPUML_CANARY_COOLDOWN_MS`` passes.
+- **Refresh driver** — :class:`RefreshDriver` periodically re-fits
+  through the scheduler as a low-priority, preemptible, slow-aging
+  tenant and hands each completed fit to the swap (or canary) path.
+- **Drift gauges** — :meth:`watch_drift` observes served outputs
+  through the runtime's result-observer hook and scores each window's
+  population stability index (PSI) against a frozen first-window
+  reference into ``serve_drift_score`` (the ``serving_drift`` SLO
+  budgets its p99); surfaced on ``/statusz``.
+
+Defaults stay inert (the house contract): constructing nothing here
+means no thread, no shadow route, no observer, and no new metric
+series — the serving fast path is untouched.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set
+
+import numpy as np
+
+from ..runtime import envspec, opsplane, telemetry
+from ..runtime.admission import CLOSED, CircuitBreaker
+from .registry import ResidentModel
+from .runtime import ServingRuntime
+
+__all__ = ["LifecycleError", "ModelLifecycle", "RefreshDriver"]
+
+_LOGGER = logging.getLogger("spark_rapids_ml_tpu.serving.lifecycle")
+
+_ROLLBACK_REASONS = ("score", "slo_burn", "manual", "shutdown")
+
+# PSI smoothing floor: keeps empty bins from blowing the log while
+# staying far below the 0.1 "drifting" rule-of-thumb threshold
+_PSI_EPS = 1e-6
+
+
+class LifecycleError(RuntimeError):
+    """Typed lifecycle rejection: a canary already in progress, a
+    version breaker still open after a rollback, or an operation the
+    configured target cannot support. Never raised for load — the
+    admission planes own those types."""
+
+
+def _primary_column(host: Dict[str, Any]) -> Optional[str]:
+    """The output column lifecycle scoring keys on: ``prediction``
+    when present (every supervised family emits it), else the first
+    column in sorted order (deterministic for pca/umap embeddings)."""
+    if "prediction" in host:
+        return "prediction"
+    cols = sorted(host)
+    return cols[0] if cols else None
+
+
+@dataclass
+class _Canary:
+    """One in-flight shadow evaluation of a candidate version."""
+
+    name: str
+    alias: str
+    version: int
+    min_requests: int
+    min_score: float
+    burn_baseline: frozenset
+    t_start: float = field(default_factory=time.perf_counter)
+    live_vals: List[np.ndarray] = field(default_factory=list)
+    shadow_vals: List[np.ndarray] = field(default_factory=list)
+    pairs: int = 0
+    score: Optional[float] = None
+    scored: bool = False
+    done: bool = False
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+@dataclass
+class _DriftState:
+    """Windowed PSI accumulator for one watched model."""
+
+    window: int
+    bins: int
+    column: Optional[str] = None
+    buf: List[np.ndarray] = field(default_factory=list)
+    buffered: int = 0
+    edges: Optional[np.ndarray] = None
+    reference: Optional[np.ndarray] = None
+    windows_scored: int = 0
+    last_psi: Optional[float] = None
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+def _hist_probs(vals: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    counts, _ = np.histogram(vals, bins=edges)
+    return counts.astype(np.float64) / max(1, vals.size)
+
+
+def _psi(reference: np.ndarray, observed: np.ndarray) -> float:
+    """Population stability index of ``observed`` bin probabilities
+    against ``reference`` ones: ``sum((q - p) * ln(q / p))`` with an
+    epsilon floor so empty bins stay finite. Always >= 0; ~0.1 is the
+    classic 'drifting' threshold, ~0.25 'retrain'."""
+    p = reference + _PSI_EPS
+    q = observed + _PSI_EPS
+    p = p / p.sum()
+    q = q / q.sum()
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+class ModelLifecycle:
+    """Lifecycle driver over a :class:`ServingRuntime` (full surface)
+    or a :class:`serving.Router` (fleet-wide :meth:`swap` fan-out;
+    canary/drift need a single runtime's mirror and observer hooks).
+
+    Explicit-construction only — building this object is the opt-in;
+    it starts no thread by itself (only :meth:`add_refresh` does) and
+    records no metric until a lifecycle action runs.
+    """
+
+    def __init__(
+        self,
+        target: Any,
+        scheduler: Any = None,
+        *,
+        canary_fraction: Optional[float] = None,
+        canary_min_requests: Optional[int] = None,
+        canary_min_score: Optional[float] = None,
+        canary_cooldown_ms: Optional[float] = None,
+        drift_window: Optional[int] = None,
+        drift_bins: Optional[int] = None,
+        burn_probe: Optional[Callable[[], Any]] = None,
+    ) -> None:
+        self._target = target
+        self._runtime: Optional[ServingRuntime] = (
+            target if isinstance(target, ServingRuntime) else None
+        )
+        self.scheduler = scheduler
+        self._fraction = float(
+            envspec.get("TPUML_CANARY_FRACTION")
+            if canary_fraction is None else canary_fraction
+        )
+        self._min_requests = int(
+            envspec.get("TPUML_CANARY_MIN_REQUESTS")
+            if canary_min_requests is None else canary_min_requests
+        )
+        self._min_score = float(
+            envspec.get("TPUML_CANARY_MIN_SCORE")
+            if canary_min_score is None else canary_min_score
+        )
+        self._cooldown_s = float(
+            envspec.get("TPUML_CANARY_COOLDOWN_MS")
+            if canary_cooldown_ms is None else canary_cooldown_ms
+        ) / 1e3
+        self._drift_window = int(
+            envspec.get("TPUML_LIFECYCLE_DRIFT_WINDOW")
+            if drift_window is None else drift_window
+        )
+        self._drift_bins = int(
+            envspec.get("TPUML_LIFECYCLE_DRIFT_BINS")
+            if drift_bins is None else drift_bins
+        )
+        # SLO-burn tripwire: names of currently-alerting SLOs. The
+        # default reads the live ops plane; tests inject their own.
+        self._burn_probe = burn_probe
+        self._lock = threading.RLock()
+        self._canaries: Dict[str, _Canary] = {}
+        self._drift: Dict[str, _DriftState] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._refreshers: List["RefreshDriver"] = []
+        self._observer_installed = False
+        self._closed = False
+        # weakref-tracked: /statusz gets a lifecycle section and the
+        # SIGTERM chain drains lifecycles before routers/runtimes
+        opsplane.track_lifecycle(self)
+
+    # -- introspection -----------------------------------------------------
+    def is_closed(self) -> bool:
+        return self._closed
+
+    def swap_in_progress(self) -> bool:
+        """True while a hot-swap is staging (load/warm/flip window) —
+        the `/readyz` 503 ``swap_in_progress`` signal."""
+        if self._runtime is None:
+            return False
+        return bool(self._runtime.registry.swaps_in_progress())
+
+    def canary_in_progress(self, name: str) -> bool:
+        with self._lock:
+            return name in self._canaries
+
+    def refreshers_alive(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._refreshers if r.is_alive())
+
+    def status(self) -> Dict[str, Any]:
+        """The `/statusz` lifecycle section."""
+        with self._lock:
+            canaries = {
+                name: {
+                    "alias": st.alias,
+                    "version": st.version,
+                    "pairs": st.pairs,
+                    "min_requests": st.min_requests,
+                    "score": st.score,
+                    "age_s": round(time.perf_counter() - st.t_start, 3),
+                }
+                for name, st in self._canaries.items()
+            }
+            drift = {
+                name: {
+                    "windows_scored": st.windows_scored,
+                    "last_psi": (
+                        None if st.last_psi is None
+                        else round(st.last_psi, 6)
+                    ),
+                    "reference_ready": st.reference is not None,
+                    "window": st.window,
+                }
+                for name, st in self._drift.items()
+            }
+            breakers = {
+                name: br.state_name()
+                for name, br in self._breakers.items()
+                if br.state() != CLOSED
+            }
+            refreshers = [r.status() for r in self._refreshers]
+        out: Dict[str, Any] = {
+            "closed": self._closed,
+            "canaries": canaries,
+            "drift": drift,
+            "version_breakers": breakers,
+            "refreshers": refreshers,
+        }
+        if self._runtime is not None:
+            out["swaps_in_progress"] = (
+                self._runtime.registry.swaps_in_progress()
+            )
+        return out
+
+    # -- hot-swap ----------------------------------------------------------
+    def swap(
+        self, name: str, model: Any = None, path: Optional[str] = None,
+    ) -> Any:
+        """Zero-downtime version flip of ``name`` (see
+        :meth:`ModelRegistry.swap`). Against a router target the swap
+        fans out fleet-wide (``path`` required — every replica loads
+        the same persisted version). Refused with a typed
+        :class:`LifecycleError` while the model's version breaker is
+        open after a canary rollback."""
+        self._check_open("swap")
+        self._check_breaker(name, "swap")
+        if self._runtime is not None:
+            return self._runtime.swap(name, model=model, path=path)
+        if path is None:
+            raise LifecycleError(
+                "fleet-wide swap through a Router needs a persisted "
+                "path — every replica loads the same version"
+            )
+        return self._target.swap(name, path)
+
+    # -- shadow canary -----------------------------------------------------
+    def start_canary(
+        self,
+        name: str,
+        model: Any = None,
+        path: Optional[str] = None,
+        *,
+        fraction: Optional[float] = None,
+        min_requests: Optional[int] = None,
+        min_score: Optional[float] = None,
+    ) -> str:
+        """Stage a candidate version of ``name`` as a fully-warmed
+        shadow entry and start mirroring a deterministic traffic
+        fraction to it. Returns the shadow alias (``<name>@v<N+1>``).
+        Callers keep receiving the live version's outputs until
+        :meth:`promote` flips routing; the verdict is automatic once
+        enough mirrored pairs score (or an SLO burn fires first)."""
+        if self._runtime is None:
+            raise LifecycleError(
+                "canary needs a ServingRuntime target: the shadow "
+                "mirror and pair scoring live in one runtime's "
+                "dispatcher (fleet-wide canary is not supported)"
+            )
+        self._check_open("start_canary")
+        self._check_breaker(name, "canary")
+        with self._lock:
+            if name in self._canaries:
+                raise LifecycleError(
+                    f"a canary for {name!r} is already in progress "
+                    f"({self._canaries[name].alias})"
+                )
+        live = self._runtime.registry.get(name)
+        version = live.version + 1
+        alias = f"{name}@v{version}"
+        # stage the candidate under the alias: full probe + ladder
+        # warmup now, so promotion later is a pure atomic flip
+        if model is not None:
+            self._runtime.registry.register(alias, model)
+        elif path is not None:
+            self._runtime.registry.load(alias, path)
+        else:
+            raise ValueError("start_canary needs a model or a path")
+        state = _Canary(
+            name=name,
+            alias=alias,
+            version=version,
+            min_requests=(
+                self._min_requests if min_requests is None
+                else int(min_requests)
+            ),
+            min_score=(
+                self._min_score if min_score is None else float(min_score)
+            ),
+            burn_baseline=frozenset(self._alerting_slos()),
+        )
+        with self._lock:
+            self._canaries[name] = state
+        self._runtime.set_shadow(
+            name,
+            alias,
+            self._fraction if fraction is None else float(fraction),
+            on_pair=lambda live_out, shadow_out, st=state: self._on_pair(
+                st, live_out, shadow_out
+            ),
+        )
+        _LOGGER.info(
+            "lifecycle: canary %s -> %s started (verdict at %d pairs, "
+            "min score %.4f)",
+            name, alias, state.min_requests, state.min_score,
+        )
+        return alias
+
+    def promote(self, name: str) -> ResidentModel:
+        """Flip ``name`` to its canary candidate: the alias entry is
+        already probed and warmed, so this is one atomic registry move
+        — no cold dispatch, no shed, no new compile."""
+        state = self._take_canary(name)
+        if state is None:
+            raise LifecycleError(f"no canary in progress for {name!r}")
+        self._runtime.clear_shadow(name)
+        entry = self._runtime.registry.promote_alias(state.alias, name)
+        telemetry.counter("canary_promotions_total").inc(1, model=name)
+        self._breaker(name).record_success()
+        _LOGGER.info(
+            "lifecycle: promoted %s -> %s v%d (score=%s over %d pairs)",
+            state.alias, name, entry.version, state.score, state.pairs,
+        )
+        return entry
+
+    def rollback(self, name: str, reason: str = "manual") -> None:
+        """Discard ``name``'s canary candidate with the live version
+        untouched (it never stopped serving — the candidate only saw
+        mirrored traffic) and open the version breaker so an immediate
+        retry of the same refresh is refused typed."""
+        if reason not in _ROLLBACK_REASONS:
+            raise ValueError(
+                f"rollback reason must be one of {_ROLLBACK_REASONS}, "
+                f"got {reason!r}"
+            )
+        state = self._take_canary(name)
+        if state is None:
+            raise LifecycleError(f"no canary in progress for {name!r}")
+        self._runtime.clear_shadow(name)
+        try:
+            self._runtime.registry.evict(state.alias)
+        except Exception:  # already evicted (LRU raced us): fine
+            _LOGGER.debug("lifecycle: %s already gone", state.alias)
+        self._breaker(name).record_failure()
+        telemetry.counter("canary_rollbacks_total").inc(
+            1, model=name, reason=reason
+        )
+        _LOGGER.warning(
+            "lifecycle: rolled back canary %s of %s (reason=%s score=%s "
+            "pairs=%d); version breaker open for %.0f ms",
+            state.alias, name, reason, state.score, state.pairs,
+            self._cooldown_s * 1e3,
+        )
+
+    def _take_canary(self, name: str) -> Optional[_Canary]:
+        with self._lock:
+            state = self._canaries.pop(name, None)
+        if state is not None:
+            state.done = True
+        return state
+
+    def _on_pair(
+        self,
+        state: _Canary,
+        live_out: Optional[Dict[str, np.ndarray]],
+        shadow_out: Optional[Dict[str, np.ndarray]],
+    ) -> None:
+        """One mirrored request resolved on both sides (dispatcher
+        thread). Accumulate the pair, check the SLO-burn tripwire, and
+        render the verdict at the configured pair count."""
+        if state.done or self._closed:
+            return
+        burning = self._alerting_slos() - set(state.burn_baseline)
+        if burning:
+            try:
+                self.rollback(state.name, reason="slo_burn")
+            except LifecycleError:  # verdict raced us
+                pass
+            else:
+                _LOGGER.warning(
+                    "lifecycle: SLO burn tripwire fired for %s: %s",
+                    state.name, sorted(burning),
+                )
+            return
+        if live_out is None or shadow_out is None:
+            return  # a failed half never scores; live errors are the
+            # serving plane's problem, not agreement evidence
+        col = _primary_column(live_out)
+        if col is None or col not in shadow_out:
+            return
+        with state.lock:
+            if state.scored:
+                return
+            state.live_vals.append(
+                np.asarray(live_out[col], dtype=np.float64).ravel()
+            )
+            state.shadow_vals.append(
+                np.asarray(shadow_out[col], dtype=np.float64).ravel()
+            )
+            state.pairs += 1
+            if state.pairs < state.min_requests:
+                return
+            state.scored = True
+            live_cat = np.concatenate(state.live_vals)
+            shadow_cat = np.concatenate(state.shadow_vals)
+        from ..evaluation import prediction_agreement
+
+        try:
+            score = prediction_agreement(live_cat, shadow_cat)
+        except Exception:
+            _LOGGER.exception(
+                "lifecycle: canary scoring failed for %s — rolling back",
+                state.name,
+            )
+            score = float("-inf")
+        state.score = None if score == float("-inf") else score
+        try:
+            if score >= state.min_score:
+                self.promote(state.name)
+            else:
+                self.rollback(state.name, reason="score")
+        except LifecycleError:  # burn tripwire or manual call raced us
+            pass
+
+    def _breaker(self, name: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(name)
+            if br is None:
+                # one rollback opens it (fails=1): the point is a typed
+                # refusal of the SAME bad refresh, not failure counting
+                br = CircuitBreaker(
+                    f"version:{name}", fails=1, cooldown_s=self._cooldown_s
+                )
+                self._breakers[name] = br
+            return br
+
+    def _check_breaker(self, name: str, what: str) -> None:
+        if not self._breaker(name).allow():
+            raise LifecycleError(
+                f"version breaker for {name!r} is open after a canary "
+                f"rollback; {what} refused until the "
+                f"{self._cooldown_s * 1e3:.0f} ms cooldown passes"
+            )
+
+    def _check_open(self, what: str) -> None:
+        if self._closed:
+            raise LifecycleError(f"lifecycle is closed; {what} refused")
+
+    def _alerting_slos(self) -> Set[str]:
+        if self._burn_probe is not None:
+            try:
+                return set(self._burn_probe())
+            except Exception:
+                return set()
+        try:
+            status = opsplane.slo_status()
+        except Exception:
+            return set()
+        return {
+            name
+            for name, st in (status or {}).items()
+            if isinstance(st, dict) and st.get("alerting")
+        }
+
+    # -- drift gauges ------------------------------------------------------
+    def watch_drift(
+        self,
+        name: str,
+        column: Optional[str] = None,
+        window: Optional[int] = None,
+        bins: Optional[int] = None,
+    ) -> None:
+        """Score ``name``'s served output distribution per window into
+        ``serve_drift_score{model}``: the first full window freezes a
+        quantile-binned reference histogram, every later window scores
+        its PSI against it. Installs the (single, shared) runtime
+        result observer on first watch."""
+        if self._runtime is None:
+            raise LifecycleError(
+                "drift gauges need a ServingRuntime target (the result "
+                "observer hook lives in the dispatcher)"
+            )
+        st = _DriftState(
+            window=self._drift_window if window is None else int(window),
+            bins=self._drift_bins if bins is None else int(bins),
+            column=column,
+        )
+        with self._lock:
+            self._drift[name] = st
+            if not self._observer_installed:
+                self._runtime.add_result_observer(self._observe_result)
+                self._observer_installed = True
+
+    def unwatch_drift(self, name: str) -> None:
+        with self._lock:
+            self._drift.pop(name, None)
+
+    def drift_state(self, name: str) -> Optional[Dict[str, Any]]:
+        st = self._drift.get(name)
+        if st is None:
+            return None
+        with st.lock:
+            return {
+                "windows_scored": st.windows_scored,
+                "last_psi": st.last_psi,
+                "reference_ready": st.reference is not None,
+            }
+
+    def _observe_result(
+        self, entry: ResidentModel, host: Dict[str, np.ndarray]
+    ) -> None:
+        # dispatcher thread, after every successful group dispatch;
+        # canary aliases are invisible here (keyed by exact live name)
+        st = self._drift.get(entry.name)
+        if st is None:
+            return
+        col = st.column or _primary_column(host)
+        if col is None or col not in host:
+            return
+        vals = np.asarray(host[col], dtype=np.float64).ravel()
+        psi: Optional[float] = None
+        with st.lock:
+            st.buf.append(vals)
+            st.buffered += int(vals.size)
+            if st.buffered < st.window:
+                return
+            data = np.concatenate(st.buf)
+            window_vals, rest = data[: st.window], data[st.window:]
+            st.buf = [rest] if rest.size else []
+            st.buffered = int(rest.size)
+            if st.reference is None:
+                # freeze the reference at the first full window:
+                # equal-mass quantile bins, open-ended edges so later
+                # windows can land outside the observed range
+                interior = np.unique(
+                    np.quantile(
+                        window_vals, np.linspace(0.0, 1.0, st.bins + 1)
+                    )[1:-1]
+                )
+                st.edges = np.concatenate(
+                    [[-np.inf], interior, [np.inf]]
+                )
+                st.reference = _hist_probs(window_vals, st.edges)
+                return
+            psi = _psi(
+                st.reference, _hist_probs(window_vals, st.edges)
+            )
+            st.windows_scored += 1
+            st.last_psi = psi
+        telemetry.histogram("serve_drift_score").observe(
+            psi, model=entry.name
+        )
+
+    # -- refresh driver ----------------------------------------------------
+    def add_refresh(
+        self,
+        name: str,
+        estimator_factory: Callable[[], Any],
+        dataset: Any,
+        **kwargs: Any,
+    ) -> "RefreshDriver":
+        """Attach and start a :class:`RefreshDriver` re-fitting
+        ``name`` periodically (``TPUML_LIFECYCLE_REFRESH_MS``) through
+        this lifecycle's scheduler. Keyword arguments pass through to
+        the driver constructor."""
+        self._check_open("add_refresh")
+        driver = RefreshDriver(
+            self, name, estimator_factory, dataset,
+            scheduler=kwargs.pop("scheduler", self.scheduler), **kwargs,
+        )
+        with self._lock:
+            self._refreshers.append(driver)
+        driver.start()
+        return driver
+
+    # -- shutdown ----------------------------------------------------------
+    def drain(self, timeout: float = 30.0) -> Dict[str, Any]:
+        """Graceful stop, FIRST in the SIGTERM chain (before router /
+        runtime / scheduler drains): halt refresh drivers so no new
+        fits land in a draining scheduler, roll back in-flight canaries
+        (``reason="shutdown"``) so no half-evaluated candidate can
+        promote, and detach the drift observer."""
+        with self._lock:
+            if self._closed:
+                return {
+                    "drained": True, "rolled_back": 0,
+                    "refreshers": len(self._refreshers),
+                }
+            self._closed = True
+            refreshers = list(self._refreshers)
+            names = list(self._canaries)
+        for r in refreshers:
+            r.halt()
+        rolled = 0
+        for name in names:
+            try:
+                self.rollback(name, reason="shutdown")
+                rolled += 1
+            except LifecycleError:  # verdict landed while we drained
+                pass
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        for r in refreshers:
+            r.join(max(0.1, deadline - time.monotonic()))
+        if self._observer_installed and self._runtime is not None:
+            self._runtime.remove_result_observer(self._observe_result)
+            self._observer_installed = False
+        return {
+            "drained": all(not r.is_alive() for r in refreshers),
+            "rolled_back": rolled,
+            "refreshers": len(refreshers),
+        }
+
+    def close(self) -> None:
+        self.drain(timeout=5.0)
+
+    def __enter__(self) -> "ModelLifecycle":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+class RefreshDriver:
+    """Periodic re-fit loop for one served model.
+
+    Each cycle builds a fresh estimator (``estimator_factory()``), fits
+    it — through the :class:`FitScheduler` as a low-priority,
+    preemptible, slow-aging tenant when one is attached, else inline —
+    and hands the model to the lifecycle swap path (or
+    :meth:`ModelLifecycle.start_canary` with ``canary=True``). Cycle
+    outcomes are counted under ``lifecycle_refresh_total{model,
+    outcome}``; a cycle refused by a version breaker or an in-flight
+    canary counts ``skipped`` and retries next period.
+
+    The thread only exists once :meth:`start` runs (``ModelLifecycle.
+    add_refresh`` calls it); ``daemon=True`` so a forgotten driver
+    never blocks interpreter exit — :meth:`halt` + :meth:`join` is the
+    clean path.
+    """
+
+    def __init__(
+        self,
+        lifecycle: ModelLifecycle,
+        name: str,
+        estimator_factory: Callable[[], Any],
+        dataset: Any,
+        *,
+        period_ms: Optional[float] = None,
+        scheduler: Any = None,
+        tenant: str = "lifecycle-refresh",
+        priority: int = -1,
+        aging_ms: Optional[float] = None,
+        fit_timeout_s: float = 600.0,
+        canary: bool = False,
+        max_refreshes: Optional[int] = None,
+    ) -> None:
+        self.lifecycle = lifecycle
+        self.name = name
+        self._factory = estimator_factory
+        self._dataset = dataset
+        self._period_s = float(
+            envspec.get("TPUML_LIFECYCLE_REFRESH_MS")
+            if period_ms is None else period_ms
+        ) / 1e3
+        self._scheduler = scheduler
+        self._tenant = tenant
+        self._priority = int(priority)
+        # refits are background work: age toward the EDF front 10x
+        # slower than interactive fits unless told otherwise
+        self._aging_ms = aging_ms
+        self._fit_timeout_s = float(fit_timeout_s)
+        self._canary = bool(canary)
+        self._max_refreshes = max_refreshes
+        self.refreshes = 0
+        self.outcomes: Dict[str, int] = {}
+        self._halt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- thread lifecycle --------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=telemetry.bind_context(self._run),
+            name=f"tpuml-lifecycle-refresh-{self.name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def halt(self) -> None:
+        self._halt.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def is_alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "model": self.name,
+            "alive": self.is_alive(),
+            "period_ms": round(self._period_s * 1e3, 1),
+            "refreshes": self.refreshes,
+            "outcomes": dict(self.outcomes),
+            "mode": "canary" if self._canary else "swap",
+        }
+
+    def _run(self) -> None:
+        while not self._halt.wait(self._period_s):
+            if self.lifecycle.is_closed():
+                return
+            self.refresh_now()
+            if (
+                self._max_refreshes is not None
+                and self.refreshes >= self._max_refreshes
+            ):
+                return
+
+    # -- one cycle ---------------------------------------------------------
+    def refresh_now(self) -> str:
+        """Run one re-fit cycle synchronously and return its outcome
+        (``swapped`` | ``canary`` | ``skipped`` | ``failed``) — also
+        the test/bench entry point, no thread required."""
+        outcome = "failed"
+        try:
+            outcome = self._refresh_once()
+        except LifecycleError as e:
+            outcome = "skipped"  # breaker open / canary in flight
+            _LOGGER.info(
+                "lifecycle: refresh of %s skipped: %s", self.name, e
+            )
+        except Exception:
+            _LOGGER.exception(
+                "lifecycle: refresh of %s failed", self.name
+            )
+        self.refreshes += 1
+        self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+        telemetry.counter("lifecycle_refresh_total").inc(
+            1, model=self.name, outcome=outcome
+        )
+        return outcome
+
+    def _refresh_once(self) -> str:
+        estimator = self._factory()
+        dataset = self._dataset() if callable(self._dataset) else self._dataset
+        if self._scheduler is not None:
+            fut = self._scheduler.submit(
+                estimator, dataset,
+                tenant=self._tenant,
+                priority=self._priority,
+                aging_ms=self._aging_ms,
+            )
+            model = fut.result(self._fit_timeout_s)
+        else:
+            model = estimator.fit(dataset)
+        if self.lifecycle.is_closed():
+            return "skipped"
+        if self._canary:
+            if self.lifecycle.canary_in_progress(self.name):
+                return "skipped"
+            self.lifecycle.start_canary(self.name, model=model)
+            return "canary"
+        self.lifecycle.swap(self.name, model=model)
+        return "swapped"

@@ -19,16 +19,37 @@ counter stays at 0 (the serving contract).
 from __future__ import annotations
 
 import logging
+import os
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ..runtime import envspec, opsplane, telemetry
+from ..runtime import envspec, faults, opsplane, telemetry
 
 _LOGGER = logging.getLogger("spark_rapids_ml_tpu.serving")
+
+
+class ModelReloadError(RuntimeError):
+    """A registered model's recorded load path is gone: the transparent
+    reload of an evicted entry (or an explicit :meth:`ModelRegistry.load`)
+    found no persisted model at the path. Typed so callers see a serving
+    error naming the model, not a ``FileNotFoundError`` from deep inside
+    persistence."""
+
+
+class SwapError(RuntimeError):
+    """A hot-swap failed before completing. ``stage`` names where it
+    died (``load``/``warm``/``flip``); whatever the stage, the prior
+    version is untouched and still serving — the new entry is only
+    routed to by the final atomic flip."""
+
+    def __init__(self, message: str, stage: str = "swap") -> None:
+        super().__init__(message)
+        self.stage = stage
 
 # floor of the padded bucket ladder; requests below it pad up to 8 rows
 # (except single-row requests, dispatched exact — see docs/serving.md
@@ -160,6 +181,10 @@ class ResidentModel:
     coalesce: bool         # pad-invariance probe passed at registration
     nbytes: int
     n_features: int
+    # monotone per-name version: bumped by every register/swap of the
+    # same name (the counter survives eviction), so the lifecycle layer
+    # can tell vN from vN+1 and /statusz can report what serves
+    version: int = 1
     # (bucket_rows) shapes whose programs have compiled — first dispatch
     # at a cold bucket runs under a warmup span so its compiles never
     # land on the steady-state dispatch site
@@ -305,6 +330,13 @@ class ModelRegistry:
         self._lock = threading.RLock()
         self._entries: "OrderedDict[str, ResidentModel]" = OrderedDict()
         self._paths: Dict[str, str] = {}
+        # last version ever assigned per name — survives eviction so a
+        # reload or re-register continues the sequence instead of
+        # restarting at 1
+        self._versions: Dict[str, int] = {}
+        # name -> stage ("load"/"warm"/"flip") while a hot-swap is
+        # staging; /readyz reports 503 swap_in_progress off this map
+        self._swapping: Dict[str, str] = {}
         self._evictions = 0
         # weakref-tracked by the ops plane so /readyz and /statusz can
         # introspect warmup state; pure bookkeeping, starts nothing
@@ -357,6 +389,7 @@ class ModelRegistry:
                     ready = False
                 models[name] = {
                     "coalesce": e.coalesce,
+                    "version": e.version,
                     "resident_bytes": e.nbytes,
                     "mp_degree": e.mp_degree,
                     "shard_bytes": e.shard_nbytes,
@@ -371,29 +404,52 @@ class ModelRegistry:
                     e.shard_nbytes for e in self._entries.values()
                 ),
                 "evictions": self._evictions,
+                "swaps_in_progress": dict(self._swapping),
                 "models": models,
             }
+
+    def swaps_in_progress(self) -> Dict[str, str]:
+        """Names with a hot-swap staging right now, mapped to the stage
+        the swap is in (``load``/``warm``/``flip``). Non-empty means the
+        process should report not-ready: a kill during the window would
+        strand the staged version's warmup investment (never the live
+        version — that flips only at the end)."""
+        with self._lock:
+            return dict(self._swapping)
 
     @property
     def evictions(self) -> int:
         return self._evictions
 
     # -- load / register ---------------------------------------------------
+    def _read_model(self, name: str, path: str) -> Any:
+        """Load a persisted model, verifying the directory first so a
+        dangling path surfaces as a typed :class:`ModelReloadError`
+        naming the model, not a ``FileNotFoundError`` from persistence."""
+        from ..core import _TpuModel
+
+        if not os.path.isfile(os.path.join(path, "metadata.json")):
+            raise ModelReloadError(
+                f"model {name!r} cannot load from {path!r}: no persisted "
+                "model there (missing metadata.json) — the recorded load "
+                "path is gone or was never a model directory"
+            )
+        return _TpuModel.read().load(path)
+
     def load(self, name: str, path: str) -> ResidentModel:
         """Load a persisted model directory (any ``_TpuModel`` subclass;
         the class resolves from its metadata) and make it resident."""
-        from ..core import _TpuModel
-
-        model = _TpuModel.read().load(path)
+        model = self._read_model(name, path)
         entry = self.register(name, model)
         with self._lock:
             self._paths[name] = path
         return entry
 
-    def register(self, name: str, model: Any) -> ResidentModel:
-        """Adopt an in-memory fitted model: resolve its fast path, admit
-        it against the HBM budget (evicting LRU residents), and warm its
-        bucket ladder."""
+    def _build_entry(self, name: str, model: Any, version: int) -> ResidentModel:
+        """Resolve a model's fast path into a :class:`ResidentModel`
+        WITHOUT inserting it: probe pad-invariance, size residency.
+        Shared by :meth:`register` (insert immediately) and :meth:`swap`
+        (stage beside the live version, flip later)."""
         family = serving_family(model)
         fn, engine = _resolve_fast_path(model, family)
         n_features = feature_width(model)
@@ -420,31 +476,43 @@ class ModelRegistry:
             coalesce=coalesce,
             nbytes=nbytes,
             n_features=n_features,
+            version=version,
             mp_degree=self._resolve_mp(nbytes),
         )
-        with self._lock:
-            if self._budget is not None and entry.shard_nbytes > self._budget:
-                raise ValueError(
-                    f"model {name!r} needs {entry.shard_nbytes} resident "
-                    f"bytes on this rank"
-                    + (
-                        f" (of {entry.nbytes} total over "
-                        f"mp={entry.mp_degree} model-axis shards)"
-                        if entry.mp_degree > 1 else ""
-                    )
-                    + f", over the whole TPUML_SERVE_HBM_BUDGET "
-                    f"({self._budget:.0f})"
+        if self._budget is not None and entry.shard_nbytes > self._budget:
+            raise ValueError(
+                f"model {name!r} needs {entry.shard_nbytes} resident "
+                f"bytes on this rank"
+                + (
+                    f" (of {entry.nbytes} total over "
+                    f"mp={entry.mp_degree} model-axis shards)"
+                    if entry.mp_degree > 1 else ""
                 )
+                + f", over the whole TPUML_SERVE_HBM_BUDGET "
+                f"({self._budget:.0f})"
+            )
+        return entry
+
+    def register(self, name: str, model: Any) -> ResidentModel:
+        """Adopt an in-memory fitted model: resolve its fast path, admit
+        it against the HBM budget (evicting LRU residents), and warm its
+        bucket ladder."""
+        with self._lock:
+            version = self._versions.get(name, 0) + 1
+        entry = self._build_entry(name, model, version)
+        with self._lock:
             self._entries.pop(name, None)
             self._entries[name] = entry
+            self._versions[name] = entry.version
             self._admit_locked(keep=name)
             self._file_hbm_locked()
         if self._warmup and entry.coalesce:
             self.warm(entry)
         _LOGGER.info(
-            "serving: registered %s (family=%s engine=%s resident=%dB"
+            "serving: registered %s v%d (family=%s engine=%s resident=%dB"
             " coalesce=%s)",
-            name, family, engine, entry.nbytes, entry.coalesce,
+            name, entry.version, entry.family, entry.engine, entry.nbytes,
+            entry.coalesce,
         )
         return entry
 
@@ -470,6 +538,141 @@ class ModelRegistry:
             self._evictions += 1
             self._file_hbm_locked()
         _LOGGER.info("serving: evicted %s (%dB)", name, entry.nbytes)
+
+    # -- versioned hot-swap ------------------------------------------------
+    def swap(
+        self, name: str, model: Any = None, path: Optional[str] = None,
+    ) -> ResidentModel:
+        """Zero-downtime version flip: stage vN+1 beside the live vN,
+        warm its full bucket ladder under warmup-flagged spans, then
+        atomically replace the routing entry and release vN.
+
+        The live entry is only touched by the final dict assignment
+        under the registry lock, so a failure at ANY earlier stage
+        (load, probe, warmup — including the ``swap:warm``/``swap:flip``
+        fault-injection sites) leaves exactly one consistent version
+        serving: the old one. Dispatchers resolve ``get(name)`` once per
+        batch, so no batch ever mixes versions. The staged entry
+        transiently occupies HBM beside vN (the "spare HBM" the swap
+        story requires); after the flip ``_admit_locked`` restores the
+        budget by LRU-evicting other residents if needed.
+
+        Raises :class:`SwapError` (``.stage`` in ``load``/``warm``/
+        ``flip``) on failure; the failure is also counted under
+        ``swap_failures_total{model,stage}``. ``KeyError`` when ``name``
+        was never registered — a swap needs a live version to replace
+        (use :meth:`register`/:meth:`load` for v1)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            old = self._entries.get(name)
+            if old is None:
+                raise KeyError(
+                    f"model {name!r} is not registered; swap replaces a "
+                    "live version — register/load v1 first"
+                )
+            if name in self._swapping:
+                raise SwapError(
+                    f"a hot-swap of {name!r} is already in progress "
+                    f"(stage {self._swapping[name]})", stage="load",
+                )
+            version = self._versions.get(name, old.version) + 1
+            self._swapping[name] = "load"
+        stage = "load"
+        try:
+            if model is None:
+                if path is None:
+                    raise ValueError("swap needs a model or a path")
+                model = self._read_model(name, path)
+            entry = self._build_entry(name, model, version)
+            stage = "warm"
+            with self._lock:
+                self._swapping[name] = "warm"
+            faults.fault_site("swap:warm")
+            if self._warmup and entry.coalesce:
+                self.warm(entry)
+            stage = "flip"
+            with self._lock:
+                self._swapping[name] = "flip"
+            faults.fault_site("swap:flip")
+            with self._lock:
+                old = self._entries.get(name)
+                self._entries[name] = entry
+                self._entries.move_to_end(name)
+                self._versions[name] = entry.version
+                # path hygiene: the evicted vN's reload path must not
+                # dangle — record vN+1's path, or drop the stale one
+                # when swapping in an in-memory model
+                if path is not None:
+                    self._paths[name] = path
+                else:
+                    self._paths.pop(name, None)
+                self._admit_locked(keep=name)
+                self._file_hbm_locked()
+        except Exception as exc:
+            telemetry.counter("swap_failures_total").inc(
+                1, model=name, stage=stage
+            )
+            with self._lock:
+                self._swapping.pop(name, None)
+            if isinstance(exc, SwapError):
+                raise
+            raise SwapError(
+                f"hot-swap of {name!r} to v{version} failed during "
+                f"{stage}: {exc}", stage=stage,
+            ) from exc
+        with self._lock:
+            self._swapping.pop(name, None)
+        if old is not None and old.model is not entry.model:
+            self._release(old)
+            with self._lock:
+                self._evictions += 1
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        telemetry.counter("swap_total").inc(1, model=name)
+        telemetry.histogram("swap_duration_ms").observe(
+            elapsed_ms, model=name
+        )
+        telemetry.gauge("serve_model_version").set(entry.version, model=name)
+        _LOGGER.info(
+            "serving: hot-swapped %s v%d -> v%d in %.1f ms "
+            "(resident=%dB coalesce=%s)",
+            name, old.version if old else 0, entry.version, elapsed_ms,
+            entry.nbytes, entry.coalesce,
+        )
+        return entry
+
+    def promote_alias(self, alias: str, name: str) -> ResidentModel:
+        """Atomically re-route ``name`` to the (already warmed) entry
+        registered under ``alias``, releasing the previous ``name``
+        entry — the canary promotion flip: the candidate served shadow
+        traffic under ``alias`` and now becomes the live version without
+        a single cold dispatch."""
+        with self._lock:
+            entry = self._entries.pop(alias, None)
+            if entry is None:
+                raise KeyError(f"model {alias!r} is not registered")
+            old = self._entries.get(name)
+            entry.name = name
+            self._entries[name] = entry
+            self._entries.move_to_end(name)
+            self._versions[name] = max(
+                entry.version, self._versions.get(name, 0) + 1
+            )
+            entry.version = self._versions[name]
+            alias_path = self._paths.pop(alias, None)
+            if alias_path is not None:
+                self._paths[name] = alias_path
+            else:
+                self._paths.pop(name, None)
+            if old is not None:
+                self._evictions += 1
+            self._file_hbm_locked()
+        if old is not None and old.model is not entry.model:
+            self._release(old)
+        telemetry.gauge("serve_model_version").set(entry.version, model=name)
+        _LOGGER.info(
+            "serving: promoted %s -> %s v%d", alias, name, entry.version
+        )
+        return entry
 
     # -- internals ---------------------------------------------------------
     def _resolve_mp(self, nbytes: int) -> int:

@@ -69,7 +69,7 @@ from ..runtime.admission import (
     ServiceEwma,
     ShuttingDown,
 )
-from .registry import ResidentModel
+from .registry import ModelReloadError, ResidentModel, SwapError
 from .runtime import ServingRuntime
 
 __all__ = [
@@ -122,6 +122,9 @@ class LoopbackReplica:
     def load(self, name: str, path: str) -> ResidentModel:
         return self.runtime.load(name, path)
 
+    def swap(self, name: str, path: str) -> ResidentModel:
+        return self.runtime.swap(name, path=path)
+
     def predict_async(
         self, name: str, X: np.ndarray, deadline_ms: Optional[float] = None
     ) -> "Future[Dict[str, np.ndarray]]":
@@ -161,6 +164,8 @@ _ERROR_TYPES = {
     "DeadlineExceeded": DeadlineExceeded,
     "ShuttingDown": ShuttingDown,
     "AdmissionError": AdmissionError,
+    "SwapError": SwapError,
+    "ModelReloadError": ModelReloadError,
     "KeyError": KeyError,
     "ValueError": ValueError,
 }
@@ -325,6 +330,9 @@ class SubprocessReplica:
 
     def load(self, name: str, path: str) -> Dict[str, Any]:
         return self._call("load", name=name, path=path)
+
+    def swap(self, name: str, path: str) -> Dict[str, Any]:
+        return self._call("swap", name=name, path=path)
 
     def predict_async(
         self, name: str, X: np.ndarray, deadline_ms: Optional[float] = None
@@ -531,6 +539,47 @@ class Router:
         shared ``path`` — each replica pins + warms its own copy and
         reports residency per rank (:meth:`fleet_warmup_state`)."""
         return [rep.load(name, path) for rep in self.replicas]
+
+    def swap(self, name: str, path: str) -> List[Any]:
+        """Fleet-wide ROLLING hot-swap from a shared persisted path:
+        replicas flip sequentially, each staging + warming vN+1 beside
+        its live vN before its own atomic flip, so at every instant
+        each replica serves exactly one consistent version and the
+        fleet as a whole keeps full capacity (one replica warms while
+        the others serve). A replica failure halts the roll with a
+        typed :class:`SwapError` naming the rank — flipped replicas
+        keep vN+1, the failed and remaining ranks keep vN serving
+        (the registry-level invariant: a failed swap never unseats the
+        prior version). Mixed-version fleets are legal mid-roll; both
+        versions answer identically-routed traffic until the roll
+        completes or the operator re-rolls."""
+        results: List[Any] = []
+        for i, rep in enumerate(self.replicas):
+            try:
+                results.append(rep.swap(name, path))
+            except Exception as e:
+                raise SwapError(
+                    f"fleet swap of {name!r} halted at replica {i}: "
+                    f"{len(results)}/{len(self.replicas)} replicas "
+                    f"flipped, ranks {i}..{len(self.replicas) - 1} keep "
+                    f"the prior version serving: {e}",
+                    stage=getattr(e, "stage", "swap"),
+                ) from e
+        return results
+
+    def fleet_versions(self, name: str) -> List[Optional[int]]:
+        """The resident version of ``name`` per replica (None where not
+        resident) — mid-roll this shows the vN/vN+1 frontier."""
+        out: List[Optional[int]] = []
+        for rep in self.replicas:
+            try:
+                models = rep.warmup_state().get("models", {})
+                entry = models.get(name) or {}
+                v = entry.get("version")
+                out.append(None if v is None else int(v))
+            except Exception:
+                out.append(None)
+        return out
 
     # -- picking -----------------------------------------------------------
     def _healthy_index(self, i: int) -> bool:

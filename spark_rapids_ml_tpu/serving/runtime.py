@@ -102,6 +102,32 @@ class _Request:
 _SHUTDOWN = object()
 
 
+@dataclass
+class _ShadowRoute:
+    """Mirror a deterministic fraction of one model's admitted traffic
+    to a shadow entry (the canary candidate). Callers always receive
+    the LIVE entry's output — the shadow future is observed only by
+    ``on_pair`` — so canarying never perturbs served bits."""
+
+    alias: str
+    fraction: float
+    # called with (live_out, shadow_out) when both sides of a mirrored
+    # request resolve; a failed side passes None
+    on_pair: Optional[Any] = None
+    count: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def take(self) -> bool:
+        """Deterministic request picker: mirror request n exactly when
+        ``floor(n * fraction)`` advances — no RNG (the TPU004 house
+        rule), and any window of requests mirrors within one request of
+        the configured fraction."""
+        with self.lock:
+            self.count += 1
+            n = self.count
+        return int(n * self.fraction) > int((n - 1) * self.fraction)
+
+
 def _bucket_rows(n: int, max_bucket: int) -> int:
     """Padded row count for an ``n``-row dispatch: next power of two,
     floored at MIN_BUCKET_ROWS, capped at the ladder top (grouping
@@ -170,6 +196,12 @@ class ServingRuntime:
         self._idle = threading.Condition()
         self._inflight: List[_Request] = []
         self._last_beat: Optional[float] = None
+        # lifecycle hooks, both empty (and cost-free) by default:
+        # result observers see every successful dispatch's host outputs
+        # (drift gauges); shadow routes mirror a traffic fraction to a
+        # canary entry without touching what callers receive
+        self._observers: List[Any] = []
+        self._shadows: Dict[str, _ShadowRoute] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def __enter__(self) -> "ServingRuntime":
@@ -285,6 +317,57 @@ class ServingRuntime:
     def load(self, name: str, path: str) -> ResidentModel:
         return self.registry.load(name, path)
 
+    def swap(
+        self, name: str, model: Any = None, path: Optional[str] = None,
+    ) -> ResidentModel:
+        """Zero-downtime hot-swap of ``name`` to a new version (see
+        :meth:`ModelRegistry.swap`): the dispatcher keeps serving vN
+        while vN+1 stages and warms; in-flight and queued requests are
+        never shed — each dispatched batch resolves its entry once, so
+        requests ride whichever version is routed at dispatch time."""
+        return self.registry.swap(name, model=model, path=path)
+
+    # -- lifecycle hooks ----------------------------------------------------
+    def add_result_observer(self, fn: Any) -> None:
+        """Register ``fn(entry, host)`` to be called after every
+        successful group dispatch with the valid-row host outputs (pad
+        tail already sliced). Observer failures are logged, never
+        propagated — observation must not fail serving."""
+        self._observers.append(fn)
+
+    def remove_result_observer(self, fn: Any) -> None:
+        try:
+            self._observers.remove(fn)
+        except ValueError:
+            pass
+
+    def set_shadow(
+        self,
+        name: str,
+        alias: str,
+        fraction: float,
+        on_pair: Optional[Any] = None,
+    ) -> None:
+        """Mirror ``fraction`` of ``name``'s admitted requests to the
+        registered entry ``alias``. Mirrored requests are fire-and-
+        forget copies: callers still get (only) the live entry's
+        output, and a shed/failed mirror never surfaces to them."""
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(
+                f"shadow fraction must be in (0, 1], got {fraction}"
+            )
+        if alias == name:
+            raise ValueError("shadow alias must differ from the live name")
+        self._shadows[name] = _ShadowRoute(
+            alias=alias, fraction=float(fraction), on_pair=on_pair
+        )
+
+    def clear_shadow(self, name: str) -> None:
+        self._shadows.pop(name, None)
+
+    def shadow_routes(self) -> Dict[str, str]:
+        return {n: s.alias for n, s in self._shadows.items()}
+
     # -- request surface ---------------------------------------------------
     def predict_async(
         self,
@@ -344,7 +427,55 @@ class ServingRuntime:
                 self._pending += 1
             self._queue.put(req)
         telemetry.counter("serve_requests_total").inc(1, model=name)
+        shadow = self._shadows.get(name)
+        if shadow is not None and shadow.take():
+            # outside self._lock (non-reentrant): the mirrored enqueue
+            # re-enters predict_async for the alias
+            self._mirror(shadow, name, X, fut, deadline_ms)
         return fut
+
+    def _mirror(
+        self,
+        shadow: _ShadowRoute,
+        name: str,
+        X: np.ndarray,
+        live_fut: "Future[Dict[str, np.ndarray]]",
+        deadline_ms: Optional[float],
+    ) -> None:
+        """Fire the shadow copy of an admitted request and pair the two
+        futures for ``on_pair`` scoring. Best-effort by design: a
+        mirror the alias cannot admit (breaker, queue, drain) is
+        dropped silently — shadow load must never shed live traffic or
+        surface canary errors to callers."""
+        try:
+            shadow_fut = self.predict_async(
+                shadow.alias, X, deadline_ms=deadline_ms
+            )
+        except Exception:
+            return
+        telemetry.counter("canary_requests_total").inc(1, model=name)
+        cb = shadow.on_pair
+        if cb is None:
+            return
+        state: Dict[str, Any] = {}
+        state_lock = threading.Lock()
+
+        def _settle_pair(side: str, fut: "Future[Dict[str, np.ndarray]]") -> None:
+            try:
+                out: Optional[Dict[str, np.ndarray]] = fut.result()
+            except BaseException:
+                out = None  # a failed side scores as missing, not fatal
+            with state_lock:
+                state[side] = out
+                if len(state) < 2:
+                    return
+            try:
+                cb(state["live"], state["shadow"])
+            except Exception:
+                logger.exception("serving: shadow pair callback failed")
+
+        live_fut.add_done_callback(lambda f: _settle_pair("live", f))
+        shadow_fut.add_done_callback(lambda f: _settle_pair("shadow", f))
 
     def predict(
         self,
@@ -652,6 +783,14 @@ class ServingRuntime:
         self.admission.note_batch(
             entry.name, time.perf_counter() - t0, len(group)
         )
+        if self._observers:
+            for obs in list(self._observers):
+                try:
+                    obs(entry, host)
+                except Exception:
+                    logger.exception(
+                        "serving: result observer failed for %r", entry.name
+                    )
         telemetry.histogram("serve_batch_fill").observe(
             n / bucket, model=entry.name
         )

@@ -1,0 +1,550 @@
+"""Continuous-training lifecycle: versioned zero-downtime hot-swap
+(bit-identity across the flip, zero sheds / zero retrace storms under
+sustained load, chaos-tested single-consistent-version invariant at the
+``swap:warm``/``swap:flip`` fault sites), shadow canary with automatic
+promote / rollback + the version breaker, the SLO-burn rollback
+tripwire, drift gauges, the RefreshDriver loop through the fit
+scheduler, typed reload errors for dangling paths, SIGTERM drain
+ordering, fleet-wide rolling swap through the router, and the
+defaults-inert contract (no lifecycle object => no thread, no new
+metric series).
+"""
+
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.runtime import faults, opsplane, telemetry
+from spark_rapids_ml_tpu.runtime.scheduler import FitScheduler
+from spark_rapids_ml_tpu.serving import (
+    LifecycleError,
+    ModelLifecycle,
+    ModelRegistry,
+    ModelReloadError,
+    RefreshDriver,
+    Router,
+    ServingRuntime,
+    SwapError,
+)
+
+N, D = 400, 10
+SEED = 7
+
+LIFECYCLE_METRICS = (
+    "swap_total", "swap_failures_total", "swap_duration_ms",
+    "serve_model_version", "canary_requests_total",
+    "canary_promotions_total", "canary_rollbacks_total",
+    "serve_drift_score", "lifecycle_refresh_total",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset_telemetry()
+    faults.reset_faults()
+    yield
+    telemetry.reset_telemetry()
+    faults.reset_faults()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(SEED)
+    return rng.normal(size=(N, D)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def df(data):
+    return DataFrame({"features": data})
+
+
+@pytest.fixture(scope="module")
+def models(df):
+    """v1 plus three swap candidates — same data, same params, so every
+    version's outputs are bit-identical (the flip must be invisible)."""
+    return [PCA(k=4).fit(df) for _ in range(4)]
+
+
+@pytest.fixture(scope="module")
+def divergent_model(data):
+    """A candidate fitted on DIFFERENT data: its projections disagree
+    with the live model's, so canary scoring must reject it."""
+    rng = np.random.default_rng(99)
+    other = rng.normal(size=(N, D)).astype(np.float32)
+    return PCA(k=4).fit(DataFrame({"features": other}))
+
+
+def _queries(rng, sizes):
+    return [rng.normal(size=(s, D)).astype(np.float32) for s in sizes]
+
+
+def _wait_no_canary(lc, name, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not lc.canary_in_progress(name):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"canary for {name!r} never settled")
+
+
+def _counter_series(name):
+    return list((telemetry.metrics_snapshot().get(name) or {}).get(
+        "series"
+    ) or [])
+
+
+def _counter_total(name):
+    return sum(s["value"] for s in _counter_series(name))
+
+
+# --- versioned hot-swap ----------------------------------------------------
+
+
+def test_swap_bit_identity_and_version(models, data):
+    """A hot-swap bumps the version atomically and the served outputs
+    stay bit-identical across the flip (same-data candidates)."""
+    with ServingRuntime(batch_window_us=5_000, max_bucket_rows=64) as rt:
+        e1 = rt.register("pca", models[0])
+        assert e1.version == 1
+        before = rt.predict("pca", data[:33], timeout=180)
+        e2 = rt.swap("pca", model=models[1])
+        assert e2.version == 2
+        assert rt.registry.get("pca").version == 2
+        after = rt.predict("pca", data[:33], timeout=180)
+    for col in before:
+        assert np.array_equal(before[col], after[col])
+    assert _counter_total("swap_total") == 1
+    assert not rt.registry.swaps_in_progress()
+
+
+def test_swap_requires_live_version(models):
+    with ServingRuntime() as rt:
+        with pytest.raises(KeyError):
+            rt.swap("never-registered", model=models[0])
+
+
+def test_sustained_load_consecutive_swaps(models, data):
+    """Three consecutive hot-swaps under a closed-loop client stream:
+    every future resolves with correct bit-identical rows, zero typed
+    sheds, zero retrace storms, and no steady-state dispatch compile —
+    the zero-downtime contract."""
+    rng = np.random.default_rng(11)
+    qs = _queries(rng, [5, 17, 33])
+    direct = []
+    for q in qs:
+        out = models[0].transform(DataFrame({"features": q}))
+        direct.append({c: np.asarray(out[c]) for c in out.columns})
+    errors = []
+    stop = threading.Event()
+
+    with ServingRuntime(batch_window_us=5_000, max_bucket_rows=64) as rt:
+        rt.register("pca", models[0])
+
+        def client(tid):
+            i = 0
+            while not stop.is_set():
+                q = qs[(tid + i) % len(qs)]
+                want = direct[(tid + i) % len(qs)]
+                try:
+                    out = rt.predict("pca", q, timeout=180)
+                    for col, v in out.items():
+                        assert np.array_equal(v, want[col]), (tid, i, col)
+                except Exception as e:  # noqa: BLE001 - collected below
+                    errors.append(e)
+                    return
+                i += 1
+
+        threads = [
+            threading.Thread(target=client, args=(t,)) for t in range(3)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            for v, model in enumerate(models[1:], start=2):
+                time.sleep(0.1)
+                entry = rt.swap("pca", model=model)
+                assert entry.version == v
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(60)
+    assert not errors, errors[:1]
+    assert rt.registry.get("pca").version == 4
+    snap = telemetry.metrics_snapshot()
+    assert not (snap.get("serve_shed_total") or {}).get("series")
+    assert not telemetry.counter("retrace_storms").value()
+    compiles = (snap.get("xla_compiles") or {}).get("series") or []
+    dispatch_compiles = [
+        s for s in compiles
+        if str(s["labels"].get("site", "")).startswith("serve.batch")
+    ]
+    assert not dispatch_compiles, dispatch_compiles
+
+
+@pytest.mark.parametrize("site", ["swap:warm", "swap:flip"])
+def test_mid_swap_fault_leaves_prior_version_serving(
+    models, data, site, monkeypatch
+):
+    """A fault injected mid-swap (before warmup / before the flip) must
+    surface as a typed SwapError, be counted by stage, and leave exactly
+    one consistent version serving: the old one."""
+    with ServingRuntime(batch_window_us=5_000, max_bucket_rows=64) as rt:
+        rt.register("pca", models[0])
+        before = rt.predict("pca", data[:17], timeout=180)
+        monkeypatch.setenv("TPUML_FAULT_SPEC", f"{site}:0:raise")
+        faults.reset_faults()
+        with pytest.raises(SwapError) as ei:
+            rt.swap("pca", model=models[1])
+        assert ei.value.stage == site.split(":")[1]
+        # the prior version is untouched and still serving
+        entry = rt.registry.get("pca")
+        assert entry.version == 1 and entry.model is models[0]
+        assert not rt.registry.swaps_in_progress()
+        after = rt.predict("pca", data[:17], timeout=180)
+        for col in before:
+            assert np.array_equal(before[col], after[col])
+        # the failure is typed AND counted under its stage
+        series = _counter_series("swap_failures_total")
+        assert [s["labels"] for s in series] == [
+            {"model": "pca", "stage": site.split(":")[1]}
+        ]
+        # a retry after the (spent) fault succeeds
+        faults.reset_faults()
+        monkeypatch.delenv("TPUML_FAULT_SPEC")
+        assert rt.swap("pca", model=models[1]).version == 2
+
+
+# --- typed reload errors ---------------------------------------------------
+
+
+def test_evicted_model_dangling_path_raises_typed(models, tmp_path):
+    """The transparent reload of an evicted model must verify the
+    recorded path still exists and raise ModelReloadError — not a
+    FileNotFoundError from deep inside persistence."""
+    pa, pb = str(tmp_path / "a"), str(tmp_path / "b")
+    models[0].write().overwrite().save(pa)
+    models[1].write().overwrite().save(pb)
+    reg = ModelRegistry(hbm_budget_bytes=300, warmup=False)
+    reg.load("a", pa)
+    reg.load("b", pb)  # tight budget: evicts "a", path recorded
+    assert reg.names() == ["b"]
+    shutil.rmtree(pa)
+    with pytest.raises(ModelReloadError, match="'a'"):
+        reg.get("a")
+
+
+def test_swap_drops_stale_reload_path(models, tmp_path):
+    """A swap that replaces a path-loaded vN with an in-memory vN+1
+    must drop vN's reload path: a later eviction + get must raise the
+    registry KeyError, never reload the stale persisted vN."""
+    p = str(tmp_path / "v1")
+    models[0].write().overwrite().save(p)
+    reg = ModelRegistry(warmup=False)
+    reg.load("m", p)
+    entry = reg.swap("m", model=models[1])
+    assert entry.version == 2
+    reg.evict("m")
+    with pytest.raises(KeyError):
+        reg.get("m")
+
+
+# --- shadow canary ---------------------------------------------------------
+
+
+def test_canary_auto_promote(models, data):
+    """An agreeing candidate mirrors a fraction of traffic, scores 1.0,
+    and auto-promotes: the live name flips to the already-warmed entry
+    and callers never saw a non-live output."""
+    with ServingRuntime(batch_window_us=5_000, max_bucket_rows=64) as rt:
+        rt.register("pca", models[0])
+        lc = ModelLifecycle(
+            rt, canary_fraction=1.0, canary_min_requests=4,
+        )
+        alias = lc.start_canary("pca", model=models[1])
+        assert alias == "pca@v2"
+        with pytest.raises(LifecycleError):  # one canary at a time
+            lc.start_canary("pca", model=models[2])
+        direct = models[0].transform(DataFrame({"features": data[:17]}))
+        for _ in range(8):
+            out = rt.predict("pca", data[:17], timeout=180)
+            for col, v in out.items():  # caller always sees live vN
+                assert np.array_equal(v, np.asarray(direct[col]))
+        _wait_no_canary(lc, "pca")
+        entry = rt.registry.get("pca")
+        assert entry.version == 2 and entry.model is models[1]
+        assert "pca@v2" not in rt.registry.names()
+    assert _counter_total("canary_promotions_total") == 1
+    assert not _counter_series("canary_rollbacks_total")
+    assert _counter_total("canary_requests_total") >= 4
+
+
+def test_canary_auto_rollback_and_version_breaker(
+    models, divergent_model, data
+):
+    """A divergent candidate rolls back automatically (reason=score),
+    the live version keeps serving untouched, and the version breaker
+    refuses an immediate re-canary AND a direct swap — typed."""
+    with ServingRuntime(batch_window_us=5_000, max_bucket_rows=64) as rt:
+        rt.register("pca", models[0])
+        lc = ModelLifecycle(
+            rt, canary_fraction=1.0, canary_min_requests=4,
+            canary_cooldown_ms=60_000.0,
+        )
+        lc.start_canary("pca", model=divergent_model)
+        for _ in range(8):
+            rt.predict("pca", data[:17], timeout=180)
+        _wait_no_canary(lc, "pca")
+        entry = rt.registry.get("pca")
+        assert entry.version == 1 and entry.model is models[0]
+        assert "pca@v2" not in rt.registry.names()
+        series = _counter_series("canary_rollbacks_total")
+        assert [s["labels"] for s in series] == [
+            {"model": "pca", "reason": "score"}
+        ]
+        assert lc.status()["version_breakers"] == {"pca": "open"}
+        with pytest.raises(LifecycleError, match="breaker"):
+            lc.start_canary("pca", model=models[1])
+        with pytest.raises(LifecycleError, match="breaker"):
+            lc.swap("pca", model=models[1])
+        assert rt.predict("pca", data[:5], timeout=180)  # still serving
+
+
+def test_canary_rollback_on_slo_burn(models, data):
+    """A NEW alerting SLO (the multi-window burn machinery) rolls the
+    canary back immediately — without waiting for the pair count — and
+    pre-existing alerts (the baseline snapshot) do not."""
+    alerts = set()
+    with ServingRuntime(batch_window_us=5_000, max_bucket_rows=64) as rt:
+        rt.register("pca", models[0])
+        lc = ModelLifecycle(
+            rt, canary_fraction=1.0, canary_min_requests=1000,
+            burn_probe=lambda: set(alerts),
+        )
+        alerts.add("sched_shed_rate")  # pre-existing: baselined away
+        lc.start_canary("pca", model=models[1])
+        rt.predict("pca", data[:5], timeout=180)
+        time.sleep(0.2)
+        assert lc.canary_in_progress("pca")  # baseline alert ignored
+        alerts.add("serving_p99_ms")  # NEW alert: the tripwire
+        rt.predict("pca", data[:5], timeout=180)
+        _wait_no_canary(lc, "pca")
+        assert rt.registry.get("pca").version == 1
+        series = _counter_series("canary_rollbacks_total")
+        assert [s["labels"] for s in series] == [
+            {"model": "pca", "reason": "slo_burn"}
+        ]
+
+
+# --- drift gauges ----------------------------------------------------------
+
+
+def test_drift_gauge_scores_windows(models, data):
+    """The first full window freezes the reference; an in-distribution
+    window scores near zero PSI, a shifted window scores high — and the
+    scores land on serve_drift_score{model}."""
+    rng = np.random.default_rng(23)
+    with ServingRuntime(batch_window_us=5_000, max_bucket_rows=64) as rt:
+        rt.register("pca", models[0])
+        lc = ModelLifecycle(rt)
+        lc.watch_drift("pca", window=64, bins=8)
+
+        def serve(X):
+            rt.predict("pca", X, timeout=180)
+
+        base = lambda: rng.normal(size=(16, D)).astype(np.float32)
+        serve(base())  # 16 rows x 4 components = 64 vals: reference
+        assert lc.drift_state("pca")["reference_ready"]
+        serve(base())  # in-distribution window
+        st = lc.drift_state("pca")
+        assert st["windows_scored"] == 1
+        psi_same = st["last_psi"]
+        serve((base() * 5.0 + 3.0))  # shifted window
+        st = lc.drift_state("pca")
+        assert st["windows_scored"] == 2
+        psi_shift = st["last_psi"]
+    assert psi_shift > psi_same
+    assert psi_shift > 0.25  # the serving_drift SLO objective
+    series = (telemetry.metrics_snapshot().get("serve_drift_score") or {}
+              ).get("series") or []
+    assert [s["labels"] for s in series] == [{"model": "pca"}]
+    assert series[0]["count"] == 2
+
+
+# --- refresh driver --------------------------------------------------------
+
+
+def test_refresh_driver_through_scheduler(models, df, data):
+    """One refresh cycle: fit a fresh estimator through the scheduler
+    as a low-priority slow-aging tenant, hand it to the swap path, and
+    count the outcome."""
+    with FitScheduler() as sched:
+        with ServingRuntime(
+            batch_window_us=5_000, max_bucket_rows=64
+        ) as rt:
+            rt.register("pca", models[0])
+            lc = ModelLifecycle(rt, scheduler=sched)
+            drv = RefreshDriver(
+                lc, "pca", lambda: PCA(k=4), df,
+                scheduler=sched, aging_ms=600_000.0,
+            )
+            assert drv.refresh_now() == "swapped"
+            entry = rt.registry.get("pca")
+            assert entry.version == 2
+            out = rt.predict("pca", data[:17], timeout=180)
+            direct = models[0].transform(
+                DataFrame({"features": data[:17]})
+            )
+            for col, v in out.items():  # same data+params: identical
+                assert np.array_equal(v, np.asarray(direct[col]))
+    series = _counter_series("lifecycle_refresh_total")
+    assert [s["labels"] for s in series] == [
+        {"model": "pca", "outcome": "swapped"}
+    ]
+
+
+def test_refresh_driver_thread_and_drain(models, df):
+    """add_refresh starts the daemon loop; drain halts it, and a closed
+    lifecycle refuses further refresh attachment typed."""
+    with ServingRuntime(batch_window_us=5_000, max_bucket_rows=64) as rt:
+        rt.register("pca", models[0])
+        lc = ModelLifecycle(rt)
+        drv = lc.add_refresh(
+            "pca", lambda: PCA(k=4), df, period_ms=50.0, max_refreshes=2,
+        )
+        assert any(
+            t.name == "tpuml-lifecycle-refresh-pca"
+            for t in threading.enumerate()
+        )
+        deadline = time.monotonic() + 60
+        while drv.refreshes < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert drv.refreshes >= 2
+        assert rt.registry.get("pca").version >= 3
+        report = lc.drain(timeout=10.0)
+        assert report["drained"]
+        assert not drv.is_alive()
+        with pytest.raises(LifecycleError):
+            lc.add_refresh("pca", lambda: PCA(k=4), df)
+        with pytest.raises(LifecycleError):
+            lc.swap("pca", model=models[1])
+
+
+# --- ops plane wiring ------------------------------------------------------
+
+
+def test_readyz_reports_swap_in_progress(models):
+    reg = ModelRegistry(warmup=False)
+    reg.register("m", models[0])
+    ok, reasons = opsplane._readiness()
+    assert not any(r.startswith("swap_in_progress=") for r in reasons)
+    reg._swapping["m"] = "warm"  # mid-swap window
+    ok, reasons = opsplane._readiness()
+    assert not ok
+    assert any(
+        r.startswith("swap_in_progress=") and '"m"' in r for r in reasons
+    )
+    reg._swapping.clear()
+
+
+def test_sigterm_drains_lifecycle_first(monkeypatch):
+    """The SIGTERM chain drains lifecycles BEFORE router/runtime/
+    scheduler: refresh loops halt and canaries roll back before serving
+    admission stops."""
+    order = []
+
+    class _Fake:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def drain(self, timeout=None):
+            order.append(self.tag)
+            return {"drained": True}
+
+        def close(self):
+            pass
+
+    lc, router, rt, sched = (
+        _Fake("lifecycle"), _Fake("router"), _Fake("runtime"),
+        _Fake("scheduler"),
+    )
+    try:
+        opsplane.track_lifecycle(lc)
+        opsplane.track_router(router)
+        opsplane.track_runtime(rt)
+        opsplane.track_scheduler(sched)
+        monkeypatch.setattr(opsplane, "_PREV_SIGTERM", lambda *a: None)
+        opsplane._on_sigterm(15, None)
+    finally:
+        opsplane.stop()
+    assert order == ["lifecycle", "router", "runtime", "scheduler"]
+
+
+def test_lifecycle_statusz_section(models, data):
+    with ServingRuntime(batch_window_us=5_000, max_bucket_rows=64) as rt:
+        rt.register("pca", models[0])
+        lc = ModelLifecycle(
+            rt, canary_fraction=1.0, canary_min_requests=1000,
+        )
+        lc.watch_drift("pca")
+        lc.start_canary("pca", model=models[1])
+        st = opsplane._statusz()
+        sections = [s for s in st["lifecycle"] if s.get("canaries")]
+        assert sections, st["lifecycle"]
+        assert "pca" in sections[0]["canaries"]
+        assert "pca" in sections[0]["drift"]
+        lc.rollback("pca", reason="manual")
+        lc.drain(timeout=5.0)
+
+
+# --- fleet-wide rolling swap -----------------------------------------------
+
+
+def test_router_rolling_fleet_swap(models, data, tmp_path, monkeypatch):
+    """A fleet swap rolls replica-by-replica from a shared persisted
+    path; a mid-roll fault halts typed with every remaining rank still
+    on the prior version."""
+    p1, p2 = str(tmp_path / "v1"), str(tmp_path / "v2")
+    models[0].write().overwrite().save(p1)
+    models[1].write().overwrite().save(p2)
+    kw = {"batch_window_us": 5_000, "max_bucket_rows": 64}
+    with Router(replicas=2, runtime_kwargs=kw) as router:
+        router.load("pca", p1)
+        assert router.fleet_versions("pca") == [1, 1]
+        before = router.predict("pca", data[:17], timeout=180)
+        results = router.swap("pca", p2)
+        assert len(results) == 2
+        assert router.fleet_versions("pca") == [2, 2]
+        after = router.predict("pca", data[:17], timeout=180)
+        for col in before:  # same data+params: flip is invisible
+            assert np.array_equal(before[col], after[col])
+        # mid-roll fault at replica 0's warm stage: roll halts typed,
+        # both replicas keep the version they had
+        monkeypatch.setenv("TPUML_FAULT_SPEC", "swap:warm:0:raise")
+        faults.reset_faults()
+        with pytest.raises(SwapError, match="replica 0"):
+            router.swap("pca", p2)
+        assert router.fleet_versions("pca") == [2, 2]
+        assert router.predict("pca", data[:5], timeout=180)
+
+
+# --- defaults stay inert ---------------------------------------------------
+
+
+def test_defaults_inert_no_lifecycle(models, data):
+    """No lifecycle object constructed => no lifecycle thread, no
+    shadow route, and none of the lifecycle metric series exist."""
+    with ServingRuntime(batch_window_us=5_000, max_bucket_rows=64) as rt:
+        rt.register("pca", models[0])
+        rt.predict("pca", data[:17], timeout=180)
+        assert rt.shadow_routes() == {}
+    assert not any(
+        t.name.startswith("tpuml-lifecycle") for t in threading.enumerate()
+    )
+    snap = telemetry.metrics_snapshot()
+    for metric in LIFECYCLE_METRICS:
+        assert not (snap.get(metric) or {}).get("series"), metric
