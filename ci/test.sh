@@ -14,6 +14,12 @@ python -m compileall -q spark_rapids_ml_tpu benchmark tests tpuml_lint bench.py 
 # TPU/JAX invariants + env-var registry/doc drift. Rule catalog and
 # suppression syntax: docs/static_analysis.md.
 python -m tpuml_lint spark_rapids_ml_tpu benchmark tests scripts ci bench.py benchmark_runner.py
+# concurrency-correctness rules, explicitly against an empty baseline:
+# the lock-hierarchy (TPU010), blocking-under-lock (TPU011) and
+# thread-lifecycle (TPU012) findings must be zero — fixed, never
+# grandfathered (runtime/lockspec.py is the declared hierarchy)
+python -m tpuml_lint spark_rapids_ml_tpu benchmark tests scripts ci bench.py benchmark_runner.py \
+    --no-baseline --rule TPU010 --rule TPU011 --rule TPU012
 python scripts/gen_config_docs.py --check
 if python -c "import black" 2>/dev/null; then
     python -m black --check spark_rapids_ml_tpu tests benchmark
@@ -1433,6 +1439,95 @@ with ServingRuntime(batch_window_us=5000, max_bucket_rows=64) as rt:
 print("lifecycle chaos smoke OK: scheduled re-fit hot-swapped with zero "
       "sheds, injected swap fault typed + rolled past, divergent canary "
       "rolled back with breaker open")
+EOF
+
+echo "== lock-witness chaos smoke =="
+# The whole stack — serving burst + scheduler re-fit + lifecycle
+# hot-swap + canary — under TPUML_LOCK_WITNESS=1: every cataloged lock
+# is an instrumented wrapper checking the runtime/lockspec.py rank
+# hierarchy on the REAL cross-thread acquisition orders (client
+# threads, the dispatcher, the fit loop, canary scoring). The contract:
+# zero lock-order violations, zero retrace storms, and the hold-time
+# histogram populated for the data-plane locks the burst exercised.
+JAX_PLATFORMS=cpu TPUML_LOCK_WITNESS=1 python - <<'EOF'
+import threading
+import time
+
+import numpy as np
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.runtime import FitScheduler, lockwitness, telemetry
+from spark_rapids_ml_tpu.serving import ModelLifecycle, ServingRuntime
+
+telemetry.reset_telemetry()
+lockwitness.reset_lockwitness()
+assert lockwitness.active(), "witness not armed"
+rng = np.random.default_rng(23)
+X = rng.normal(size=(512, 8)).astype(np.float32)
+df = DataFrame({"features": X})
+queries = [rng.normal(size=(s, 8)).astype(np.float32) for s in (3, 17, 33)]
+
+def totals(name):
+    s = telemetry.metrics_snapshot().get(name)
+    return sum(row["value"] for row in s["series"]) if s else 0
+
+with ServingRuntime(batch_window_us=5000, max_bucket_rows=64) as rt:
+    rt.register("pca", PCA(k=4).fit(df))
+    with FitScheduler() as sched:
+        lc = ModelLifecycle(rt, scheduler=sched)
+        stop, errors = threading.Event(), []
+        def client(i0):
+            i = i0
+            while not stop.is_set():
+                try:
+                    rt.predict("pca", queries[i % 3], timeout=300)
+                except Exception as e:
+                    errors.append(e)
+                    return
+                i += 1
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            # a scheduled re-fit hot-swapped under the burst, then a
+            # promoting canary — the full cross-subsystem lock surface
+            v2 = sched.submit(
+                PCA(k=4), df, tenant="lifecycle", priority=-1,
+                aging_ms=600000.0,
+            ).result(300)
+            lc.swap("pca", model=v2)
+            lc.start_canary(
+                "pca", model=v2, fraction=1.0, min_requests=4
+            )
+            deadline = time.monotonic() + 60
+            while (lc.canary_in_progress("pca")
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert not lc.canary_in_progress("pca"), "canary never settled"
+            time.sleep(0.3)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(60)
+        assert not errors, f"typed shed under witness: {errors[0]!r}"
+        lc.drain(timeout=30)
+
+viol = lockwitness.violations()
+assert viol == (), f"lock-order violations on real paths: {viol}"
+assert totals("lock_order_violations_total") == 0
+assert totals("retrace_storms") == 0
+held = {
+    row.get("labels", {}).get("lock")
+    for row in telemetry.metrics_snapshot()["lock_hold_ms"]["series"]
+}
+assert "serving.state" in held, held
+print("lock-witness chaos smoke OK: serving burst + scheduled re-fit + "
+      "hot-swap + canary under TPUML_LOCK_WITNESS=1 — zero lock-order "
+      "violations, zero retrace storms, hold histograms for "
+      f"{len(held)} lock(s)")
 EOF
 
 echo "CI OK"

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import threading
 import time
+
+from . import lockwitness
 from typing import Callable, Dict, Optional, Tuple
 
 # breaker states (the gauge values both planes export)
@@ -75,7 +77,7 @@ class ServiceEwma:
 
     def __init__(self, alpha: float = EWMA_ALPHA) -> None:
         self.alpha = float(alpha)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("admission.ewma")
         self._ewma: Dict[str, Tuple[float, float]] = {}
 
     def note(self, key: str, service_s: float, n_items: int = 1) -> None:
@@ -134,7 +136,7 @@ class CircuitBreaker:
         self._state = CLOSED
         self._consecutive = 0
         self._opened_at = 0.0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("admission.breaker")
 
     @property
     def enabled(self) -> bool:

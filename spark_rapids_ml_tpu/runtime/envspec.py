@@ -891,6 +891,20 @@ SPEC: Dict[str, EnvVar] = _registry(
         exclusive_minimum=0, category="observability",
         also_documented_in=("docs/observability.md",),
     ),
+    EnvVar(
+        "TPUML_LOCK_WITNESS", "choice", "off",
+        "Runtime lock-order witness (`runtime/lockwitness.py`): `1` "
+        "(alias `count`) makes every cataloged lock constructed after "
+        "this point an instrumented wrapper that checks the "
+        "`runtime/lockspec.py` rank hierarchy at each acquire, counts "
+        "violations in `lock_order_violations_total`, and exports "
+        "per-lock `lock_hold_ms`/`lock_wait_ms` histograms; `raise` "
+        "escalates the first occurrence of each violation to an "
+        "exception. `off` (the default) constructs raw `threading` "
+        "primitives — zero overhead, no metric series.",
+        choices=("off", "1", "count", "raise"), category="observability",
+        also_documented_in=("docs/observability.md",),
+    ),
 )
 
 

@@ -33,7 +33,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from . import envspec
+from . import envspec, lockwitness
 
 SITES = (
     "ingest:chunk", "sgd:epoch", "init:connect",
@@ -126,7 +126,7 @@ class FaultInjector:
 
     def __init__(self, spec: str) -> None:
         self.spec = spec
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("faults.plan")
         self._hits: Dict[str, int] = {}
         # site -> {index: action}; later entries for the same (site, index)
         # win, matching "last setting wins" env semantics.
@@ -162,7 +162,7 @@ class FaultInjector:
         raise InjectedFault(f"injected fault at {site}[{n}]")
 
 
-_cache_lock = threading.Lock()
+_cache_lock = lockwitness.make_lock("faults.cache")
 _cached: Optional[Tuple[str, Optional[FaultInjector]]] = None
 
 

@@ -494,6 +494,32 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "was already in progress or the version breaker was open.",
         labels=("model", "outcome"),
     ),
+    # --- lock-order witness (runtime/lockwitness.py, PR 19) ---------------
+    MetricSpec(
+        "lock_order_violations_total", "counter",
+        "Distinct lock-order violations observed by the runtime "
+        "witness (`TPUML_LOCK_WITNESS`): a rank inversion against the "
+        "`runtime/lockspec.py` hierarchy or an acquisition cycle, "
+        "labeled by the held and the acquired lock's cataloged names. "
+        "Each distinct (held, acquired) pair counts exactly once per "
+        "process; both label sets are closed by the lock catalog.",
+        labels=("held", "acquired"),
+    ),
+    MetricSpec(
+        "lock_hold_ms", "histogram",
+        "Milliseconds a cataloged lock was held, per release, labeled "
+        "by the lock's `lockspec` name. Only recorded while the "
+        "witness is active — the series answer \"whose critical "
+        "section is long\" on `/statusz`.",
+        labels=("lock",),
+    ),
+    MetricSpec(
+        "lock_wait_ms", "histogram",
+        "Milliseconds an acquire blocked before getting a cataloged "
+        "lock, labeled by the lock's `lockspec` name — the direct "
+        "contention measurement next to `lock_hold_ms`.",
+        labels=("lock",),
+    ),
 )
 
 

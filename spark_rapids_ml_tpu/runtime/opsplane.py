@@ -59,7 +59,7 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
-from . import envspec, slo, telemetry
+from . import envspec, lockwitness, slo, telemetry
 
 _LOGGER = logging.getLogger("spark_rapids_ml_tpu")
 
@@ -78,7 +78,7 @@ __all__ = [
 ]
 
 
-_LOCK = threading.RLock()
+_LOCK = lockwitness.make_rlock("opsplane.plane")
 _STARTED = False
 _RECORDER: Optional["FlightRecorder"] = None
 _SERVER: Optional[ThreadingHTTPServer] = None
@@ -117,7 +117,7 @@ class FlightRecorder:
     """
 
     def __init__(self, max_events: int) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("opsplane.flight")
         self._events: Deque[Dict[str, Any]] = deque(maxlen=int(max_events))
         self._threads: Dict[int, str] = {}
         self.dumps: Dict[str, int] = {}
@@ -223,7 +223,7 @@ class _SloEvaluator(threading.Thread):
         self._period = float(period_s)
         self._threshold = float(threshold)
         self._halt = threading.Event()
-        self._state_lock = threading.Lock()
+        self._state_lock = lockwitness.make_lock("opsplane.slo")
         self._prev: Optional[Dict[str, Any]] = None
         self._ticks: Dict[str, Deque[Tuple[float, bool]]] = {
             s.name: deque(maxlen=self.MAX_TICKS) for s in slo.CATALOG

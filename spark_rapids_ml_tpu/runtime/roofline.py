@@ -43,7 +43,7 @@ import logging
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-from . import envspec
+from . import envspec, lockwitness
 
 _LOGGER = logging.getLogger("spark_rapids_ml_tpu")
 
@@ -89,7 +89,7 @@ _PEAK_HBM_GBPS_BY_KIND: Tuple[Tuple[str, float], ...] = (
 _CPU_PEAK_FLOPS = 1e12
 _CPU_PEAK_HBM_GBPS = 100.0
 
-_PEAK_LOCK = threading.Lock()
+_PEAK_LOCK = lockwitness.make_lock("roofline.peaks")
 _PEAK_CACHE: Optional[Tuple[float, float, int]] = None
 
 
@@ -139,7 +139,7 @@ def peak_specs() -> Tuple[float, float, int]:
 # compile-time capture
 # --------------------------------------------------------------------------
 
-_LOCK = threading.Lock()
+_LOCK = lockwitness.make_lock("roofline.state")
 _INSTALLED = False
 _ORIG_BACKEND_COMPILE: Any = None
 # site -> [flops_per_call, bytes_per_call, n_programs]

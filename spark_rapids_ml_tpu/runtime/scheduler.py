@@ -59,7 +59,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple, Union
 
-from . import envspec, faults, telemetry
+from . import envspec, faults, lockwitness, telemetry
 from .admission import (
     CircuitBreaker,
     DeadlineExceeded,
@@ -217,9 +217,11 @@ class FitScheduler:
         self._default_deadline_s = (
             None if default_deadline_ms is None else default_deadline_ms / 1e3
         )
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
-        self._block = threading.Lock()  # breaker map (submit holds _lock)
+        self._lock = lockwitness.make_lock("scheduler.state")
+        self._cv = lockwitness.make_condition(
+            "scheduler.state", lock=self._lock
+        )
+        self._block = lockwitness.make_lock("scheduler.breakers")
         self._backlog: List[_Job] = []
         self._inflight: List[_Job] = []
         self._thread: Optional[threading.Thread] = None

@@ -60,7 +60,7 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
-from . import envspec, metricspec
+from . import envspec, lockwitness, metricspec
 
 _LOGGER = logging.getLogger("spark_rapids_ml_tpu")
 
@@ -137,7 +137,7 @@ def _process_index() -> int:
 
 # RLock: _Hist.quantile locks its ring copy, and the exporters call it
 # while already holding the registry lock
-_MLOCK = threading.RLock()
+_MLOCK = lockwitness.make_rlock("telemetry.metrics")
 _METRICS: Dict[str, "_Metric"] = {}
 
 
@@ -314,7 +314,7 @@ _CURRENT: "contextvars.ContextVar[Optional[_Span]]" = contextvars.ContextVar(
 )
 _IDS = itertools.count(1)
 
-_RLOCK = threading.Lock()
+_RLOCK = lockwitness.make_lock("telemetry.trace")
 _EPOCH: Optional[float] = None  # perf_counter origin of trace timestamps
 _EVENTS: List[Dict[str, Any]] = []  # chrome-trace "X" events
 _PENDING_LINES: List[str] = []  # jsonl lines not yet appended to disk
@@ -1000,7 +1000,7 @@ def aggregate_metrics() -> Dict[str, Any]:
 # --------------------------------------------------------------------------
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-_WD_LOCK = threading.Lock()
+_WD_LOCK = lockwitness.make_lock("telemetry.watchdog")
 _WD_INSTALLED = False
 _WD_CHECKED = False
 _WD_COUNTS: Dict[str, int] = {}

@@ -35,7 +35,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
-from ..runtime import envspec, telemetry
+from ..runtime import envspec, lockwitness, telemetry
 from ..runtime.admission import (
     CLOSED,
     EWMA_ALPHA as _ALPHA,
@@ -106,7 +106,7 @@ class AdmissionController:
             envspec.get("TPUML_SERVE_BREAKER_COOLDOWN_MS")
             if breaker_cooldown_ms is None else breaker_cooldown_ms
         ) / 1e3
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("admission.controller")
         self._breakers: Dict[str, CircuitBreaker] = {}
         # per-model EWMA of (batch service seconds, requests per batch):
         # estimated wait = queued requests / reqs-per-batch * service

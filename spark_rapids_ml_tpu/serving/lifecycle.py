@@ -47,7 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, Set
 
 import numpy as np
 
-from ..runtime import envspec, opsplane, telemetry
+from ..runtime import envspec, lockwitness, opsplane, telemetry
 from ..runtime.admission import CLOSED, CircuitBreaker
 from .registry import ResidentModel
 from .runtime import ServingRuntime
@@ -97,7 +97,9 @@ class _Canary:
     score: Optional[float] = None
     scored: bool = False
     done: bool = False
-    lock: threading.Lock = field(default_factory=threading.Lock)
+    lock: Any = field(
+        default_factory=lambda: lockwitness.make_lock("lifecycle.canary")
+    )
 
 
 @dataclass
@@ -113,7 +115,9 @@ class _DriftState:
     reference: Optional[np.ndarray] = None
     windows_scored: int = 0
     last_psi: Optional[float] = None
-    lock: threading.Lock = field(default_factory=threading.Lock)
+    lock: Any = field(
+        default_factory=lambda: lockwitness.make_lock("lifecycle.drift")
+    )
 
 
 def _hist_probs(vals: np.ndarray, edges: np.ndarray) -> np.ndarray:
@@ -188,7 +192,7 @@ class ModelLifecycle:
         # SLO-burn tripwire: names of currently-alerting SLOs. The
         # default reads the live ops plane; tests inject their own.
         self._burn_probe = burn_probe
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("lifecycle.manager")
         self._canaries: Dict[str, _Canary] = {}
         self._drift: Dict[str, _DriftState] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}

@@ -28,7 +28,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ..runtime import envspec, faults, opsplane, telemetry
+from ..runtime import envspec, faults, lockwitness, opsplane, telemetry
 
 _LOGGER = logging.getLogger("spark_rapids_ml_tpu.serving")
 
@@ -327,7 +327,7 @@ class ModelRegistry:
         # round down to a power of two so the ladder is exactly the
         # pow2 range [MIN_BUCKET_ROWS, max]
         self._max_bucket = max(MIN_BUCKET_ROWS, 1 << (raw.bit_length() - 1))
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("registry.models")
         self._entries: "OrderedDict[str, ResidentModel]" = OrderedDict()
         self._paths: Dict[str, str] = {}
         # last version ever assigned per name — survives eviction so a

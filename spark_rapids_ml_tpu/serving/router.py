@@ -60,7 +60,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..parallel.replica import ReplicaGroup, replica_groups
-from ..runtime import envspec, opsplane, telemetry
+from ..runtime import envspec, lockwitness, opsplane, telemetry
 from ..runtime.admission import (
     AdmissionError,
     CircuitBreaker,
@@ -224,8 +224,8 @@ class SubprocessReplica:
             env=penv,
         )
         self._pending: Dict[int, "Future[Any]"] = {}
-        self._plock = threading.Lock()
-        self._wlock = threading.Lock()
+        self._plock = lockwitness.make_lock("router.replica_proc")
+        self._wlock = lockwitness.make_lock("router.replica_wire")
         self._next_id = 0
         self._closed = False
         self._hello: "Future[Dict[str, Any]]" = Future()
@@ -472,7 +472,7 @@ class Router:
         # rotating pair covers all replicas like a random pair does in
         # expectation, without making tests flaky)
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("router.fleet")
         self._closed = False
         telemetry.gauge("fleet_replicas").set(len(self.replicas))
         opsplane.track_router(self)

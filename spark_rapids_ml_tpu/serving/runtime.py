@@ -59,7 +59,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..runtime import envspec, faults, opsplane, retry, telemetry
+from ..runtime import envspec, faults, lockwitness, opsplane, retry, telemetry
 from .admission import (
     AdmissionController,
     DeadlineExceeded,
@@ -115,7 +115,9 @@ class _ShadowRoute:
     # request resolve; a failed side passes None
     on_pair: Optional[Any] = None
     count: int = 0
-    lock: threading.Lock = field(default_factory=threading.Lock)
+    lock: Any = field(
+        default_factory=lambda: lockwitness.make_lock("serving.shadow")
+    )
 
     def take(self) -> bool:
         """Deterministic request picker: mirror request n exactly when
@@ -189,11 +191,11 @@ class ServingRuntime:
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         self._draining = False
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("serving.state")
         # outstanding (admitted, unresolved) requests; the condition
         # lets drain() wait for the dispatcher to finish in-flight work
         self._pending = 0
-        self._idle = threading.Condition()
+        self._idle = lockwitness.make_condition("serving.idle")
         self._inflight: List[_Request] = []
         self._last_beat: Optional[float] = None
         # lifecycle hooks, both empty (and cost-free) by default:

@@ -34,6 +34,8 @@ try:
 except Exception:  # older jax without the knobs
     pass
 
+import threading  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -61,6 +63,42 @@ def pytest_addoption(parser):
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: mark test as slow")
     config.addinivalue_line("markers", "compat: CPU-oracle equivalence test")
+    config.addinivalue_line(
+        "markers",
+        "allow_threads: test intentionally leaves named threads running",
+    )
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_sanitizer(request):
+    """Fail any test that leaks a live non-daemon thread.
+
+    Snapshot-diff by thread name around each test: a non-daemon thread
+    still alive afterwards means a missed ``close()``/``drain()`` —
+    exactly the leak that hangs interpreter exit in production and
+    bleeds scheduler/serving state into the next test. Daemon threads
+    get a short grace join (dispatcher loops observe their shutdown
+    flag within a tick) and are tolerated if still winding down —
+    TPU012 already guarantees they cannot block exit. Opt out with
+    ``@pytest.mark.allow_threads`` and a reason in the test body.
+    """
+    before = {t.name for t in threading.enumerate()}
+    yield
+    if request.node.get_closest_marker("allow_threads"):
+        return
+    leaked = [
+        t
+        for t in threading.enumerate()
+        if t.is_alive() and not t.daemon and t.name not in before
+    ]
+    for t in leaked:
+        t.join(timeout=2.0)
+    leaked = [t for t in leaked if t.is_alive()]
+    assert not leaked, (
+        "test leaked live non-daemon thread(s): "
+        f"{sorted(t.name for t in leaked)} — close/drain the owner, or "
+        "mark the test @pytest.mark.allow_threads with a reason"
+    )
 
 
 def pytest_collection_modifyitems(config, items):

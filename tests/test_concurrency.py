@@ -1,0 +1,505 @@
+"""Concurrency-correctness suite: the runtime lock-order witness
+(seeded rank inversion reported exactly once, cross-thread cycle
+detection, hold/wait histograms under a contended serving burst, the
+defaults-inert contract), the TPU010/011/012 lint rules on good and bad
+fixtures, and the thread-leak sanitizer's own escape hatch.
+"""
+
+import ast
+import os
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.models.feature import PCA
+from spark_rapids_ml_tpu.runtime import lockwitness, telemetry
+from spark_rapids_ml_tpu.serving import ServingRuntime
+from tpuml_lint import (
+    tpu010_lock_order,
+    tpu011_block_under_lock,
+    tpu012_thread_lifecycle,
+)
+from tpuml_lint.core import SourceFile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N, D = 80, 6
+SEED = 13
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    lockwitness.reset_lockwitness()
+    telemetry.reset_telemetry()
+    yield
+    lockwitness.reset_lockwitness()
+    telemetry.reset_telemetry()
+
+
+@pytest.fixture(scope="module")
+def fitted_pca():
+    rng = np.random.default_rng(SEED)
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    return PCA(k=3).fit(DataFrame({"features": X})), X
+
+
+def _totals(name):
+    snap = telemetry.metrics_snapshot()
+    m = snap.get(name)
+    if m is None:
+        return 0.0
+    out = 0.0
+    for s in m["series"]:
+        out += s.get("value", s.get("count", 0.0))
+    return out
+
+
+def _series_labels(name):
+    snap = telemetry.metrics_snapshot()
+    m = snap.get(name)
+    if m is None:
+        return []
+    return [s.get("labels", {}) for s in m["series"]]
+
+
+# --- witness: detection ----------------------------------------------------
+
+
+def test_seeded_inversion_reported_exactly_once(monkeypatch):
+    """A worker thread acquiring rank-40 under rank-50, three times:
+    one violation pair, one counter increment, never re-reported."""
+    monkeypatch.setenv("TPUML_LOCK_WITNESS", "1")
+    outer = lockwitness.make_rlock("registry.models")  # rank 50
+    inner = lockwitness.make_lock("serving.state")  # rank 40
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(3):
+                with outer:
+                    with inner:
+                        pass
+        except Exception as e:  # count mode must never raise
+            errors.append(e)
+
+    t = threading.Thread(target=worker, name="tpuml-test-invert",
+                         daemon=True)
+    t.start()
+    t.join(10)
+    assert not errors
+    assert lockwitness.violations() == (
+        ("registry.models", "serving.state"),
+    )
+    assert _totals("lock_order_violations_total") == 1.0
+    labels = _series_labels("lock_order_violations_total")
+    assert labels == [
+        {"held": "registry.models", "acquired": "serving.state"}
+    ]
+
+
+def test_cross_thread_cycle_detected(monkeypatch):
+    """Each thread's own order ascends a different way: T1 takes
+    40 -> 42, T2 takes 42 only ever after 40 is *not* held... the cycle
+    arises from the union of edges. Seed 40->42 on one thread, then
+    42->40 on another: the second edge closes a cycle and is reported
+    even though the rank check already fires for it; the pair set is
+    still deduped to that single offending edge."""
+    monkeypatch.setenv("TPUML_LOCK_WITNESS", "1")
+    a = lockwitness.make_lock("serving.state")  # rank 40
+    b = lockwitness.make_lock("serving.shadow")  # rank 42
+
+    def t1():
+        with a:
+            with b:  # ascending: legal, adds edge 40->42
+                pass
+
+    def t2():
+        with b:
+            with a:  # inversion AND cycle with t1's edge
+                pass
+
+    th1 = threading.Thread(target=t1, name="tpuml-test-c1", daemon=True)
+    th1.start()
+    th1.join(10)
+    assert lockwitness.violations() == ()
+    th2 = threading.Thread(target=t2, name="tpuml-test-c2", daemon=True)
+    th2.start()
+    th2.join(10)
+    assert lockwitness.violations() == (
+        ("serving.shadow", "serving.state"),
+    )
+
+
+def test_raise_mode_raises_and_does_not_leak(monkeypatch):
+    monkeypatch.setenv("TPUML_LOCK_WITNESS", "raise")
+    outer = lockwitness.make_rlock("registry.models")
+    inner = lockwitness.make_lock("serving.state")
+    with outer:
+        with pytest.raises(lockwitness.LockOrderError):
+            with inner:
+                pass
+    # the failed acquire must have released the inner lock: a plain
+    # (now-legal) acquisition succeeds immediately
+    with inner:
+        pass
+    assert not inner.locked()
+
+
+def test_condition_wait_is_not_an_inversion(monkeypatch):
+    """Condition.wait releases the lock — waiting with a lower-rank
+    lock outstanding on another thread must not be misread as holding
+    through the block."""
+    monkeypatch.setenv("TPUML_LOCK_WITNESS", "1")
+    cv = lockwitness.make_condition("serving.idle")
+    done = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=0.05)
+        done.append(True)
+
+    t = threading.Thread(target=waiter, name="tpuml-test-wait",
+                         daemon=True)
+    t.start()
+    t.join(10)
+    assert done and lockwitness.violations() == ()
+
+
+def test_unknown_name_fails_loudly_in_both_modes(monkeypatch):
+    monkeypatch.delenv("TPUML_LOCK_WITNESS", raising=False)
+    with pytest.raises(ValueError, match="lockspec"):
+        # deliberately uncataloged: the runtime rejection under test
+        lockwitness.make_lock("not.in.catalog")  # tpuml: ignore[TPU010]
+    monkeypatch.setenv("TPUML_LOCK_WITNESS", "1")
+    with pytest.raises(ValueError, match="lockspec"):
+        lockwitness.make_lock("not.in.catalog")  # tpuml: ignore[TPU010]
+    # kind mismatch too: serving.state is cataloged as a plain lock
+    with pytest.raises(ValueError, match="cataloged as"):
+        lockwitness.make_rlock("serving.state")  # tpuml: ignore[TPU010]
+
+
+# --- witness: hold/wait histograms under a real serving burst --------------
+
+
+def test_contended_serving_burst_exports_hold_histograms(
+    fitted_pca, monkeypatch
+):
+    """A multi-client predict burst through a witnessed ServingRuntime:
+    zero violations on the real acquisition orders, and the hold-time
+    histogram carries per-lock series for the locks the data plane
+    actually took."""
+    monkeypatch.setenv("TPUML_LOCK_WITNESS", "1")
+    model, X = fitted_pca
+    rng = np.random.default_rng(5)
+    with ServingRuntime(batch_window_us=2_000, max_bucket_rows=32) as rt:
+        rt.register("pca", model)
+        futs = []
+
+        def client():
+            for _ in range(8):
+                q = rng.normal(size=(3, D)).astype(np.float32)
+                futs.append(rt.predict_async("pca", q))
+
+        threads = [
+            threading.Thread(target=client, name=f"tpuml-test-cli{i}",
+                             daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        for f in list(futs):
+            f.result(60)
+    assert lockwitness.violations() == ()
+    assert _totals("lock_order_violations_total") == 0.0
+    held_locks = {
+        s.get("lock") for s in _series_labels("lock_hold_ms")
+    }
+    assert "serving.state" in held_locks
+    assert _totals("lock_hold_ms") > 0.0
+
+
+# --- defaults inert --------------------------------------------------------
+
+
+def test_defaults_inert_raw_primitives(monkeypatch):
+    monkeypatch.delenv("TPUML_LOCK_WITNESS", raising=False)
+    assert not lockwitness.active()
+    lk = lockwitness.make_lock("serving.state")
+    rlk = lockwitness.make_rlock("registry.models")
+    cv = lockwitness.make_condition("serving.idle")
+    assert type(lk) is type(threading.Lock())
+    assert type(rlk) is type(threading.RLock())
+    assert isinstance(cv, threading.Condition)
+    # the shared-lock form unwraps to a Condition over the raw lock
+    cv2 = lockwitness.make_condition("scheduler.state", lock=lk)
+    assert isinstance(cv2, threading.Condition)
+
+
+def test_defaults_inert_no_metric_series(fitted_pca, monkeypatch):
+    monkeypatch.delenv("TPUML_LOCK_WITNESS", raising=False)
+    model, X = fitted_pca
+    with ServingRuntime(batch_window_us=0, max_bucket_rows=32) as rt:
+        rt.register("pca", model)
+        rt.predict("pca", X[:4], timeout=60)
+    snap = telemetry.metrics_snapshot()
+    for name in ("lock_order_violations_total", "lock_hold_ms",
+                 "lock_wait_ms"):
+        assert name not in snap, f"{name} series exist with witness off"
+
+
+def test_witness_outputs_bit_identical(fitted_pca, monkeypatch):
+    """The witness observes; it must never perturb served bits."""
+    model, X = fitted_pca
+    q = X[:5]
+
+    def serve():
+        with ServingRuntime(batch_window_us=0, max_bucket_rows=32) as rt:
+            rt.register("pca", model)
+            return rt.predict("pca", q, timeout=60)
+
+    monkeypatch.delenv("TPUML_LOCK_WITNESS", raising=False)
+    off = serve()
+    monkeypatch.setenv("TPUML_LOCK_WITNESS", "1")
+    on = serve()
+    assert lockwitness.violations() == ()
+    assert set(off) == set(on)
+    for col in off:
+        assert np.array_equal(off[col], on[col]), col
+
+
+# --- lint rules: TPU010 / TPU011 / TPU012 fixtures -------------------------
+
+
+def _lint_file(rule, code, path):
+    text = textwrap.dedent(code)
+    sf = SourceFile(path=path, abspath="/" + path, text=text,
+                    tree=ast.parse(text))
+    return [f for f in rule.check_file(sf) if not sf.suppressed(f)]
+
+
+def _lint_project(rule, code, path):
+    text = textwrap.dedent(code)
+    sf = SourceFile(path=path, abspath="/" + path, text=text,
+                    tree=ast.parse(text))
+    return [
+        f for f in rule.check_project([sf], REPO_ROOT)
+        if not sf.suppressed(f)
+    ]
+
+
+def test_tpu010_flags_descending_and_self_nesting():
+    findings = _lint_project(tpu010_lock_order, """
+        from spark_rapids_ml_tpu.runtime import lockwitness
+
+        class S:
+            def __init__(self):
+                self._hi = lockwitness.make_rlock("registry.models")
+                self._lo = lockwitness.make_lock("serving.state")
+
+            def bad_order(self):
+                with self._hi:
+                    with self._lo:
+                        pass
+
+            def bad_self(self):
+                with self._lo:
+                    with self._lo:
+                        pass
+
+            def good(self):
+                with self._lo:
+                    with self._hi:
+                        pass
+    """, "pkg/mod.py")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 2
+    assert any("rank 40" in m and "rank 50" in m for m in msgs)
+    assert any("deadlocks" in m for m in msgs)
+
+
+def test_tpu010_flags_raw_lock_in_scope_only():
+    code = """
+        import threading
+        _LOCK = threading.Lock()
+    """
+    scoped = _lint_project(
+        tpu010_lock_order, code, "spark_rapids_ml_tpu/runtime/x.py"
+    )
+    assert len(scoped) == 1 and "lockwitness" in scoped[0].message
+    unscoped = _lint_project(tpu010_lock_order, code, "pkg/mod.py")
+    assert unscoped == []
+
+
+def test_tpu010_flags_unknown_name_and_kind_mismatch():
+    findings = _lint_project(tpu010_lock_order, """
+        from spark_rapids_ml_tpu.runtime import lockwitness
+        a = lockwitness.make_lock("no.such.lock")
+        b = lockwitness.make_rlock("serving.state")
+    """, "pkg/mod.py")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 2
+    assert any("not declared" in m for m in msgs)
+    assert any("cataloged as a lock" in m for m in msgs)
+
+
+def test_tpu010_suppression_honoured():
+    findings = _lint_project(tpu010_lock_order, """
+        from spark_rapids_ml_tpu.runtime import lockwitness
+        # tpuml: ignore[TPU010]
+        a = lockwitness.make_lock("no.such.lock")
+    """, "pkg/mod.py")
+    assert findings == []
+
+
+def test_tpu011_flags_blocking_calls_under_lock():
+    findings = _lint_project(tpu011_block_under_lock, """
+        import time
+        from spark_rapids_ml_tpu.runtime import lockwitness
+
+        class S:
+            def __init__(self, q):
+                self._lock = lockwitness.make_lock("serving.state")
+                self._q = q
+
+            def bad(self, fut, model, x, th):
+                with self._lock:
+                    time.sleep(0.1)
+                    fut.result()
+                    model.predict(x)
+                    self._q.get()
+                    th.join()
+
+            def good(self, fut):
+                snapshot = None
+                with self._lock:
+                    snapshot = self._q
+                fut.result()
+                time.sleep(0.0)
+    """, "pkg/mod.py")
+    assert len(findings) == 5
+    assert all("blocking call under lock" in f.message for f in findings)
+
+
+def test_tpu011_does_not_flag_condition_wait_or_path_join():
+    findings = _lint_project(tpu011_block_under_lock, """
+        import os
+        from spark_rapids_ml_tpu.runtime import lockwitness
+
+        class S:
+            def __init__(self):
+                self._lock = lockwitness.make_lock("scheduler.state")
+                self._cv = lockwitness.make_condition(
+                    "scheduler.state", lock=self._lock
+                )
+
+            def ok(self):
+                with self._cv:
+                    self._cv.wait(timeout=0.1)
+                with self._lock:
+                    p = os.path.join("a", "b")
+                    s = ",".join(["x"])
+    """, "pkg/mod.py")
+    assert findings == []
+
+
+def test_tpu012_flags_unnamed_nondaemon_unowned_threads():
+    findings = _lint_file(tpu012_thread_lifecycle, """
+        import threading
+
+        def spawn():
+            t = threading.Thread(target=lambda: None)
+            t.start()
+    """, "spark_rapids_ml_tpu/runtime/x.py")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 3
+    assert any("daemon=True" in m for m in msgs)
+    assert any("name=" in m for m in msgs)
+    assert any("teardown" in m for m in msgs)
+
+
+def test_tpu012_accepts_owned_daemon_named_thread():
+    findings = _lint_file(tpu012_thread_lifecycle, """
+        import threading
+
+        class Owner:
+            def start(self):
+                self._t = threading.Thread(
+                    target=self._loop, name="tpuml-x", daemon=True
+                )
+                self._t.start()
+
+            def close(self):
+                self._t.join()
+    """, "spark_rapids_ml_tpu/runtime/x.py")
+    assert findings == []
+
+
+def test_tpu012_accepts_finally_teardown_and_subclass():
+    findings = _lint_file(tpu012_thread_lifecycle, """
+        import threading
+
+        def stream():
+            cancel = threading.Event()
+            t = threading.Thread(target=run, name="tpuml-s", daemon=True)
+            t.start()
+            try:
+                yield 1
+            finally:
+                cancel.set()
+
+        class Eval(threading.Thread):
+            def __init__(self):
+                super().__init__(name="tpuml-eval", daemon=True)
+
+            def halt(self):
+                pass
+    """, "spark_rapids_ml_tpu/runtime/x.py")
+    assert findings == []
+
+
+def test_tpu012_flags_bad_subclass_and_ignores_tests():
+    code = """
+        import threading
+
+        class W(threading.Thread):
+            def __init__(self):
+                super().__init__()
+    """
+    findings = _lint_file(
+        tpu012_thread_lifecycle, code, "spark_rapids_ml_tpu/runtime/x.py"
+    )
+    assert len(findings) == 3
+    assert _lint_file(
+        tpu012_thread_lifecycle, code, "tests/test_x.py"
+    ) == []
+
+
+# --- thread-leak sanitizer -------------------------------------------------
+
+
+@pytest.mark.allow_threads
+def test_leak_sanitizer_escape_hatch():
+    """The marker must bypass the autouse assertion — this test leaves
+    a (short-lived) non-daemon thread alive on purpose and relies on
+    the marker to be allowed to."""
+    ev = threading.Event()
+    t = threading.Thread(
+        target=ev.wait, args=(5.0,), name="tpuml-test-leak"
+    )
+    t.start()
+    assert t.is_alive() and not t.daemon
+    # release it promptly so it cannot outlive the module
+    ev.set()
+
+
+def test_leak_sanitizer_joins_finished_threads():
+    """A non-daemon thread that finishes its work passes the sanitizer
+    without the marker: the snapshot diff joins and tolerates it."""
+    t = threading.Thread(target=lambda: None, name="tpuml-test-done")
+    t.start()
+    t.join(5)

@@ -655,6 +655,21 @@ def test_lint_fails_on_each_rule(tmp_path):
             "from spark_rapids_ml_tpu.runtime import telemetry\n"
             'telemetry.counter("retries").inc(request_id="r1")\n'
         ),
+        "TPU010": (
+            "from spark_rapids_ml_tpu.runtime import lockwitness\n"
+            'l = lockwitness.make_lock("not.in.the.catalog")\n'
+        ),
+        "TPU011": (
+            "import time\n"
+            "from spark_rapids_ml_tpu.runtime import lockwitness\n"
+            '_L = lockwitness.make_lock("faults.cache")\n'
+            "def f():\n"
+            "    with _L:\n"
+            "        time.sleep(1)\n"
+        ),
+        # TPU012 is scoped to spark_rapids_ml_tpu/ paths, so a tmp-file
+        # fixture cannot trip it; tests/test_concurrency.py covers it
+        # through the in-process harness with a scoped path.
     }
     for code, src in bad.items():
         p = tmp_path / f"{code.lower()}_fixture.py"

@@ -19,6 +19,9 @@ from . import (
     tpu007_metric_catalog,
     tpu008_label_cardinality,
     tpu009_inline_pspec,
+    tpu010_lock_order,
+    tpu011_block_under_lock,
+    tpu012_thread_lifecycle,
 )
 from .core import (
     Finding,
@@ -42,11 +45,14 @@ FILE_RULES = (
     tpu005_static_args,
     tpu006_lane_align,
     tpu009_inline_pspec,
+    tpu012_thread_lifecycle,
 )
 PROJECT_RULES = (
     tpu002_env_docs,
     tpu007_metric_catalog,
     tpu008_label_cardinality,
+    tpu010_lock_order,
+    tpu011_block_under_lock,
 )
 ALL_RULES = FILE_RULES + PROJECT_RULES
 

@@ -22,6 +22,9 @@ METRICSPEC_RELPATH = os.path.join(
 SLOSPEC_RELPATH = os.path.join(
     "spark_rapids_ml_tpu", "runtime", "slo.py"
 )
+LOCKSPEC_RELPATH = os.path.join(
+    "spark_rapids_ml_tpu", "runtime", "lockspec.py"
+)
 
 _cache: dict = {}
 
@@ -75,3 +78,13 @@ def load_slospec(repo_root: str) -> Optional[Any]:
     if not os.path.exists(path):
         return None
     return _load_by_path("_tpuml_lint_slospec", path)
+
+
+def load_lockspec(repo_root: str) -> Optional[Any]:
+    """The executed lock-hierarchy catalog (``runtime/lockspec.py``,
+    stdlib-only like the other registries), or None where the file does
+    not exist (bare temp fixture repos lint clean)."""
+    path = os.path.join(repo_root, LOCKSPEC_RELPATH)
+    if not os.path.exists(path):
+        return None
+    return _load_by_path("_tpuml_lint_lockspec", path)

@@ -1,0 +1,162 @@
+"""Shared lock-site resolution for the concurrency rules (TPU010/011).
+
+Both rules need the same map: which attribute / module-level name in a
+file is which cataloged lock. The map is built from the construction
+idiom the catalog enforces — every lock in ``runtime/``/``serving/`` is
+created through ``runtime.lockwitness``::
+
+    self._lock = lockwitness.make_lock("serving.state")
+    _MLOCK = lockwitness.make_rlock("telemetry.metrics")
+    self._cv = lockwitness.make_condition("scheduler.state",
+                                          lock=self._lock)
+    lock: Any = field(default_factory=lambda: make_lock("serving.shadow"))
+
+so resolution is purely lexical: ``self.X`` inside class ``C`` looks up
+the ``make_*`` assignment to ``self.X`` in ``C``; a bare module-level
+name looks up the module-level assignment. Anything else (an attribute
+on a foreign object, a subscript) resolves to None and is simply out of
+static scope — the runtime witness covers those paths.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .core import SourceFile, dotted_name, str_const
+
+MAKE_FNS = ("make_lock", "make_rlock", "make_condition")
+RAW_CTORS = ("Lock", "RLock", "Condition")
+
+#: directories whose locks must be cataloged and witness-constructed
+SCOPED_DIRS = (
+    "spark_rapids_ml_tpu/runtime/",
+    "spark_rapids_ml_tpu/serving/",
+)
+#: the factory module itself constructs raw primitives by design
+EXEMPT_FILES = ("spark_rapids_ml_tpu/runtime/lockwitness.py",)
+
+
+def in_scope(path: str) -> bool:
+    return (
+        any(path.startswith(d) for d in SCOPED_DIRS)
+        and path not in EXEMPT_FILES
+    )
+
+
+def _make_call(node: ast.AST) -> Optional[Tuple[str, ast.Call]]:
+    """(factory name, call) when ``node`` is a ``make_*`` call —
+    ``lockwitness.make_lock(...)`` or bare ``make_lock(...)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    dn = dotted_name(node.func)
+    if dn is None:
+        return None
+    leaf = dn.rsplit(".", 1)[-1]
+    if leaf in MAKE_FNS:
+        return leaf, node
+    return None
+
+
+def _raw_ctor(node: ast.AST) -> Optional[str]:
+    """'Lock'|'RLock'|'Condition' when ``node`` constructs a raw
+    threading primitive — ``threading.Lock()``, a bare imported
+    ``Lock()``, or a direct factory reference (``default_factory=
+    threading.Lock``)."""
+    target = node.func if isinstance(node, ast.Call) else node
+    dn = dotted_name(target)
+    if dn is None:
+        return None
+    head, _, leaf = dn.rpartition(".")
+    if leaf in RAW_CTORS and head in ("threading", ""):
+        return leaf
+    return None
+
+
+def _field_factory(node: ast.AST) -> Optional[ast.AST]:
+    """The ``default_factory`` value of a ``field(...)`` call, unwrapped
+    through a zero-arg lambda, else None."""
+    if not (isinstance(node, ast.Call)
+            and dotted_name(node.func) in ("field", "dataclasses.field")):
+        return None
+    for kw in node.keywords:
+        if kw.arg == "default_factory":
+            v = kw.value
+            if isinstance(v, ast.Lambda) and not v.args.args:
+                return v.body
+            return v
+    return None
+
+
+class LockMap:
+    """Lexical lock bindings of one file.
+
+    ``named``: binding key -> lockspec name, where a binding key is
+    ``("self", ClassName, attr)`` or ``("mod", "", name)``. ``raw``
+    lists raw threading constructions bound to an attribute /
+    module-level / class-field name (function-local raws are exempt —
+    a lock that never escapes one call cannot participate in a
+    cross-thread ordering).
+    """
+
+    def __init__(self) -> None:
+        self.named: Dict[Tuple[str, str, str], str] = {}
+        self.raw: List[Tuple[ast.AST, str, str]] = []
+
+    def resolve(self, expr: ast.AST, cls: Optional[str]) -> Optional[str]:
+        """The lockspec name a with/acquire target expr binds to."""
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ) and expr.value.id == "self" and cls:
+            return self.named.get(("self", cls, expr.attr))
+        if isinstance(expr, ast.Name):
+            return self.named.get(("mod", "", expr.id))
+        return None
+
+
+def _bind(lm: LockMap, targets: List[ast.expr], value: ast.AST,
+          cls: Optional[str], in_func: bool) -> None:
+    mk = _make_call(value)
+    raw = _raw_ctor(value)
+    for t in targets:
+        key = None
+        if isinstance(t, ast.Attribute) and isinstance(
+            t.value, ast.Name
+        ) and t.value.id == "self" and cls:
+            key = ("self", cls, t.attr)
+        elif isinstance(t, ast.Name) and not in_func:
+            # module- or class-level binding; class-level lock
+            # attributes are accessed through self just the same
+            key = ("self", cls, t.id) if cls else ("mod", "", t.id)
+        if mk is not None:
+            name = str_const(mk[1].args[0]) if mk[1].args else None
+            if key is not None and name is not None:
+                lm.named[key] = name
+        elif raw is not None and key is not None:
+            lm.raw.append((value, raw, ".".join(k for k in key[1:] if k)))
+
+
+def build(sf: SourceFile) -> LockMap:
+    lm = LockMap()
+
+    def walk(node: ast.AST, cls: Optional[str], in_func: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name, False)
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, cls, True)
+                continue
+            if isinstance(child, ast.Assign):
+                _bind(lm, child.targets, child.value, cls, in_func)
+            elif isinstance(child, ast.AnnAssign) and child.value is not None:
+                factory = _field_factory(child.value)
+                _bind(
+                    lm, [child.target],
+                    factory if factory is not None else child.value,
+                    cls, in_func,
+                )
+            walk(child, cls, in_func)
+
+    walk(sf.tree, None, False)
+    return lm

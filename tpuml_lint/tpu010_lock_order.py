@@ -1,0 +1,240 @@
+"""TPU010: lock-hierarchy discipline against runtime/lockspec.py.
+
+Project rule (it loads the lock catalog by file path, like TPU007 loads
+the metric catalog). Four checks:
+
+- **Nested acquisition order**: within one function body, a ``with``
+  over a resolvable cataloged lock taken while a higher-or-equal-rank
+  lock is statically held is a hierarchy violation. Same-name nesting
+  of a non-reentrant kind is self-deadlock, flagged the same way.
+- **Undeclared locks**: any raw ``threading.Lock/RLock/Condition``
+  bound to an attribute, module-level name, or dataclass field inside
+  ``runtime/``/``serving/`` — every lock there is constructed through
+  ``runtime.lockwitness`` with a cataloged name, which is what gives
+  both this rule and the runtime witness their ground truth.
+- **Catalog integrity**: a ``make_*`` call whose name is not in the
+  catalog, whose factory kind disagrees with the cataloged kind, or
+  which appears outside the name's declared home module.
+- **Obscured acquisition**: a ``with getattr(...)`` context or an
+  ``.acquire(**kwargs)`` splat in scoped dirs — acquisitions the rule
+  cannot prove are flagged rather than silently trusted (the same
+  stance TPU008 takes on ``**label`` splats).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from . import envinfo, locks
+from .core import Finding, SourceFile, dotted_name, str_const
+
+CODE = "TPU010"
+NAME = "lock-order"
+
+_KIND_OF_FN = {
+    "make_lock": "lock",
+    "make_rlock": "rlock",
+    "make_condition": "condition",
+}
+
+
+def _walk_withs(
+    sf: SourceFile,
+    lm: locks.LockMap,
+    spec_by_name,
+    body: Sequence[ast.stmt],
+    cls: Optional[str],
+    held: List[Tuple[str, ast.AST]],
+    scoped: bool,
+) -> Iterator[Finding]:
+    """DFS one function body (not descending into nested defs),
+    tracking the stack of statically held cataloged locks."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # runs later, on its own stack
+        if isinstance(stmt, ast.ClassDef):
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            entered: List[str] = []
+            for item in stmt.items:
+                ctx = item.context_expr
+                if scoped and isinstance(ctx, ast.Call) and dotted_name(
+                    ctx.func
+                ) == "getattr":
+                    yield sf.finding(
+                        CODE, ctx,
+                        "lock acquisition through getattr() cannot be "
+                        "checked against the declared hierarchy",
+                        fixit="acquire through the named attribute so "
+                        "TPU010 can rank it (runtime/lockspec.py)",
+                    )
+                    continue
+                name = lm.resolve(ctx, cls)
+                if name is None or name not in spec_by_name:
+                    continue
+                spec = spec_by_name[name]
+                for held_name, held_node in held:
+                    hspec = spec_by_name[held_name]
+                    if held_name == name:
+                        if hspec.kind != "rlock":
+                            yield sf.finding(
+                                CODE, ctx,
+                                f"re-acquiring non-reentrant lock "
+                                f"{name!r} (kind {hspec.kind}) while "
+                                "already holding it deadlocks",
+                                fixit="narrow the outer critical "
+                                "section or catalog the lock as an "
+                                "rlock if re-entry is intended",
+                            )
+                    elif hspec.rank >= spec.rank:
+                        yield sf.finding(
+                            CODE, ctx,
+                            f"acquires {name!r} (rank {spec.rank}) "
+                            f"while holding {held_name!r} (rank "
+                            f"{hspec.rank}); the declared hierarchy "
+                            "(runtime/lockspec.py) only permits "
+                            "ascending-rank nesting",
+                            fixit="re-order the acquisitions or move "
+                            "the inner call outside the outer "
+                            "critical section",
+                        )
+                if name in spec_by_name:
+                    entered.append(name)
+                    held.append((name, ctx))
+            yield from _walk_withs(
+                sf, lm, spec_by_name, stmt.body, cls, held, scoped
+            )
+            for _ in entered:
+                held.pop()
+            continue
+        for child_body in _stmt_bodies(stmt):
+            yield from _walk_withs(
+                sf, lm, spec_by_name, child_body, cls, held, scoped
+            )
+
+
+def _stmt_bodies(stmt: ast.stmt) -> Iterator[Sequence[ast.stmt]]:
+    for attr in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, attr, None)
+        if b:
+            yield b
+    for h in getattr(stmt, "handlers", ()):
+        yield h.body
+
+
+def _functions(
+    tree: ast.AST,
+) -> Iterator[Tuple[Optional[str], Sequence[ast.stmt]]]:
+    """(enclosing class name, body) for the module and every function."""
+
+    def walk(node: ast.AST, cls: Optional[str]) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cls, child.body
+                yield from walk(child, cls)
+            else:
+                yield from walk(child, cls)
+
+    yield None, tree.body  # type: ignore[attr-defined]
+    yield from walk(tree, None)
+
+
+def check_project(
+    files: Sequence[SourceFile], repo_root: str
+) -> Iterator[Finding]:
+    lockspec = envinfo.load_lockspec(repo_root)
+    if lockspec is None:
+        return
+    spec_by_name = dict(lockspec.SPEC)
+
+    for sf in files:
+        scoped = locks.in_scope(sf.path)
+        lm = locks.build(sf)
+
+        if scoped:
+            for node, ctor, bound in lm.raw:
+                yield sf.finding(
+                    CODE, node,
+                    f"raw threading.{ctor} bound to {bound!r}: locks in "
+                    "runtime//serving/ are constructed through "
+                    "runtime/lockwitness.py with a cataloged name",
+                    fixit=f"use lockwitness.make_"
+                    f"{'condition' if ctor == 'Condition' else ctor.lower()}"
+                    '("<lockspec name>") and declare the name in '
+                    "runtime/lockspec.py",
+                )
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn is None:
+                continue
+            fn = dn.rsplit(".", 1)[-1]
+            if fn not in _KIND_OF_FN:
+                continue
+            name = str_const(node.args[0]) if node.args else None
+            if name is None:
+                if scoped:
+                    yield sf.finding(
+                        CODE, node,
+                        f"lockwitness.{fn} with a non-literal name "
+                        "cannot be checked against the catalog",
+                        fixit="pass the lockspec name as a string "
+                        "literal",
+                    )
+                continue
+            spec = spec_by_name.get(name)
+            if spec is None:
+                yield sf.finding(
+                    CODE, node,
+                    f"lock name {name!r} is not declared in "
+                    "runtime/lockspec.py",
+                    fixit="add a LockSpec with a rank that fits the "
+                    "documented hierarchy",
+                )
+                continue
+            # make_condition(name, lock=...) shares an existing lock:
+            # the name names the *lock* entry, not a condition entry
+            shares = fn == "make_condition" and any(
+                kw.arg == "lock" for kw in node.keywords
+            )
+            want = "lock" if shares else _KIND_OF_FN[fn]
+            if spec.kind != want:
+                yield sf.finding(
+                    CODE, node,
+                    f"{name!r} is cataloged as a {spec.kind} but "
+                    f"constructed with {fn}",
+                    fixit="match the factory to the cataloged kind",
+                )
+            if scoped and spec.module != sf.path:
+                yield sf.finding(
+                    CODE, node,
+                    f"{name!r} is declared to live in {spec.module} "
+                    f"but is constructed in {sf.path}",
+                    fixit="construct the lock in its declared home or "
+                    "update the catalog entry",
+                )
+        # .acquire(**kwargs) splats on any attribute in scope
+        if scoped:
+            for node in ast.walk(sf.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                    and any(kw.arg is None for kw in node.keywords)
+                ):
+                    yield sf.finding(
+                        CODE, node,
+                        "acquire(**kwargs) obscures blocking/timeout "
+                        "semantics from the hierarchy check",
+                        fixit="pass blocking/timeout explicitly",
+                    )
+
+        for cls, body in _functions(sf.tree):
+            yield from _walk_withs(
+                sf, lm, spec_by_name, body, cls, [], scoped
+            )

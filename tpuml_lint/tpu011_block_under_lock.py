@@ -1,0 +1,185 @@
+"""TPU011: blocking call while a cataloged lock is held.
+
+Project rule sharing TPU010's lexical lock resolution. Inside a
+``with`` over a resolvable cataloged lock it flags the classic
+dispatcher-deadlock shapes — calls that can block indefinitely (or for
+a full device step) while every other thread needing the lock stalls
+behind them:
+
+- ``<future>.result(...)`` — a Future resolved by a thread that may
+  itself need the held lock;
+- ``time.sleep(...)`` — a critical section priced in wall-clock;
+- ``<queue>.get(...)`` on queue-named receivers — waiting for a
+  producer who may be waiting for the lock;
+- ``jax.block_until_ready`` / ``.block_until_ready()`` — a device
+  fence (milliseconds to seconds) under a host lock;
+- subprocess RPC (``subprocess.run/...``, ``.communicate()``);
+- ``<thread>.join(...)`` — joining a thread that may need the lock
+  (string/path joins are filtered out);
+- ``.predict(...)`` / ``.fit(...)`` — whole model executions.
+
+``Condition.wait`` is deliberately NOT flagged: waiting releases the
+lock, which is the sanctioned way to block inside a critical section.
+The fix is almost always the repo's established idiom — snapshot under
+the lock, do the slow work outside (see ``ModelRegistry.warm`` or the
+dispatcher's collect-then-execute split).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from . import envinfo, locks
+from .core import Finding, SourceFile, dotted_name
+
+CODE = "TPU011"
+NAME = "block-under-lock"
+
+_QUEUEISH = re.compile(r"(^|_)(q|queue|inq|outq|jobs|work)s?$", re.I)
+
+#: attribute names that block on another actor finishing
+_BLOCKING_ATTRS = {
+    "result": "Future.result() blocks on another worker",
+    "communicate": "subprocess RPC round-trip",
+    "predict": "a whole model execution",
+    "fit": "a whole model fit",
+    "block_until_ready": "a device fence",
+}
+_SUBPROCESS_FNS = {
+    "subprocess.run", "subprocess.check_output",
+    "subprocess.check_call", "subprocess.call",
+}
+
+
+def _blocking_reason(node: ast.Call) -> Optional[str]:
+    dn = dotted_name(node.func)
+    if dn is not None:
+        if dn == "time.sleep":
+            return "time.sleep() prices the critical section in wall-clock"
+        if dn in _SUBPROCESS_FNS or dn.startswith("jax.block_until_ready"):
+            return "a blocking subprocess/device call"
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    recv = node.func.value
+    if attr in _BLOCKING_ATTRS:
+        return _BLOCKING_ATTRS[attr]
+    if attr == "get":
+        rname = recv.id if isinstance(recv, ast.Name) else (
+            recv.attr if isinstance(recv, ast.Attribute) else ""
+        )
+        if _QUEUEISH.search(rname or ""):
+            return "queue.get() waits on a producer"
+        return None
+    if attr == "join":
+        # thread joins only: filter string-literal receivers and
+        # path-flavored dotted names (os.path.join, PurePath.join...)
+        if isinstance(recv, ast.Constant):
+            return None
+        rdn = dotted_name(recv) or ""
+        if "path" in rdn.lower() or "sep" in rdn.lower():
+            return None
+        return "joining a thread that may itself need the held lock"
+    return None
+
+
+def _scan(
+    sf: SourceFile,
+    lm: locks.LockMap,
+    spec_by_name,
+    body: Sequence[ast.stmt],
+    cls: Optional[str],
+    held: List[str],
+) -> Iterator[Finding]:
+    for stmt in body:
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            entered = 0
+            for item in stmt.items:
+                name = lm.resolve(item.context_expr, cls)
+                if name is not None and name in spec_by_name:
+                    held.append(name)
+                    entered += 1
+            yield from _scan(sf, lm, spec_by_name, stmt.body, cls, held)
+            for _ in range(entered):
+                held.pop()
+            continue
+        if held:
+            for node in _calls_outside_defs(stmt):
+                reason = _blocking_reason(node)
+                if reason is not None:
+                    yield sf.finding(
+                        CODE, node,
+                        f"blocking call under lock {held[-1]!r}: "
+                        f"{reason}; every thread needing the lock "
+                        "stalls behind it",
+                        fixit="snapshot state under the lock and do "
+                        "the blocking work outside the critical "
+                        "section",
+                    )
+        for child_body in _bodies(stmt):
+            yield from _scan(
+                sf, lm, spec_by_name, child_body, cls, held
+            )
+
+
+def _bodies(stmt: ast.stmt) -> Iterator[Sequence[ast.stmt]]:
+    for attr in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, attr, None)
+        if b:
+            yield b
+    for h in getattr(stmt, "handlers", ()):
+        yield h.body
+
+
+def _calls_outside_defs(stmt: ast.stmt) -> Iterator[ast.Call]:
+    """Calls in this statement's own expressions — not in nested
+    compound bodies (scanned with their own held-stack state) and not
+    inside nested function defs (run elsewhere)."""
+    stack: List[ast.AST] = [stmt]
+    first = True
+    while stack:
+        node = stack.pop()
+        if not first and isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+             ast.Lambda),
+        ):
+            continue
+        if not first and isinstance(node, ast.stmt) and any(
+            True for _ in _bodies(node)
+        ):
+            # a nested compound statement: its header expressions still
+            # run under the lock, its bodies are scanned separately
+            for field in ("test", "iter", "items"):
+                v = getattr(node, field, None)
+                if v is not None:
+                    stack.extend(v if isinstance(v, list) else [v])
+            continue
+        first = False
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+    return
+
+
+def check_project(
+    files: Sequence[SourceFile], repo_root: str
+) -> Iterator[Finding]:
+    lockspec = envinfo.load_lockspec(repo_root)
+    if lockspec is None:
+        return
+    spec_by_name = dict(lockspec.SPEC)
+    from .tpu010_lock_order import _functions
+
+    for sf in files:
+        lm = locks.build(sf)
+        if not lm.named:
+            continue
+        for cls, body in _functions(sf.tree):
+            yield from _scan(sf, lm, spec_by_name, body, cls, [])

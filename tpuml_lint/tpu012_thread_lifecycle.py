@@ -1,0 +1,190 @@
+"""TPU012: thread lifecycle — daemon, named, and reachable teardown.
+
+Every ``threading.Thread`` created inside ``spark_rapids_ml_tpu/``
+(product code; tests spawn ad-hoc threads freely) must be:
+
+- **daemon** (``daemon=True`` literally at the constructor / in the
+  subclass ``super().__init__``): a non-daemon worker turns every
+  forgotten ``close()`` into a hung interpreter at exit;
+- **name-stamped** (``name=...``): the witness, the thread-leak
+  sanitizer fixture, and crash dumps all identify threads by name —
+  ``Thread-23`` is unactionable in a flight-recorder dump;
+- **reachable from a teardown path**: the owning class defines one of
+  ``stop/drain/close/halt/shutdown/__exit__``, the module defines a
+  top-level ``stop``/``shutdown``/``close``, or the spawning function
+  itself shuts the worker down in a ``finally`` (the streaming
+  prefetcher's ``cancel.set()`` pattern). Daemon-ness keeps exit from
+  hanging; teardown keeps *tests* from leaking live threads into each
+  other (``tests/conftest.py`` snapshots them).
+
+The same three requirements apply to ``threading.Thread`` subclasses:
+their ``__init__`` must forward ``daemon=True`` and a ``name`` through
+``super().__init__``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from .core import Finding, SourceFile, dotted_name, parents_map
+
+CODE = "TPU012"
+NAME = "thread-lifecycle"
+
+SCOPE_PREFIX = "spark_rapids_ml_tpu/"
+TEARDOWN_METHODS = {"stop", "drain", "close", "halt", "shutdown", "__exit__"}
+TEARDOWN_MODULE_FNS = {"stop", "shutdown", "close"}
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    return dotted_name(node.func) in ("threading.Thread", "Thread")
+
+
+def _kw(node: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _daemon_true(node: ast.Call) -> bool:
+    v = _kw(node, "daemon")
+    return isinstance(v, ast.Constant) and v.value is True
+
+
+def _module_teardown_fns(tree: ast.AST) -> Set[str]:
+    return {
+        n.name
+        for n in getattr(tree, "body", ())
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name in TEARDOWN_MODULE_FNS
+    }
+
+
+def _class_methods(cls: ast.ClassDef) -> Set[str]:
+    return {
+        n.name
+        for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _finally_teardown(fn: ast.AST) -> bool:
+    """True when ``fn`` contains a ``try/finally`` whose finalbody calls
+    ``.set()`` or ``.join()`` — the local-worker shutdown idiom
+    (``cancel.set()`` / ``worker.join()``)."""
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Try) and node.finalbody):
+            continue
+        for fin in node.finalbody:
+            for call in ast.walk(fin):
+                if (
+                    isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in ("set", "join")
+                ):
+                    return True
+    return False
+
+
+def _teardown_evidence(
+    node: ast.AST, parents, module_fns: Set[str]
+) -> bool:
+    cur = parents.get(node)
+    fn_seen = False
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            if _class_methods(cur) & TEARDOWN_METHODS:
+                return True
+        if not fn_seen and isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            fn_seen = True
+            if _finally_teardown(cur):
+                return True
+        cur = parents.get(cur)
+    return bool(module_fns)
+
+
+def _super_init(cls: ast.ClassDef) -> Optional[ast.Call]:
+    """The ``super().__init__(...)`` call inside ``cls.__init__``."""
+    for n in cls.body:
+        if isinstance(n, ast.FunctionDef) and n.name == "__init__":
+            for node in ast.walk(n):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "__init__"
+                    and isinstance(node.func.value, ast.Call)
+                    and dotted_name(node.func.value.func) == "super"
+                ):
+                    return node
+    return None
+
+
+def check_file(sf: SourceFile) -> Iterator[Finding]:
+    if not sf.path.startswith(SCOPE_PREFIX):
+        return
+    parents = parents_map(sf.tree)
+    module_fns = _module_teardown_fns(sf.tree)
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and _is_thread_ctor(node):
+            if not _daemon_true(node):
+                yield sf.finding(
+                    CODE, node,
+                    "thread is not daemon=True (literal): a non-daemon "
+                    "worker hangs interpreter exit on any missed "
+                    "teardown path",
+                    fixit="pass daemon=True at the constructor",
+                )
+            if _kw(node, "name") is None:
+                yield sf.finding(
+                    CODE, node,
+                    'thread has no name= stamp: the leak sanitizer, '
+                    "the lock witness, and flight-recorder dumps "
+                    "identify threads by name",
+                    fixit='pass name="tpuml-<role>"',
+                )
+            if not _teardown_evidence(node, parents, module_fns):
+                yield sf.finding(
+                    CODE, node,
+                    "thread has no reachable teardown: no "
+                    "stop/drain/close/halt/shutdown/__exit__ on the "
+                    "owning class, no module-level stop/shutdown, and "
+                    "no finally-block .set()/.join() in the spawning "
+                    "function",
+                    fixit="wire the thread into an owner teardown "
+                    "method (and join or signal it there)",
+                )
+        elif isinstance(node, ast.ClassDef) and any(
+            dotted_name(b) in ("threading.Thread", "Thread")
+            for b in node.bases
+        ):
+            si = _super_init(node)
+            if si is None or not _daemon_true(si):
+                yield sf.finding(
+                    CODE, si or node,
+                    f"Thread subclass {node.name!r} does not pass "
+                    "daemon=True (literal) through super().__init__",
+                    fixit="forward daemon=True in __init__",
+                )
+            if si is None or _kw(si, "name") is None:
+                yield sf.finding(
+                    CODE, si or node,
+                    f"Thread subclass {node.name!r} does not stamp a "
+                    "name= through super().__init__",
+                    fixit='forward name="tpuml-<role>" in __init__',
+                )
+            if not (
+                _class_methods(node) & TEARDOWN_METHODS or module_fns
+            ):
+                yield sf.finding(
+                    CODE, node,
+                    f"Thread subclass {node.name!r} has no teardown "
+                    "method (stop/drain/close/halt/shutdown/__exit__) "
+                    "and the module has no stop/shutdown",
+                    fixit="add a teardown method that signals and "
+                    "joins the thread",
+                )
