@@ -1530,4 +1530,151 @@ print("lock-witness chaos smoke OK: serving burst + scheduled re-fit + "
       f"{len(held)} lock(s)")
 EOF
 
+echo "== measured-autotuner smoke =="
+# Autotuner contract (docs/autotune.md): defaults inert (env unset =>
+# no cache file, no autotune metric series, fits bit-identical), a cold
+# probe search measures real pinned-width fits and persists the winner,
+# and the warm re-run answers the resolver's consult from the cache
+# with ZERO new probe spans (span-count-asserted under TPUML_TRACE) and
+# zero retrace storms.
+rm -rf /tmp/tpuml_autotune_smoke /tmp/tpuml_autotune_trace
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+import time
+
+import numpy as np
+
+from spark_rapids_ml_tpu.classification import RandomForestClassifier
+from spark_rapids_ml_tpu.data import DataFrame
+from spark_rapids_ml_tpu.runtime import autotune, telemetry
+
+cache_dir = "/tmp/tpuml_autotune_smoke"
+os.makedirs(cache_dir)
+rng = np.random.default_rng(7)
+X = rng.normal(size=(512, 12)).astype(np.float32)
+y = (X[:, 0] - X[:, 1] > 0).astype(np.float64)
+df = DataFrame({"features": X, "label": y})
+
+def fit():
+    return RandomForestClassifier(
+        numTrees=8, maxDepth=5, seed=3, num_workers=1
+    ).fit(df)
+
+def probe_spans():
+    return sum(
+        st["count"]
+        for name, st in telemetry.span_stats().items()
+        if name.startswith("autotune.probe.")
+    )
+
+def metric_total(name):
+    s = telemetry.metrics_snapshot().get(name)
+    return sum(r["value"] for r in s["series"]) if s else 0
+
+# --- defaults inert: no file, no metric series, bit-identical fits ---
+for var in ("TPUML_AUTOTUNE", "TPUML_AUTOTUNE_CACHE", "TPUML_TRACE"):
+    os.environ.pop(var, None)
+os.environ["TPUML_RF_TREE_BATCH"] = "auto"
+telemetry.reset_telemetry()
+autotune.reset_autotune()
+m_a, m_b = fit(), fit()
+np.testing.assert_array_equal(m_a._features_arr, m_b._features_arr)
+np.testing.assert_array_equal(m_a._thresholds_arr, m_b._thresholds_arr)
+assert "autotuned" not in m_a._fit_report, m_a._fit_report
+assert not any(
+    k.startswith("autotune") for k in telemetry.metrics_snapshot()
+)
+assert os.listdir(cache_dir) == [], "off mode must not create files"
+
+# --- cold: real measured search over pinned widths, winner persisted ---
+os.environ["TPUML_AUTOTUNE"] = "on"
+os.environ["TPUML_AUTOTUNE_CACHE"] = cache_dir
+os.environ["TPUML_TRACE"] = "/tmp/tpuml_autotune_trace"
+# each candidate measure is a full (small) fit: the library's 2 s
+# default budget is sized for micro-probes and would truncate the grid
+os.environ["TPUML_AUTOTUNE_BUDGET_MS"] = "60000"
+telemetry.reset_telemetry()
+autotune.reset_autotune()
+m_cold = fit()  # heuristic-provenance decision carries the shape key
+dec = next(
+    d for d in m_cold._fit_report["autotuned"] if d["knob"] == "rf_tree_batch"
+)
+assert dec["provenance"] == "heuristic", dec
+
+def measure(width):
+    os.environ["TPUML_RF_TREE_BATCH"] = str(width)
+    os.environ["TPUML_AUTOTUNE"] = "off"  # no recursion inside probes
+    try:
+        t0 = time.perf_counter()
+        fit()
+        return time.perf_counter() - t0
+    finally:
+        os.environ["TPUML_RF_TREE_BATCH"] = "auto"
+        os.environ["TPUML_AUTOTUNE"] = "on"
+
+widths = [dec["value"]] + [w for w in (1, 2, 4) if w != dec["value"]]
+won = autotune.probe("rf_tree_batch", dec["key"], widths, measure, reps=1)
+cold_spans = probe_spans()
+assert cold_spans >= len(widths), (cold_spans, widths)
+# one SEARCH (probes_total) spanning len(widths) measurements (spans)
+assert metric_total("autotune_probes_total") == 1
+assert os.path.exists(os.path.join(cache_dir, "autotune-cache.json"))
+
+# --- warm: fresh in-memory state answers from disk, zero new probes ---
+autotune.reset_autotune()  # simulate a new process on the same cache
+m_warm = fit()
+warm = next(
+    d for d in m_warm._fit_report["autotuned"] if d["knob"] == "rf_tree_batch"
+)
+assert warm["provenance"] == "cache_hit", warm
+assert warm["value"] == won.value, (warm, won)
+assert probe_spans() == cold_spans, "warm cache must probe ZERO times"
+assert metric_total("autotune_probes_total") == 1, "no new searches warm"
+assert metric_total("autotune_cache_hits") >= 1
+storms = telemetry.metrics_snapshot().get("retrace_storms")
+assert not storms or all(
+    s["value"] == 0 for s in storms["series"]
+), storms
+for var in ("TPUML_AUTOTUNE", "TPUML_AUTOTUNE_CACHE", "TPUML_TRACE",
+            "TPUML_RF_TREE_BATCH", "TPUML_AUTOTUNE_BUDGET_MS"):
+    os.environ.pop(var, None)
+print(f"autotuner smoke OK: cold search measured {cold_spans} probes "
+      f"(winner {won.value}, {won.provenance}), warm consult cache_hit "
+      "with zero new probes, 0 retrace storms")
+EOF
+
+# bench autotune artifact: the tuned-vs-default A/B must post its ratio
+# columns and clear the bench_regress absolute floor (tiny CPU scale —
+# this checks the search + gate plumbing, not TPU speedups)
+JAX_PLATFORMS=cpu BENCH_ONLY=autotune BENCH_AUTOTUNE_BUDGET_MS=20000 \
+    BENCH_AUTOTUNE_RF_ROWS=2048 python bench.py cpu \
+    > /tmp/tpuml_bench_autotune.out
+python - <<'EOF'
+import json
+import subprocess
+import sys
+
+with open("/tmp/tpuml_bench_autotune.out") as f:
+    line = json.loads(f.read().strip().splitlines()[-1])
+entry = line["autotune"]
+assert entry["tuned_vs_default"] >= 0.85, entry
+legs = entry["legs"]
+assert set(legs) == {"rf", "pca_stream", "serving"}, sorted(legs)
+for name, leg in legs.items():
+    assert leg["tuned_vs_default"] > 0, (name, leg)
+    # default-wins legs must show the tuner RETURNING the default
+    if leg["tuned"] == leg["default"]:
+        assert leg["tuned_vs_default"] == 1.0, (name, leg)
+r = subprocess.run(
+    [sys.executable, "scripts/bench_regress.py",
+     "--current", "/tmp/tpuml_bench_autotune.out",
+     "--trajectory", "/tmp/tpuml_nonexistent_r*.json"],
+    capture_output=True, text=True,
+)
+assert "tuned_vs_default>=floor" in r.stdout, r.stdout
+assert r.returncode == 0, (r.returncode, r.stdout)
+print("bench autotune columns OK:",
+      {k: v["tuned_vs_default"] for k, v in legs.items()})
+EOF
+
 echo "CI OK"

@@ -21,6 +21,15 @@ newest run against the most recent prior run that produced entries:
   fleet goodput and its fraction of perfect N-replica scaling)
 - ``fleet_p99_ms`` — regression when it grows past ``+threshold``
   (fleet tail measured from the MERGED per-rank reservoirs)
+- ``tuned_vs_default`` — regression when it shrinks past ``-threshold``
+  AND, unconditionally, when it falls below the absolute floor
+  ``1.0 - threshold``: the autotuner measures the default config first
+  and falls back to it on a loss, so a tuned run that loses to the
+  default means the search or the cache is broken, not that the
+  hardware got slower. The floor gates even ``tunnel_bound`` and
+  first-appearance entries — tuned and default are measured
+  back-to-back in the SAME run over the same link, so link weather
+  cancels out of the ratio.
 
 Rules that keep the gate honest on real trajectories:
 
@@ -153,7 +162,13 @@ _STATIC_FIELDS = (
     ("fleet_p99_ms", +1),     # merged-reservoir fleet tail growth
     ("swap_p99_delta_ms", +1),  # hot-swap tail disturbance growth
     ("rollback_ms", +1),      # canary re-flip latency growth
+    ("tuned_vs_default", -1),  # autotuner stopped beating/matching default
 )
+
+# tuned_vs_default also has an ABSOLUTE floor (see compare): the probe
+# engine measures the default first, so a ratio below 1.0 - threshold is
+# a broken search/cache regardless of what any prior run posted.
+_ABS_FLOOR_FIELD = "tuned_vs_default"
 
 _QPS_FIELD_RE = re.compile(r"^qps_sweep\[(.+)\]\.p99_ms$")
 
@@ -214,6 +229,21 @@ def compare(
         if c is None:
             rows.append((name, "-", 0.0, 0.0, 0.0, "skip:entry-dropped"))
             continue
+        # absolute floor: gates every current entry reporting the field,
+        # including new and tunnel_bound ones (same-run back-to-back
+        # ratio — the link cancels out; "no prior run" is no excuse)
+        fv = c.get(_ABS_FLOOR_FIELD)
+        if fv is not None:
+            fv = float(fv)
+            floor = 1.0 - threshold
+            bad = fv < floor
+            rows.append(
+                (
+                    name, f"{_ABS_FLOOR_FIELD}>=floor", floor, fv,
+                    fv - 1.0, "REGRESS" if bad else "ok",
+                )
+            )
+            failed = failed or bad
         if b is None:
             rows.append((name, "-", 0.0, 0.0, 0.0, "skip:new-entry"))
             continue
@@ -295,10 +325,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
             return 0
         cur_path, cur = runs.pop()
-    if not runs:
-        print("bench_regress: no prior run to gate against — pass")
-        return 0
-    base_path, base = runs[-1]
+    if runs:
+        base_path, base = runs[-1]
+    else:
+        # no trajectory yet: nothing to compare, but the absolute-floor
+        # fields still gate the current run on its own
+        print("bench_regress: no prior run — absolute floors only")
+        base_path, base = "(none)", {}
 
     rows, failed = compare(base, cur, args.threshold)
     print(

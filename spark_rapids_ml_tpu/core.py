@@ -41,7 +41,7 @@ import jax.numpy as jnp
 
 from .data.dataframe import DataFrame, _is_sparse
 from .params import Params, _TpuParams, HasLabelCol, HasPredictionCol, HasWeightCol
-from .runtime import envspec, telemetry
+from .runtime import autotune, envspec, telemetry
 from .parallel.mesh import (
     global_row_count,
     make_mesh,
@@ -152,8 +152,17 @@ def resolve_gang_fit(n_lanes: int, lane_bytes: float) -> int:
     raw = str(envspec.get("TPUML_GANG_FIT")).strip().lower()
     if raw == "off":
         return 1
+    tune_key = None
     if raw == "auto":
         want = n_lanes
+        if autotune.active():
+            tune_key = autotune.shape_key(
+                n=n_lanes, d=int(lane_bytes), dtype="lane_bytes"
+            )
+            tuned = autotune.consult("gang_fit", tune_key)
+            if isinstance(tuned, int) and 1 <= tuned <= n_lanes:
+                want = tuned
+                tune_key = None  # provenance already filed by consult
     else:
         try:
             want = int(raw)
@@ -170,6 +179,8 @@ def resolve_gang_fit(n_lanes: int, lane_bytes: float) -> int:
     budget = float(budget) if budget else _default_gang_budget()
     fit = max(1, int(budget // max(1.0, float(lane_bytes))))
     lanes = max(1, min(want, fit))
+    if tune_key is not None:
+        autotune.record_heuristic("gang_fit", tune_key, lanes)
     telemetry.record_hbm_estimate("gang_fit", float(lane_bytes) * lanes)
     return lanes
 
@@ -855,12 +866,19 @@ class _TpuEstimator(Params, _TpuParams):
         gang_results: Dict[int, Dict[str, Any]] = {}
         gang_reports: Dict[int, Dict[str, Any]] = {}
         gang_deltas: Dict[int, Dict[str, int]] = {}
+        gang_tuned: List[Dict[str, Any]] = []
         if not streaming and len(param_sets) > 1 and _gang_env_on():
             gang_fit = self._get_tpu_gang_fit_func(dataset)
             if gang_fit is not None:
-                gang_results, gang_reports, gang_deltas = self._gang_dispatch(
-                    inputs, param_sets, gang_fit=gang_fit, cls_name=cls_name
-                )
+                with autotune.collect() as gang_tuned:
+                    gang_results, gang_reports, gang_deltas = (
+                        self._gang_dispatch(
+                            inputs,
+                            param_sets,
+                            gang_fit=gang_fit,
+                            cls_name=cls_name,
+                        )
+                    )
 
         for lane, (est, ps) in enumerate(zip(estimators, param_sets)):
             if lane in gang_results:
@@ -868,11 +886,17 @@ class _TpuEstimator(Params, _TpuParams):
                 est._copyValues(model)
                 est._copy_tpu_params(model)
                 model._resilience_report = gang_deltas.get(lane, {})
-                model._fit_report = gang_reports[lane]
+                fit_report = gang_reports[lane]
+                if gang_tuned:
+                    fit_report = dict(fit_report or {})
+                    fit_report["autotuned"] = list(gang_tuned)
+                model._fit_report = fit_report
                 models.append(model)
                 continue
             res_base = _res_counters.snapshot()
-            with annotate(f"{cls_name}.fit"), timed(
+            with autotune.collect() as tuned, annotate(
+                f"{cls_name}.fit"
+            ), timed(
                 self.logger, "fit"
             ), telemetry.span(
                 "fit.dispatch", lane=lane, streaming=streaming
@@ -884,6 +908,12 @@ class _TpuEstimator(Params, _TpuParams):
             # estimator unpacks result into model constructor kwargs. Absent
             # on the defaults path — reports attach only when a knob engaged.
             fit_report = result.pop("_fit_report", None) if isinstance(result, dict) else None
+            if tuned:
+                # knob decisions the tuner made during this dispatch —
+                # value + provenance (cache_hit|probed|heuristic). Absent
+                # (never an empty list) while TPUML_AUTOTUNE is off.
+                fit_report = dict(fit_report or {})
+                fit_report["autotuned"] = list(tuned)
             model = est._create_model(result)
             est._copyValues(model)
             est._copy_tpu_params(model)

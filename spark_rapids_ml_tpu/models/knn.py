@@ -31,7 +31,7 @@ from ..data.dataframe import DataFrame
 from ..params import Params, TypeConverters, _TpuParams, _mk
 from ..parallel.mesh import make_mesh, shard_rows
 from ..ops.knn_kernels import resolve_knn_topk, ring_knn
-from ..runtime import telemetry
+from ..runtime import autotune, envspec, telemetry
 from ..utils.logging import get_logger
 
 _DEFAULT_ID_COL = "unique_id"
@@ -585,6 +585,82 @@ class ApproximateNearestNeighborsModel(
             cache[key] = build_ivf_index(Xi, nlist=nlist, seed=seed)
         return cache[key]
 
+    def _tuned_nprobe(
+        self,
+        Xi: np.ndarray,
+        Xq: np.ndarray,
+        index: Any,
+        nlist: int,
+        nprobe: int,
+        k: int,
+        mesh: Any,
+    ) -> int:
+        """Recall-gated measured nprobe search (``TPUML_AUTOTUNE``).
+
+        Candidates are an octave ladder around the heuristic (measured
+        first); fitness is the measured probe-search time on a small
+        query sample, and a candidate is INFEASIBLE unless its recall
+        against the exact top-k on that sample stays >= 0.95 — the
+        documented ANN operating point, so the tuner can never trade
+        recall for speed. nlist is pinned: rebuilding the index per
+        candidate would blow the probe budget, so the cached value is a
+        ``[nlist, nprobe]`` pair only valid at this nlist (the
+        ``resolve_ann_params`` consult checks that)."""
+        import time as _time
+
+        from ..ops.ivf_kernels import ivf_feasible, ivf_search
+
+        key = autotune.shape_key(n=Xi.shape[0])
+        ladder = [nprobe]
+        for cand in (
+            max(1, nprobe // 2),
+            min(nlist, nprobe * 2),
+            min(nlist, nprobe * 4),
+        ):
+            if cand not in ladder:
+                ladder.append(cand)
+        xs = np.asarray(Xq[: min(128, Xq.shape[0])], np.float32)
+        xi = np.asarray(Xi, np.float32)
+        d2x = (
+            (xs * xs).sum(axis=1)[:, None]
+            - 2.0 * (xs @ xi.T)
+            + (xi * xi).sum(axis=1)[None, :]
+        )
+        true_idx = np.argpartition(d2x, kth=k - 1, axis=1)[:, :k]
+        true_sets = [set(row.tolist()) for row in true_idx]
+
+        def measure(value: Any) -> Optional[float]:
+            cand = int(value[1])
+            if not ivf_feasible(xi.shape[0], k, nlist, cand):
+                return None
+            xq_d, _ = shard_rows(xs, mesh)
+            t0 = _time.perf_counter()
+            _, idx = ivf_search(
+                xq_d, index, k=k, nprobe=cand,
+                topk_impl=resolve_knn_topk(), mesh=mesh,
+            )
+            idx = np.asarray(idx)[: xs.shape[0]]
+            dt = _time.perf_counter() - t0
+            hits = sum(
+                len(true_sets[i] & set(idx[i].tolist()))
+                for i in range(xs.shape[0])
+            )
+            if hits / float(xs.shape[0] * k) < 0.95:
+                return None
+            return dt
+
+        tuned = autotune.tune(
+            "ann_params", key, [[nlist, c] for c in ladder], measure
+        )
+        if (
+            isinstance(tuned, (list, tuple))
+            and len(tuned) == 2
+            and tuned[0] == nlist
+            and 1 <= int(tuned[1]) <= nlist
+        ):
+            return int(tuned[1])
+        return nprobe
+
     def kneighbors(
         self, query_df: DataFrame
     ) -> Tuple[DataFrame, DataFrame, DataFrame]:
@@ -651,6 +727,19 @@ class ApproximateNearestNeighborsModel(
             with timer.stage("build"):
                 index = self._ivf_index(Xi, nlist, seed)
             mesh = make_mesh(self.num_workers)
+            # measured nprobe refinement (TPUML_AUTOTUNE): only when the
+            # value came from the heuristic (algoParams/env pins win) and
+            # single-process — ranks timing probes independently could
+            # disagree on the winner and deadlock the sharded search
+            if (
+                nproc == 1
+                and autotune.active()
+                and (self.getAlgoParams() or {}).get("nprobe") is None
+                and not envspec.is_set("TPUML_ANN_NPROBE")
+            ):
+                nprobe = self._tuned_nprobe(
+                    Xi, Xq, index, nlist, nprobe, k, mesh
+                )
             with timer.stage("search"):
                 Xq_d, _ = shard_rows(Xq, mesh)
                 d2, idx = ivf_search(

@@ -166,10 +166,25 @@ def resolve_ann_params(
     over the heuristics. Raises ``ValueError`` on out-of-domain values —
     the estimator surfaces these verbatim.
     """
-    from ..runtime import envspec
+    from ..runtime import autotune, envspec
 
+    tuned = None
+    if (nlist is None or nprobe is None) and autotune.active():
+        # tuned winners (bench probe or kneighbors' in-situ recall-gated
+        # search) fill only the slots neither algoParams nor env pinned
+        tuned = autotune.consult(
+            "ann_params", autotune.shape_key(n=n_rows)
+        )
+        if not (
+            isinstance(tuned, (list, tuple))
+            and len(tuned) == 2
+            and all(isinstance(v, int) for v in tuned)
+        ):
+            tuned = None
     if nlist is None:
         nlist = envspec.get("TPUML_ANN_NLIST")
+    if nlist is None and tuned is not None and 2 <= tuned[0] <= max(n_rows, 1):
+        nlist = tuned[0]
     if nlist is None:
         nlist = default_nlist(n_rows)
     nlist = int(nlist)
@@ -181,6 +196,11 @@ def resolve_ann_params(
         )
     if nprobe is None:
         nprobe = envspec.get("TPUML_ANN_NPROBE")
+    if nprobe is None and tuned is not None and tuned[0] == nlist:
+        # a tuned nprobe is only meaningful at the nlist it was measured
+        # against — a stale pair from another nlist falls through
+        if 1 <= tuned[1] <= nlist:
+            nprobe = tuned[1]
     if nprobe is None:
         nprobe = default_nprobe(nlist)
     nprobe = int(nprobe)

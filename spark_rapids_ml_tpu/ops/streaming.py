@@ -32,7 +32,7 @@ import jax.numpy as jnp
 
 from ..data.chunks import Chunk, ChunkSource
 from ..parallel.mesh import row_sharding
-from ..runtime import counters, envspec, opsplane, telemetry
+from ..runtime import autotune, counters, envspec, opsplane, telemetry
 from ..runtime.faults import SimulatedPreemption, fault_site
 from ..runtime.scheduler import preempt_point
 from ..runtime.retry import (
@@ -399,7 +399,48 @@ def _probe_quant_error(x: np.ndarray, kind: str) -> float:
     return float(np.sqrt(np.mean((rec - v) ** 2))) / max(rms, 1e-12)
 
 
-def select_wire_format(sample_X: np.ndarray, requested: Optional[str] = None) -> str:
+def _tune_wire_format(x: np.ndarray, heuristic: str, mesh) -> str:
+    """Measured refinement of the ``auto`` wire pick (TPUML_AUTOTUNE).
+
+    Candidates are the encodings AT LEAST as accurate as the heuristic's
+    error-probed choice (the accuracy gate stays with the error probe —
+    the tuner only ever trades bytes against encode cost among formats
+    the tolerance contract already admits), heuristic first. Fitness is
+    the measured encode + device_put + on-device upcast-reduce of the
+    first chunk — the per-chunk ingest-path cost the knob controls."""
+    ladder = ["int8", "f16", "f32"]  # narrowest (most lossy) first
+    feasible = ladder[ladder.index(heuristic):]
+    if len(feasible) < 2:
+        return heuristic
+    candidates = [heuristic] + [w for w in feasible if w != heuristic]
+    key = autotune.shape_key(
+        n=x.shape[0],
+        d=x.shape[1] if x.ndim > 1 else 0,
+        dtype=x.dtype,
+        mesh=mesh,
+        storage=str(x.dtype),
+    )
+
+    def measure(w: str) -> float:
+        t0 = time.perf_counter()
+        if w == "int8":
+            q, scale, offset = _quantize_int8(x, x.shape[0])
+            buf: np.ndarray = q
+        elif w == "f16":
+            buf = x.astype(np.float16)
+        else:
+            buf = np.ascontiguousarray(x, np.float32)
+        dev = jax.device_put(buf, row_sharding(mesh))
+        jnp.sum(jnp.asarray(dev, jnp.float32)).block_until_ready()
+        return time.perf_counter() - t0
+
+    tuned = autotune.tune("wire_dtype", key, candidates, measure, reps=2)
+    return tuned if tuned in feasible else heuristic
+
+
+def select_wire_format(
+    sample_X: np.ndarray, requested: Optional[str] = None, mesh=None
+) -> str:
     """Resolve the wire encoding for one streaming pass (never ``auto``).
 
     ``requested`` overrides the env (None = read ``TPUML_WIRE_DTYPE``).
@@ -408,6 +449,11 @@ def select_wire_format(sample_X: np.ndarray, requested: Optional[str] = None) ->
     against the documented tolerances — and an explicit request that is
     infeasible on this host/backend WARNS and falls back instead of
     failing the fit. Non-float storage always ships as-is (``f32``).
+
+    With ``TPUML_AUTOTUNE`` on and a ``mesh``, the ``auto`` pick is
+    further refined by measurement (:func:`_tune_wire_format`) among
+    the formats the error tolerances admit; explicit requests
+    (including the ``f32`` default) are never second-guessed.
     """
     kind = resolve_wire_dtype() if requested is None else str(requested)
     x = np.asarray(sample_X)
@@ -425,6 +471,8 @@ def select_wire_format(sample_X: np.ndarray, requested: Optional[str] = None) ->
             "TPUML_WIRE_DTYPE=auto: int8 probe error %.2e -> wire %s",
             err8, kind,
         )
+        if autotune.active() and mesh is not None:
+            kind = _tune_wire_format(x, kind, mesh)
     if kind == "f8" and not _f8_supported():
         _wire_logger.warning(
             "TPUML_WIRE_DTYPE=f8 requested but float8_e4m3 is unavailable "
@@ -752,8 +800,23 @@ def iter_device_chunks(
         first = next(it, None)
         if first is None:
             return
-        kind = select_wire_format(first.X, requested=wire)
+        kind = select_wire_format(first.X, requested=wire, mesh=mesh)
         depth = int(envspec.get("TPUML_STREAM_STAGE_DEPTH"))
+        if not envspec.is_set("TPUML_STREAM_STAGE_DEPTH") and autotune.active():
+            # consult-only: a ring depth cannot be measured from inside
+            # one pipeline pass, so entries come from the bench probe
+            # (bench.py autotune) rather than an in-situ search
+            depth_key = autotune.shape_key(
+                n=first.X.shape[0],
+                d=first.X.shape[1] if first.X.ndim > 1 else 0,
+                dtype=np_dtype,
+                mesh=mesh,
+            )
+            tuned_depth = autotune.consult("stream_stage_depth", depth_key)
+            if isinstance(tuned_depth, int) and 0 <= tuned_depth <= 64:
+                depth = tuned_depth
+            else:
+                autotune.record_heuristic("stream_stage_depth", depth_key, depth)
         _LAST_INGEST.clear()
         _LAST_INGEST.update(
             wire_dtype=kind,

@@ -37,7 +37,7 @@ from jax.sharding import Mesh
 
 from ..parallel.layout import LAYOUT
 from ..parallel.mesh import DP_AXIS
-from ..runtime import envspec, telemetry
+from ..runtime import autotune, envspec, telemetry
 
 # elements per (F, nodes, bins, stats) histogram tile; bounds peak HBM of the
 # deepest level (tile is float32: 1<<22 elems = 16 MiB)
@@ -128,8 +128,29 @@ def resolve_tree_batch(t_group: int, cfg: "ForestConfig", n_rows: int) -> int:
     raw = str(envspec.get("TPUML_RF_TREE_BATCH")).strip().lower()
     if raw == "off":
         return 1
+    tune_key = None
     if raw == "auto":
         want = t_group
+        if autotune.active():
+            tune_key = autotune.shape_key(
+                n=n_rows,
+                d=cfg.n_features,
+                k=cfg.n_stats,
+                dtype="uint8",
+                depth=cfg.max_depth,
+                group=t_group,
+            )
+            tuned = autotune.consult("rf_tree_batch", tune_key)
+            # a tuned width only applies where it still divides the
+            # group — a stale entry from a different tree count falls
+            # through to the heuristic rather than breaking the reshape
+            if (
+                isinstance(tuned, int)
+                and 1 <= tuned <= t_group
+                and t_group % tuned == 0
+            ):
+                want = tuned
+                tune_key = None  # provenance already filed by consult
     else:
         try:
             want = int(raw)
@@ -154,6 +175,8 @@ def resolve_tree_batch(t_group: int, cfg: "ForestConfig", n_rows: int) -> int:
     )
     fit = max(1, int(budget // max(1, per_tree)))
     batch = _largest_divisor_leq(t_group, min(want, fit))
+    if tune_key is not None:
+        autotune.record_heuristic("rf_tree_batch", tune_key, batch)
     telemetry.record_hbm_estimate("tree_batch", float(per_tree) * batch)
     return batch
 

@@ -905,6 +905,43 @@ SPEC: Dict[str, EnvVar] = _registry(
         choices=("off", "1", "count", "raise"), category="observability",
         also_documented_in=("docs/observability.md",),
     ),
+    # --- measured autotuner (runtime/autotune.py) -------------------------
+    EnvVar(
+        "TPUML_AUTOTUNE", "choice", "off",
+        "Measured knob autotuner (`runtime/autotune.py`): `off` (the "
+        "default) disables every cache read and probe — resolvers use "
+        "their static heuristics and outputs are bit-identical to an "
+        "untuned run; `on` consults the shape-keyed tuning cache before "
+        "each `auto` resolver's heuristic and probes candidate values "
+        "with short dispatches of the real jitted work on a miss; "
+        "`force` re-probes even over an existing cache entry "
+        "(overwriting stale winners). See `docs/autotune.md` for the "
+        "search strategy and fitness definition.",
+        choices=("off", "on", "force"), category="autotune",
+        also_documented_in=("docs/autotune.md",),
+    ),
+    EnvVar(
+        "TPUML_AUTOTUNE_CACHE", "path", None,
+        "Directory of the persistent tuning cache "
+        "(`autotune-cache.json`, atomic tmp+rename, written by rank 0 "
+        "only). Unset with `TPUML_AUTOTUNE=on` keeps tuned winners "
+        "in-process (probes still run; nothing is persisted). Corrupt "
+        "or truncated files are tolerated: the tuner warns once and "
+        "falls back to heuristics.",
+        category="autotune",
+        also_documented_in=("docs/autotune.md",),
+    ),
+    EnvVar(
+        "TPUML_AUTOTUNE_BUDGET_MS", "float", 2000,
+        "Wall-clock probe budget per (knob, shape) search, in "
+        "milliseconds. The successive-halving search stops starting new "
+        "measurements once the budget is spent and keeps the best "
+        "candidate measured so far (the heuristic default is always "
+        "measured first, so a truncated search can never do worse than "
+        "no tuner).",
+        exclusive_minimum=0, category="autotune",
+        also_documented_in=("docs/autotune.md",),
+    ),
 )
 
 

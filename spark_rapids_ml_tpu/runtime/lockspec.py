@@ -192,6 +192,22 @@ SPEC: Dict[str, LockSpec] = _registry(
         "The process-wide parsed-plan cache.",
     ),
     LockSpec(
+        "autotune.file", 82, "lock",
+        f"{_RT}/autotune.py", "", "_FILE_LOCK",
+        "Serializes the tuning-cache file's read-merge-replace cycle so "
+        "concurrent in-process stores cannot drop each other's entries; "
+        "held only around local file I/O, never around probes. Below "
+        "autotune.cache: the merge's corrupt-file path takes the "
+        "warn-once lock while holding this one.",
+    ),
+    LockSpec(
+        "autotune.cache", 83, "lock",
+        f"{_RT}/autotune.py", "", "_LOCK",
+        "Autotuner in-memory cache + probe bookkeeping; holders may "
+        "file autotune metrics (telemetry band below) but never call "
+        "back out into dispatch layers.",
+    ),
+    LockSpec(
         "roofline.peaks", 84, "lock",
         f"{_RT}/roofline.py", "", "_PEAK_LOCK",
         "Resolved per-device peak FLOPs/bandwidth cache.",

@@ -520,6 +520,37 @@ SPEC: Dict[str, MetricSpec] = _registry(
         "contention measurement next to `lock_hold_ms`.",
         labels=("lock",),
     ),
+    # --- measured autotuner (runtime/autotune.py, PR 20) ------------------
+    MetricSpec(
+        "autotune_cache_hits", "counter",
+        "Tuning-cache consultations answered from a stored winner, "
+        "labeled by knob. Only moves while `TPUML_AUTOTUNE` is `on` or "
+        "`force` — an unset tuner leaves no series.",
+        labels=("knob",),
+    ),
+    MetricSpec(
+        "autotune_cache_misses", "counter",
+        "Tuning-cache consultations that found no entry for the "
+        "(knob, shape) key, labeled by knob; the resolver either "
+        "probes (when it can measure in place) or falls back to its "
+        "static heuristic.",
+        labels=("knob",),
+    ),
+    MetricSpec(
+        "autotune_probes_total", "counter",
+        "Completed probe searches (one per (knob, shape) measured, "
+        "however many candidates the search visited), labeled by knob. "
+        "A warm cache must read 0 — probes on a repeat shape mean the "
+        "cache is not persisting.",
+        labels=("knob",),
+    ),
+    MetricSpec(
+        "autotune_probe_ms", "histogram",
+        "Wall milliseconds one probe search spent measuring "
+        "candidates, labeled by knob; bounded per search by "
+        "`TPUML_AUTOTUNE_BUDGET_MS`.",
+        labels=("knob",),
+    ),
 )
 
 

@@ -1016,20 +1016,40 @@ def _retrace_limit() -> int:
     return int(envspec.get("TPUML_TELEMETRY_RETRACE_LIMIT"))
 
 
+def _watchdog_active() -> bool:
+    """The listener cannot be unregistered once installed, but its
+    EFFECT must follow the live opt-in: telemetry recording, or an
+    explicit retrace limit in the environment. Otherwise a process (or
+    test) that traced once charges every later untraced compile to the
+    ``<untraced>`` site — where no span can carry the ``warmup`` attr —
+    and legitimate warmup ladders score as storms long after the trace
+    env is gone."""
+    if _recording():
+        return True
+    try:
+        return envspec.is_set("TPUML_TELEMETRY_RETRACE_LIMIT")
+    except Exception:
+        return False
+
+
 def _on_event_duration(event: str, duration: float, **kw: Any) -> None:
     if event != _COMPILE_EVENT:
         return
     try:  # a listener exception would poison every jax compile
         cur = _CURRENT.get()
         site = cur.name if cur is not None else "<untraced>"
-        counter("xla_compiles").inc(1, site=site)
-        histogram("xla_compile_seconds").observe(duration, site=site)
         consume = _ROOFLINE_CONSUME
         if consume is not None:
             # hand the just-compiled program's cost analysis (stashed by
             # the roofline compile hook on this same thread) to the
-            # innermost span site — the attribution moment
+            # innermost span site — the attribution moment. Runs even
+            # while the watchdog is dormant: the pending list is
+            # thread-local and would otherwise grow without bound.
             consume(site)
+        if not _watchdog_active():
+            return
+        counter("xla_compiles").inc(1, site=site)
+        histogram("xla_compile_seconds").observe(duration, site=site)
         if cur is not None and cur.attrs.get("warmup"):
             # declared-compilation sites (`span(..., warmup=True)`): the
             # serving registry's per-bucket warmup exists precisely to

@@ -59,7 +59,15 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..runtime import envspec, faults, lockwitness, opsplane, retry, telemetry
+from ..runtime import (
+    autotune,
+    envspec,
+    faults,
+    lockwitness,
+    opsplane,
+    retry,
+    telemetry,
+)
 from .admission import (
     AdmissionController,
     DeadlineExceeded,
@@ -171,10 +179,28 @@ class ServingRuntime:
         self.registry = registry or ModelRegistry(
             warmup=warmup, max_bucket_rows=max_bucket_rows, rank=rank
         )
-        self._window_s = (
+        window_us = (
             int(envspec.get("TPUML_SERVE_BATCH_WINDOW_US"))
             if batch_window_us is None else int(batch_window_us)
-        ) / 1e6
+        )
+        if (
+            batch_window_us is None
+            and not envspec.is_set("TPUML_SERVE_BATCH_WINDOW_US")
+            and autotune.active()
+        ):
+            # consult-only: the window trades p99 against batch fill, so
+            # winners come from the serving bench probe (bench.py
+            # autotune) where both ends of the trade are measured —
+            # never from inside a live runtime's constructor
+            tune_key = autotune.shape_key(k=MIN_BUCKET_ROWS)
+            tuned = autotune.consult("serve_batch_window_us", tune_key)
+            if isinstance(tuned, int) and 0 <= tuned <= 100_000:
+                window_us = tuned
+            else:
+                autotune.record_heuristic(
+                    "serve_batch_window_us", tune_key, window_us
+                )
+        self._window_s = window_us / 1e6
         default_deadline_ms = (
             envspec.get("TPUML_SERVE_DEFAULT_DEADLINE_MS")
             if default_deadline_ms is None else float(default_deadline_ms)

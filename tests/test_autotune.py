@@ -1,0 +1,493 @@
+"""Measured autotuner (runtime/autotune.py): the off-default must be
+perfectly inert, the cache must survive corruption/concurrency without
+failing a fit, probes must be budget-bounded and warm-cache-free, and
+rank discipline must keep non-zero ranks from ever writing the file."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import spark_rapids_ml_tpu.ops.streaming as streaming
+import spark_rapids_ml_tpu.ops.tree_kernels as tk
+from spark_rapids_ml_tpu.ops.ivf_kernels import resolve_ann_params
+from spark_rapids_ml_tpu.runtime import autotune, envspec, telemetry
+from spark_rapids_ml_tpu.serving.runtime import ServingRuntime
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tuner():
+    autotune.reset_autotune()
+    telemetry.reset_telemetry()
+    yield
+    autotune.reset_autotune()
+    telemetry.reset_telemetry()
+
+
+def _probe_span_count():
+    return sum(
+        st["count"]
+        for name, st in telemetry.span_stats().items()
+        if name.startswith("autotune.probe.")
+    )
+
+
+def _cfg(**kw):
+    base = dict(
+        max_depth=4, n_bins=32, n_features=16, n_stats=2, impurity="gini",
+        k_features=16, min_samples_leaf=1, min_info_gain=0.0,
+        min_samples_split=2, bootstrap=True,
+    )
+    base.update(kw)
+    return tk.ForestConfig(**base)
+
+
+# --------------------------------------------------------------------------
+# defaults inert
+# --------------------------------------------------------------------------
+
+
+def test_defaults_inert(tmp_path, monkeypatch):
+    """TPUML_AUTOTUNE unset: no cache file, no probe spans, no autotune
+    metric series, and tune()/consult() answer None before any I/O."""
+    monkeypatch.delenv("TPUML_AUTOTUNE", raising=False)
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    assert not autotune.active()
+    assert autotune.consult("rf_tree_batch", "k") is None
+    calls = []
+    assert (
+        autotune.tune("rf_tree_batch", "k", [1, 2], lambda c: calls.append(c))
+        is None
+    )
+    assert not calls, "off mode must never invoke the measure closure"
+    assert autotune.consult("rf_tree_batch", "k") is None
+    assert list(tmp_path.iterdir()) == [], "off mode must not create files"
+    snap = telemetry.metrics_snapshot()
+    assert not any(k.startswith("autotune") for k in snap)
+    assert _probe_span_count() == 0
+
+
+def test_defaults_inert_resolvers(monkeypatch):
+    """With the tuner off, every wired resolver answers exactly its
+    static heuristic — the bit-identical-outputs contract."""
+    monkeypatch.delenv("TPUML_AUTOTUNE", raising=False)
+    cfg = _cfg()
+    monkeypatch.setenv("TPUML_RF_TREE_BATCH", "auto")
+    base_batch = tk.resolve_tree_batch(8, cfg, 600)
+    assert resolve_ann_params(4096) == resolve_ann_params(4096)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    assert streaming.select_wire_format(x, requested="auto") in (
+        "int8", "f16", "f32",
+    )
+    with autotune.collect() as decisions:
+        assert tk.resolve_tree_batch(8, cfg, 600) == base_batch
+    assert decisions == [], "off mode must not file provenance"
+
+
+# --------------------------------------------------------------------------
+# probe engine
+# --------------------------------------------------------------------------
+
+
+def test_probe_default_always_measured_and_budget_bounded(monkeypatch):
+    """The heuristic default (candidates[0]) is measured even under a
+    zero budget, and the budget stops further measurements."""
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    measured = []
+
+    def measure(c):
+        measured.append(c)
+        return 0.010 if c == "default" else 0.001
+
+    d = autotune.probe(
+        "k", "s", ["default", "b", "c", "d"], measure, budget_ms=0.0,
+        store_result=False,
+    )
+    assert measured == ["default"], measured
+    assert d.value == "default"
+
+
+def test_probe_prefers_measured_winner_with_margin(monkeypatch):
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    costs = {1: 0.02, 2: 0.01, 4: 0.004, 8: 0.03}
+    d = autotune.probe("k", "s", [1, 2, 4, 8], costs.get, store_result=False)
+    assert d.value == 4
+    # near-tie (within the 2% hysteresis margin) resolves to the default
+    d2 = autotune.probe(
+        "k2", "s", [1, 2], {1: 0.1000, 2: 0.0999}.get, store_result=False
+    )
+    assert d2.value == 1
+
+
+def test_probe_infeasible_and_raising_candidates_dropped(monkeypatch):
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+
+    def measure(c):
+        if c == "bad":
+            return None
+        if c == "boom":
+            raise RuntimeError("candidate exploded")
+        return {"a": 0.02, "b": 0.01}[c]
+
+    d = autotune.probe(
+        "k", "s", ["a", "bad", "boom", "b"], measure, store_result=False
+    )
+    assert d.value == "b"
+
+
+def test_probe_spans_carry_warmup_and_count(monkeypatch, tmp_path):
+    """Probe dispatches run under autotune.probe.<knob> spans with the
+    inheritable warmup attr — and a warm cache runs ZERO of them."""
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    # TPUML_TRACE is path-valued: point it at tmp so the atexit dump
+    # doesn't litter the working directory.
+    monkeypatch.setenv("TPUML_TRACE", str(tmp_path / "trace"))
+    telemetry.reset_telemetry()
+    seen = []
+
+    def sink(span, _thread):
+        if span["name"].startswith("autotune.probe."):
+            seen.append(span)
+
+    telemetry.add_span_sink(sink)
+    try:
+        key = autotune.shape_key(n=100)
+        v = autotune.tune("k", key, [1, 2], {1: 0.02, 2: 0.01}.get)
+        assert v == 2
+        assert seen and all(s["args"].get("warmup") for s in seen)
+        cold_probes = telemetry.counter("autotune_probes_total").value(knob="k")
+        assert cold_probes == 1
+        n_spans = len(seen)
+        # warm pass: same knob+key answers from the cache, no new spans
+        autotune.reset_autotune()
+        assert autotune.tune("k", key, [1, 2], {1: 0.02, 2: 0.01}.get) == 2
+        assert len(seen) == n_spans
+        assert telemetry.counter("autotune_probes_total").value(knob="k") == 1
+        assert telemetry.counter("autotune_cache_hits").value(knob="k") == 1
+    finally:
+        telemetry.remove_span_sink(sink)
+
+
+# --------------------------------------------------------------------------
+# cache robustness
+# --------------------------------------------------------------------------
+
+
+def _cache_file(tmp_path):
+    return os.path.join(str(tmp_path), autotune.CACHE_FILENAME)
+
+
+def test_corrupt_cache_falls_back_loudly_once(tmp_path, monkeypatch):
+    import logging
+
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    with open(_cache_file(tmp_path), "w") as f:
+        f.write("{ definitely not json")
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    # the package root disables propagation, so attach directly
+    logger = logging.getLogger("spark_rapids_ml_tpu.autotune")
+    handler = _Capture(level=logging.WARNING)
+    logger.addHandler(handler)
+    try:
+        assert autotune.consult("k", "s") is None
+        assert autotune.consult("k", "s2") is None
+    finally:
+        logger.removeHandler(handler)
+    warnings = [r for r in records if "unreadable" in r.getMessage()]
+    assert len(warnings) == 1, "corrupt cache must warn exactly once"
+
+
+def test_truncated_cache_tolerated(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    autotune.store("k", "s", 7, fitness_s=0.01)
+    full = open(_cache_file(tmp_path)).read()
+    with open(_cache_file(tmp_path), "w") as f:
+        f.write(full[: len(full) // 2])  # torn write
+    autotune.reset_autotune()
+    assert autotune.consult("k", "s") is None  # heuristics, not a crash
+
+
+def test_wrong_version_and_malformed_entries_tolerated(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    doc = {
+        "version": 999,
+        "entries": {"k|s": {"value": 3}},
+    }
+    with open(_cache_file(tmp_path), "w") as f:
+        json.dump(doc, f)
+    assert autotune.consult("k", "s") is None
+    # right version, junk entries: only well-formed ones survive
+    autotune.reset_autotune()
+    doc = {
+        "version": autotune.CACHE_VERSION,
+        "entries": {"k|s": {"value": 3}, "k|bad": "nope", "k|bad2": {}},
+    }
+    with open(_cache_file(tmp_path), "w") as f:
+        json.dump(doc, f)
+    assert autotune.consult("k", "s") == 3
+    assert autotune.consult("k", "bad") is None
+    assert autotune.consult("k", "bad2") is None
+
+
+def test_concurrent_writers_keep_a_valid_file(tmp_path, monkeypatch):
+    """N threads storing different knobs concurrently: the file stays
+    parseable (atomic replace) and the merge keeps every knob."""
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+
+    def write(i):
+        autotune.store(f"knob{i}", "s", i, fitness_s=0.01)
+
+    threads = [threading.Thread(target=write, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    doc = json.load(open(_cache_file(tmp_path)))
+    assert doc["version"] == autotune.CACHE_VERSION
+    autotune.reset_autotune()
+    for i in range(8):
+        assert autotune.consult(f"knob{i}", "s") == i
+
+
+def test_rank_nonzero_never_writes(tmp_path, monkeypatch):
+    """Simulated 2-rank world: rank 1 probes (its fit still benefits
+    in-process) but only rank 0 may write the shared file."""
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    monkeypatch.setenv("TPUML_PROC_ID", "1")
+    v = autotune.tune("k", "s", [1, 2], {1: 0.02, 2: 0.01}.get)
+    assert v == 2
+    assert autotune.consult("k", "s") == 2  # in-process winner survives
+    assert not os.path.exists(_cache_file(tmp_path))
+    monkeypatch.setenv("TPUML_PROC_ID", "0")
+    autotune.store("k", "s", 2, fitness_s=0.01)
+    assert os.path.exists(_cache_file(tmp_path))
+
+
+def test_memory_only_when_cache_dir_unset(monkeypatch):
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.delenv("TPUML_AUTOTUNE_CACHE", raising=False)
+    v = autotune.tune("k", "s", [1, 2], {1: 0.02, 2: 0.01}.get)
+    assert v == 2
+    assert autotune.consult("k", "s") == 2
+
+
+def test_force_reprobes_and_overwrites_stale_entry(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    assert autotune.tune("k", "s", [1, 2], {1: 0.02, 2: 0.01}.get) == 2
+    # hardware moved under the cache: candidate 1 is now fastest
+    calls = []
+
+    def remeasure(c):
+        calls.append(c)
+        return {1: 0.001, 2: 0.01}[c]
+
+    # on-mode trusts the (stale) entry — no measurement
+    autotune.reset_autotune()
+    assert autotune.tune("k", "s", [1, 2], remeasure) == 2
+    assert not calls
+    monkeypatch.setenv("TPUML_AUTOTUNE", "force")
+    autotune.reset_autotune()
+    assert autotune.tune("k", "s", [1, 2], remeasure) == 1
+    assert calls
+    doc = json.load(open(_cache_file(tmp_path)))
+    assert doc["entries"]["k|s"]["value"] == 1
+    # and the overwrite persists for a later on-mode run
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    autotune.reset_autotune()
+    assert autotune.consult("k", "s") == 1
+
+
+# --------------------------------------------------------------------------
+# shape keys
+# --------------------------------------------------------------------------
+
+
+def test_shape_key_buckets_and_pins():
+    k1 = autotune.shape_key(n=1000, d=17, dtype="float32")
+    k2 = autotune.shape_key(n=900, d=20, dtype="float32")
+    k3 = autotune.shape_key(n=3000, d=17, dtype="float32")
+    assert k1 == k2, "same pow2 buckets must share an entry"
+    assert k1 != k3
+    assert autotune.shape_key(n=1000, dtype="float32") != autotune.shape_key(
+        n=1000, dtype="float16"
+    )
+    assert "backend=" in k1 and "mesh=1x1" in k1
+    assert autotune.shape_key(n=8, depth=13) != autotune.shape_key(n=8, depth=7)
+
+
+# --------------------------------------------------------------------------
+# resolver integration
+# --------------------------------------------------------------------------
+
+
+def test_tree_batch_consults_cache_and_validates(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    monkeypatch.setenv("TPUML_RF_TREE_BATCH", "auto")
+    cfg = _cfg()
+    key = autotune.shape_key(
+        n=600, d=cfg.n_features, k=cfg.n_stats, dtype="uint8",
+        depth=cfg.max_depth, group=8,
+    )
+    autotune.store("rf_tree_batch", key, 2, fitness_s=0.01)
+    with autotune.collect() as decisions:
+        assert tk.resolve_tree_batch(8, cfg, 600) == 2
+    assert decisions[-1]["provenance"] == "cache_hit"
+    # a stale width that does not divide the group falls back loudly-
+    # silently to the heuristic (and files heuristic provenance)
+    autotune.store("rf_tree_batch", key, 3, fitness_s=0.01)
+    autotune.reset_autotune()
+    with autotune.collect() as decisions:
+        batch = tk.resolve_tree_batch(8, cfg, 600)
+    assert 8 % batch == 0
+    assert decisions[-1]["provenance"] == "heuristic"
+
+
+def test_ann_params_consult_applies_only_matching_nlist(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    n = 4096
+    base_nlist, base_nprobe = resolve_ann_params(n)
+    autotune.store(
+        "ann_params", autotune.shape_key(n=n), [base_nlist, base_nprobe + 3]
+    )
+    assert resolve_ann_params(n) == (base_nlist, base_nprobe + 3)
+    # explicit pins always win over the cache
+    assert resolve_ann_params(n, nlist=32, nprobe=4) == (32, 4)
+    # entry whose nlist no longer matches the resolved nlist: nprobe
+    # half of the pair must NOT apply
+    autotune.reset_autotune()
+    autotune.store(
+        "ann_params", autotune.shape_key(n=n), [base_nlist + 1, 1]
+    )
+    nl, npb = resolve_ann_params(n, nlist=base_nlist)
+    assert (nl, npb) == (base_nlist, base_nprobe)
+
+
+def test_serving_window_consults_cache(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    monkeypatch.delenv("TPUML_SERVE_BATCH_WINDOW_US", raising=False)
+    from spark_rapids_ml_tpu.serving.registry import MIN_BUCKET_ROWS
+
+    autotune.store(
+        "serve_batch_window_us",
+        autotune.shape_key(k=MIN_BUCKET_ROWS),
+        777,
+    )
+    rt = ServingRuntime()
+    assert rt._window_s == pytest.approx(777 / 1e6)
+    # explicit arg and env pin both bypass the cache
+    rt = ServingRuntime(batch_window_us=123)
+    assert rt._window_s == pytest.approx(123 / 1e6)
+    monkeypatch.setenv("TPUML_SERVE_BATCH_WINDOW_US", "456")
+    rt = ServingRuntime()
+    assert rt._window_s == pytest.approx(456 / 1e6)
+
+
+def test_stream_stage_depth_consults_cache(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    monkeypatch.delenv("TPUML_STREAM_STAGE_DEPTH", raising=False)
+    from spark_rapids_ml_tpu.data.chunks import ArrayChunkSource
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    mesh = make_mesh()
+    np_dtype = np.dtype("float32")
+    src = ArrayChunkSource(X)
+    first = next(iter(src.iter_chunks(32, np_dtype)))
+    depth_key = autotune.shape_key(
+        n=first.X.shape[0], d=first.X.shape[1], dtype=np_dtype, mesh=mesh
+    )
+    autotune.store("stream_stage_depth", depth_key, 0)
+    consumed = list(
+        streaming.iter_device_chunks(
+            ArrayChunkSource(X), mesh, 32, jnp.float32,
+            need_y=False, need_w=False,
+        )
+    )
+    assert consumed
+    assert streaming.last_ingest_report()["stage_depth"] == 0
+
+
+def test_wire_format_tuned_only_among_feasible(monkeypatch, tmp_path):
+    """The tuner may pick a WIDER (more accurate) format than the error
+    probe's choice, never a narrower one; and a poisoned cache entry
+    outside the feasible ladder is ignored."""
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    from spark_rapids_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    rng = np.random.default_rng(0)
+    # smooth data: int8 feasible, so the ladder is int8/f16/f32
+    x = rng.uniform(-1, 1, size=(64, 8)).astype(np.float32)
+    kind = streaming.select_wire_format(x, requested="auto", mesh=mesh)
+    assert kind in ("int8", "f16", "f32")
+    # the winner is cached: a second resolve consults, zero probes
+    before = telemetry.counter("autotune_probes_total").value(
+        knob="wire_dtype"
+    )
+    assert (
+        streaming.select_wire_format(x, requested="auto", mesh=mesh) == kind
+    )
+    after = telemetry.counter("autotune_probes_total").value(knob="wire_dtype")
+    assert after == before
+    # explicit requests are never tuned
+    assert streaming.select_wire_format(x, requested="f32", mesh=mesh) == "f32"
+
+
+def test_fit_report_carries_autotune_provenance(monkeypatch, tmp_path):
+    """End-to-end: a RandomForest fit with the tuner on reports every
+    knob decision in _fit_report['autotuned']; with the tuner off the
+    key is absent and the model is identical."""
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+    from spark_rapids_ml_tpu.data import DataFrame
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(240, 8)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    df = DataFrame({"features": list(X), "label": y})
+
+    def fit_model():
+        est = RandomForestClassifier(
+            numTrees=4, maxDepth=3, seed=7, num_workers=1
+        )
+        return est.fit(df)
+
+    monkeypatch.delenv("TPUML_AUTOTUNE", raising=False)
+    monkeypatch.setenv("TPUML_RF_TREE_BATCH", "auto")
+    m_off = fit_model()
+    assert "autotuned" not in m_off._fit_report
+    monkeypatch.setenv("TPUML_AUTOTUNE", "on")
+    monkeypatch.setenv("TPUML_AUTOTUNE_CACHE", str(tmp_path))
+    m_on = fit_model()
+    tuned = m_on._fit_report["autotuned"]
+    assert any(d["knob"] == "rf_tree_batch" for d in tuned)
+    assert all(
+        d["provenance"] in ("cache_hit", "probed", "heuristic") for d in tuned
+    )
+    # consult-only knob: tuned widths come from the cache, so the fitted
+    # forest is identical either way at the same (valid) width
+    np.testing.assert_array_equal(
+        m_off.transform(df)["prediction"], m_on.transform(df)["prediction"]
+    )

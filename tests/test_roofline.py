@@ -438,6 +438,55 @@ def test_bench_regress_serving_p99_gate(tmp_path):
     assert br.parse_bench_file(str(w)) == {"serving": serving}
 
 
+def test_bench_regress_tuned_vs_default_gate():
+    """The autotuner ratio gates two ways: trajectory (shrink past
+    -threshold vs the prior run) and an absolute floor at 1.0-threshold
+    that bites even on new and tunnel_bound entries — the ratio is
+    measured back-to-back in one run, so link weather cancels out and
+    'no prior run' is no excuse for losing to the default."""
+    br = _load_by_path("bench_regress")
+    good = _entry(1.0, 1.1, 0.0, tuned_vs_default=1.2)
+    # steady ratio: pass
+    rows, failed = br.compare({"autotune": good}, {"autotune": good}, 0.15)
+    assert not failed, rows
+    # default-wins run (exactly 1.0) clears the floor with room
+    rows, failed = br.compare(
+        {}, {"autotune": _entry(1.0, 1.0, 0.0, tuned_vs_default=1.0)}, 0.15
+    )
+    assert not failed, rows
+    # trajectory collapse: 1.2 -> 0.95 is -21%, past the 15% threshold
+    rows, failed = br.compare(
+        {"autotune": good},
+        {"autotune": _entry(1.0, 1.1, 0.0, tuned_vs_default=0.95)},
+        0.15,
+    )
+    assert failed, rows
+    # absolute floor fires with NO prior entry at all...
+    rows, failed = br.compare(
+        {}, {"autotune": _entry(1.0, 1.0, 0.0, tuned_vs_default=0.7)}, 0.15
+    )
+    assert failed, rows
+    # ...and tunnel_bound does not shelter it (same-run ratio)
+    rows, failed = br.compare(
+        {"autotune": good},
+        {"autotune": _entry(
+            1.0, 1.1, 0.0, tuned_vs_default=0.7, tunnel_bound=True
+        )},
+        0.15,
+    )
+    assert failed, rows
+    assert any("tuned_vs_default>=floor" in r[1] for r in rows)
+    # just above the floor, trajectory skipped by tunnel_bound: pass
+    rows, failed = br.compare(
+        {"autotune": good},
+        {"autotune": _entry(
+            1.0, 1.1, 0.0, tuned_vs_default=0.9, tunnel_bound=True
+        )},
+        0.15,
+    )
+    assert not failed, rows
+
+
 def test_bench_regress_parses_wrapper_and_raw(tmp_path):
     br = _load_by_path("bench_regress")
     raw = {
